@@ -1,0 +1,68 @@
+"""Simulation configuration.
+
+The reference hardcodes every protocol parameter as Go package constants
+(reference: slave/slave.go:21-29, main.go:10-12).  Here they live in one typed,
+hashable config so a single compiled round kernel can be reused across the five
+BASELINE.json benchmark configs.
+
+Reference constants reproduced (see BASELINE.md):
+  heartbeat period 1 s  -> 1 round == 1 s of simulated time
+  failure timeout 5 s   -> t_fail = 5 rounds      (slave/slave.go:24)
+  fail-list cooldown 5 s-> t_cooldown = 5 rounds  (slave/slave.go:25)
+  minimum group size 4  -> min_group = 4          (slave/slave.go:504,511)
+  fanout 3 ring         -> topology="ring", fanout=3 (slave/slave.go:517-519)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Literal
+
+Topology = Literal["ring", "random"]
+
+
+@dataclasses.dataclass(frozen=True)
+class SimConfig:
+    """Static (trace-time) parameters of the gossip simulation.
+
+    Frozen + hashable so it can be closed over by ``jax.jit`` without
+    retriggering compilation when reused.
+    """
+
+    n: int = 1024                    # number of simulated nodes (fixed; churn via masks)
+    fanout: int = 3                  # gossip in-degree per round
+    topology: Topology = "ring"      # "ring" = reference parity; "random" = north star
+    t_fail: int = 5                  # rounds without hb advance before declaring failure
+    t_cooldown: int = 5              # rounds a removed member stays on the fail list
+    min_group: int = 4               # below this list size a node only refreshes timestamps
+    hb_grace: int = 1                # only detect members with hb_count > hb_grace
+                                     # (reference: slave/slave.go:468-469)
+    remove_broadcast: bool = True    # detector broadcasts REMOVE to everyone in one round
+                                     # (reference: slave/slave.go:338-363); False = pure
+                                     # gossip dissemination of failures (north-star mode)
+    fresh_cooldown: bool = False     # False = reference-faithful: a removed entry keeps
+                                     # its stale gossip timestamp on the fail list
+                                     # (slave/slave.go:276-286), so detector removals
+                                     # expire ~immediately and zombie re-adds can cycle
+                                     # when remove_broadcast is off.  True = stamp the
+                                     # fail-list entry at removal time, giving the full
+                                     # t_cooldown suppression (required for convergence
+                                     # in gossip-only dissemination mode)
+    introducer: int = 0              # node index playing the hardcoded introducer
+                                     # (reference: slave/slave.go:22)
+
+    def __post_init__(self) -> None:
+        if self.n < 2:
+            raise ValueError(f"n must be >= 2, got {self.n}")
+        if not (0 < self.fanout < self.n):
+            raise ValueError(f"fanout must be in (0, n), got {self.fanout}")
+        if self.topology == "ring" and self.fanout != 3:
+            raise ValueError("ring (parity) topology is defined for fanout=3")
+        if self.t_fail < 1 or self.t_cooldown < 0:
+            raise ValueError("t_fail >= 1 and t_cooldown >= 0 required")
+
+    @staticmethod
+    def log_fanout(n: int) -> int:
+        """North-star fanout = ceil(log2 N), the BASELINE.json 100k config."""
+        return max(1, math.ceil(math.log2(max(n, 2))))

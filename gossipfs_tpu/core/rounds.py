@@ -1,0 +1,330 @@
+"""The gossip round kernel: one synchronous step advances all N nodes.
+
+This replaces the reference's per-node 1 s heartbeat goroutine
+(``HeartBeat``, reference: slave/slave.go:499-544 driven by main.go:27-33) with
+a single batched tensor program.  One call == one heartbeat period == 1
+simulated second for every node at once.  Mapping (SURVEY.md §7.1):
+
+  Go behaviour (cite)                          -> tensor op here
+  bump own heartbeat (slave.go:443-448)        -> diagonal += alive & !small
+  refresh-only when list < 4 (slave.go:504-509)-> age[i, member] = 0 for small rows
+  detect hb>1 & age>5 (slave.go:460-476)       -> fail mask over [N, N]
+  REMOVE broadcast to all (slave.go:338-363)   -> any-over-observers OR into columns
+  RecentFailList cooldown (slave.go:484-497)   -> FAILED entries expire to UNKNOWN
+  push list to fanout + max-merge + local
+  timestamp (slave.go:527-542, 414-427)        -> row gather over in-edges,
+                                                  elementwise max, age reset
+  join via introducer push (slave.go:250-274)  -> introducer row broadcast
+  leave broadcast (slave.go:310-336)           -> column mark FAILED
+
+The Go system is asynchronous (UDP datagrams land whenever); the sim uses the
+standard synchronous-rounds model: messages sent in round t are merged before
+round t+1's detection pass, which is what the 1 s period effectively gives the
+reference on a LAN.
+
+Everything here is pure jnp on static shapes — safe under ``jit``,
+``lax.scan``, and GSPMD sharding (see parallel/mesh.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from gossipfs_tpu.config import SimConfig
+from gossipfs_tpu.core import topology
+from gossipfs_tpu.core.state import FAILED, MEMBER, UNKNOWN, RoundEvents, SimState
+
+
+class RoundMetrics(NamedTuple):
+    """Per-round scalar observables (cheap enough to stack over any horizon)."""
+
+    true_detections: jax.Array   # detector fired on an actually-dead subject
+    false_positives: jax.Array   # detector fired on a live subject
+    n_alive: jax.Array
+
+
+class MetricsCarry(NamedTuple):
+    """Per-subject first-detection / convergence rounds, carried across the scan.
+
+    ``first_detect[j]``: first round any observer's detector fired on j.
+    ``converged[j]``: first round every live observer had dropped j from its
+    list (the cluster-wide detection-complete time the BASELINE curves want).
+    Both are -1 until the event happens; reset to -1 when j rejoins.
+    """
+
+    first_detect: jax.Array  # int32 [N]
+    converged: jax.Array     # int32 [N]
+
+    @staticmethod
+    def init(n: int) -> "MetricsCarry":
+        neg = jnp.full((n,), -1, dtype=jnp.int32)
+        return MetricsCarry(first_detect=neg, converged=neg)
+
+
+def _apply_events(state: SimState, events: RoundEvents, config: SimConfig) -> SimState:
+    """Crash / leave / join, before the heartbeat tick (see module docstring)."""
+    hb, age, status, alive = state.hb, state.age, state.status, state.alive
+
+    # -- leave: broadcast LEAVE, receivers remove + fail-list (slave.go:310-336).
+    # The entry moves onto the fail list keeping its *existing* timestamp
+    # (removeMember appends the live Member struct, slave.go:276-286), so age
+    # keeps running — cooldown is measured from the last gossip refresh.
+    leave = events.leave & alive
+    mark = alive[:, None] & (status == MEMBER) & leave[None, :]
+    status = jnp.where(mark, FAILED, status)
+    if config.fresh_cooldown:
+        age = jnp.where(mark, 0, age)
+
+    # -- crash-stop: silent death (README.md:30 "CTRL+C to crash")
+    alive = alive & ~(events.crash | leave)
+
+    # -- join: introducer appends unconditionally (addNewMember, slave.go:250-274)
+    #    then pushes its full list to every member; receivers merge-add unless
+    #    the joiner is on their RecentFailList (slave.go:430-439).
+    join = events.join & ~alive
+    intro = config.introducer
+    intro_alive = alive[intro]
+    any_join = jnp.any(join)
+    eff = join & intro_alive  # joins are lost if the introducer is down (SPOF kept)
+
+    # introducer's own row: unconditional append at hb=0
+    intro_row_add = eff & (jnp.arange(state.n) != intro)
+    intro_sel = (jnp.arange(state.n) == intro)[:, None] & intro_row_add[None, :]
+    status = jnp.where(intro_sel, MEMBER, status)
+    hb = jnp.where(intro_sel, 0, hb)
+    age = jnp.where(intro_sel, 0, age)
+
+    # everyone else merges the introducer's pushed list: add joiner if UNKNOWN
+    recv_add = alive[:, None] & (status == UNKNOWN) & eff[None, :]
+    status = jnp.where(recv_add, MEMBER, status)
+    hb = jnp.where(recv_add, 0, hb)
+    age = jnp.where(recv_add, 0, age)
+
+    # the joiner's fresh table = the introducer's post-append row (it receives
+    # the same full-list push); a fresh process has an empty fail list.
+    joiner_status = jnp.where(status[intro] == MEMBER, MEMBER, UNKNOWN)
+    joiner_hb = jnp.where(status[intro] == MEMBER, hb[intro], 0)
+    new_row = eff[:, None]
+    status = jnp.where(new_row, joiner_status[None, :], status)
+    hb = jnp.where(new_row, joiner_hb[None, :], hb)
+    age = jnp.where(new_row, 0, age)
+    # self entry always present (InitMembership, slave.go:161-167)
+    self_sel = new_row & (jnp.arange(state.n)[None, :] == jnp.arange(state.n)[:, None])
+    status = jnp.where(self_sel, MEMBER, status)
+    hb = jnp.where(self_sel, 0, hb)
+
+    alive = alive | eff
+    # guard: when no joins fired, keep arrays untouched (cheap no-op branch not
+    # needed — masks are all-false — but keeps numerics identical)
+    del any_join
+    return SimState(hb=hb, age=age, status=status, alive=alive, round=state.round)
+
+
+def _tick(
+    state: SimState, config: SimConfig
+) -> tuple[SimState, jax.Array, jax.Array]:
+    """Per-node heartbeat pass: refresh/bump/detect/remove-broadcast/cooldown.
+
+    Returns (state, fail_events [N,N] bool, active [N] bool senders).
+    """
+    n = state.n
+    hb, age, status, alive = state.hb, state.age, state.status, state.alive
+    eye = jnp.eye(n, dtype=bool)
+
+    counts = jnp.sum((status == MEMBER).astype(jnp.int32), axis=1)
+    small = counts < config.min_group
+    active = alive & ~small
+    refresher = alive & small
+
+    # small groups only refresh timestamps (slave.go:504-509)
+    refresh_all = refresher[:, None] & (status == MEMBER)
+    age = jnp.where(refresh_all, 0, age)
+
+    # bump own heartbeat + stamp — only while the self entry is still in the
+    # list (updateMemberList matches by address, slave.go:443-448; a node that
+    # processed a REMOVE about itself stops bumping)
+    bump = eye & active[:, None] & (status == MEMBER)
+    hb = hb + bump.astype(jnp.int32)
+    age = jnp.where(bump, 0, age)
+
+    # failure detection (slave.go:460-476): member, not self, past the hb
+    # grace, and silent for more than t_fail rounds.  Removed entries keep
+    # their stale timestamp on the fail list (slave.go:276-286): age runs on.
+    fail = (
+        active[:, None]
+        & (status == MEMBER)
+        & ~eye
+        & (hb > config.hb_grace)
+        & (age > config.t_fail)
+    )
+    status = jnp.where(fail, FAILED, status)
+    if config.fresh_cooldown:
+        age = jnp.where(fail, 0, age)
+
+    # REMOVE broadcast (slave.go:338-363): one detection removes j everywhere
+    # this round.  North-star mode turns this off and lets removal spread by
+    # gossip omission instead.
+    if config.remove_broadcast:
+        removed = jnp.any(fail, axis=0)
+        mark = alive[:, None] & (status == MEMBER) & removed[None, :]
+        status = jnp.where(mark, FAILED, status)
+        if config.fresh_cooldown:
+            age = jnp.where(mark, 0, age)
+
+    # fail-list cooldown expiry (cleanFailList, slave.go:484-497).  Because the
+    # fail-list entry keeps its last-refresh timestamp, detector-removed
+    # entries (already > t_fail stale) expire the same tick; only LEAVE/REMOVE
+    # entries with fresh timestamps get the full suppression window.
+    expire = (status == FAILED) & (age > config.t_cooldown)
+    status = jnp.where(expire, UNKNOWN, status)
+
+    return (
+        SimState(hb=hb, age=age, status=status, alive=alive, round=state.round),
+        fail,
+        active,
+    )
+
+
+def _merge(
+    state: SimState, edges: jax.Array, senders: jax.Array, config: SimConfig
+) -> SimState:
+    """Gossip exchange: gather sender rows over in-edges, elementwise-max merge.
+
+    Implements MergeMemberList (slave.go:414-440): shared members take the max
+    heartbeat and a *local* timestamp; unknown members are added unless on the
+    receiver's fail list (FAILED entries ignore gossip entirely).
+
+    Loops over the fanout with a fori_loop so peak memory stays at one [N, N]
+    gathered temp regardless of fanout (fanout can be ~17 at N=100k).
+    """
+    hb, age, status, alive = state.hb, state.age, state.status, state.alive
+
+    def body(f, acc):
+        best_hb, any_member = acc
+        k = lax.dynamic_index_in_dim(edges, f, axis=1, keepdims=False)  # [N]
+        ok = senders[k][:, None]                     # sender actually gossiped
+        s_member = (status[k, :] == MEMBER) & ok     # entry present in message
+        s_hb = jnp.where(s_member, hb[k, :], -1)
+        return jnp.maximum(best_hb, s_hb), any_member | s_member
+
+    init = (jnp.full(hb.shape, -1, dtype=hb.dtype), jnp.zeros(hb.shape, dtype=bool))
+    best_hb, any_member = lax.fori_loop(0, edges.shape[1], body, init)
+
+    recv = alive[:, None]
+    advance = recv & (status == MEMBER) & (best_hb > hb)       # max-merge + stamp
+    add = recv & (status == UNKNOWN) & any_member              # learn new member
+    hb = jnp.where(advance | add, best_hb, hb)
+    age = jnp.where(advance | add, 0, age)
+    status = jnp.where(add, MEMBER, status)
+    return SimState(hb=hb, age=age, status=status, alive=alive, round=state.round)
+
+
+@partial(jax.jit, static_argnames=("config",))
+def gossip_round(
+    state: SimState,
+    events: RoundEvents,
+    edges: jax.Array | None,
+    config: SimConfig,
+) -> tuple[SimState, RoundMetrics, jax.Array]:
+    """Advance the whole cluster by one heartbeat period.
+
+    ``edges`` is the random-topology in-edge array; pass None for ring mode,
+    where edges are derived from the post-tick membership tables (the
+    reference computes push targets after updateMemberList, slave.go:510-524).
+    Returns (next_state, per-round metrics, fail_events [N,N]).
+    """
+    state = _apply_events(state, events, config)
+    state, fail, active = _tick(state, config)
+    if config.topology == "ring":
+        edges = topology.ring_edges_from_status(state.status)
+    assert edges is not None
+    state = _merge(state, edges, active, config)
+
+    # age advances for every entry not refreshed this round (refreshes wrote 0)
+    state = state._replace(age=state.age + 1, round=state.round + 1)
+
+    dead = ~state.alive
+    metrics = RoundMetrics(
+        true_detections=jnp.sum(fail & dead[None, :], dtype=jnp.int32),
+        false_positives=jnp.sum(fail & state.alive[None, :], dtype=jnp.int32),
+        n_alive=jnp.sum(state.alive, dtype=jnp.int32),
+    )
+    return state, metrics, fail
+
+
+def _update_carry(
+    carry: MetricsCarry,
+    state: SimState,
+    rejoined: jax.Array,
+    fail: jax.Array,
+    round_idx: jax.Array,
+) -> MetricsCarry:
+    n = state.n
+    first_detect, converged = carry
+    # rejoined = joins that actually took effect: new incarnation, new clock
+    first_detect = jnp.where(rejoined, -1, first_detect)
+    converged = jnp.where(rejoined, -1, converged)
+
+    any_fail = jnp.any(fail, axis=0)
+    first_detect = jnp.where((first_detect < 0) & any_fail, round_idx, first_detect)
+
+    eye = jnp.eye(n, dtype=bool)
+    dropped = ~state.alive[:, None] | eye | (state.status != MEMBER)
+    all_dropped = jnp.all(dropped, axis=0) & ~state.alive
+    converged = jnp.where((converged < 0) & all_dropped, round_idx, converged)
+    return MetricsCarry(first_detect=first_detect, converged=converged)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("config", "num_rounds", "crash_rate", "rejoin_rate"),
+)
+def run_rounds(
+    state: SimState,
+    config: SimConfig,
+    num_rounds: int,
+    key: jax.Array,
+    events: RoundEvents | None = None,
+    crash_rate: float = 0.0,
+    rejoin_rate: float = 0.0,
+) -> tuple[SimState, MetricsCarry, RoundMetrics]:
+    """Scan ``num_rounds`` gossip rounds.
+
+    ``events``: optional pre-scheduled RoundEvents stacked to [num_rounds, N]
+    (deterministic fault injection — the sim's CTRL+C).  ``crash_rate`` /
+    ``rejoin_rate`` add per-round random churn on top (BASELINE configs 3/4).
+    Returns final state, per-subject detection/convergence rounds, and
+    per-round metrics stacked over the horizon.
+    """
+    n = config.n
+    if events is None:
+        zeros = jnp.zeros((num_rounds, n), dtype=bool)
+        events = RoundEvents(crash=zeros, leave=zeros, join=zeros)
+
+    def step(carry, ev: RoundEvents):
+        st, mc = carry
+        k = jax.random.fold_in(key, st.round)
+        k_edge, k_churn = jax.random.split(k)
+        if crash_rate > 0.0 or rejoin_rate > 0.0:
+            crash, join = topology.churn_masks(k_churn, st.alive, crash_rate, rejoin_rate)
+            ev = RoundEvents(crash=ev.crash | crash, leave=ev.leave, join=ev.join | join)
+        edges = (
+            None
+            if config.topology == "ring"
+            else topology.random_in_edges(k_edge, config.n, config.fanout)
+        )
+        round_idx = st.round
+        alive_before = st.alive
+        st, metrics, fail = gossip_round(st, ev, edges, config)
+        # joins lost to a dead introducer don't reset metrics (slave.go:22 SPOF)
+        rejoined = ev.join & ~alive_before & st.alive
+        mc = _update_carry(mc, st, rejoined, fail, round_idx)
+        return (st, mc), metrics
+
+    (state, mcarry), per_round = lax.scan(step, (state, MetricsCarry.init(n)), events)
+    return state, mcarry, per_round

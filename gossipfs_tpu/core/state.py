@@ -1,0 +1,97 @@
+"""Global simulation state: every node's membership table as one batched tensor.
+
+The reference keeps, per node, a ``[]master.Member`` slice of
+``{Address, HeartbeatCount, UpdateTime}`` records (reference:
+master/master.go:16-20, slave/slave.go:59-118).  The TPU-native build holds all
+N tables at once as a structure-of-arrays ``[N, N]`` state — row *i* is node
+*i*'s view of every peer *j*:
+
+  ``hb[i, j]``     heartbeat count *i* currently knows for *j*
+                   (reference ``Member.HeartbeatCount``)
+  ``age[i, j]``    rounds since the entry was last refreshed — the round-time
+                   equivalent of ``now - Member.UpdateTime`` (slave.go:426,470)
+  ``status[i, j]`` UNKNOWN (not in *i*'s list) / MEMBER (in the list) /
+                   FAILED (removed, on the RecentFailList cooldown —
+                   slave/slave.go:276-286, 484-497)
+
+plus ground truth ``alive[j]`` (is the simulated process up) and the global
+round counter.  Keeping N fixed and encoding churn in ``alive``/``status``
+avoids shape changes that would retrigger XLA compilation.
+
+Arrays are sharded over the **subject axis j** (columns) on the device mesh:
+the gossip merge gathers whole *rows* by sender index, which is local to every
+column shard — see gossipfs_tpu/parallel/mesh.py.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from gossipfs_tpu.config import SimConfig
+
+# status lane values
+UNKNOWN = jnp.int8(0)   # j not in i's membership list
+MEMBER = jnp.int8(1)    # j in i's list (alive as far as i knows)
+FAILED = jnp.int8(2)    # j removed by i, still on the RecentFailList cooldown
+
+
+class SimState(NamedTuple):
+    """Pytree of the full simulation state (see module docstring)."""
+
+    hb: jax.Array       # int32 [N, N]
+    age: jax.Array      # int32 [N, N]
+    status: jax.Array   # int8  [N, N]
+    alive: jax.Array    # bool  [N]
+    round: jax.Array    # int32 scalar
+
+    @property
+    def n(self) -> int:
+        return self.hb.shape[0]
+
+
+class RoundEvents(NamedTuple):
+    """Per-round external events (the sim equivalent of CTRL+C / CLI verbs).
+
+    Reference fault model is crash-stop via CTRL+C plus voluntary ``leave``
+    and ``join`` (reference: README.md:30, slave/slave.go:288-336).
+    """
+
+    crash: jax.Array    # bool [N] — die silently this round
+    leave: jax.Array    # bool [N] — broadcast LEAVE, then die
+    join: jax.Array     # bool [N] — (re)join through the introducer
+
+    @staticmethod
+    def none(n: int) -> "RoundEvents":
+        z = jnp.zeros((n,), dtype=bool)
+        return RoundEvents(crash=z, leave=z, join=z)
+
+
+def init_state(config: SimConfig, member_mask: jax.Array | None = None) -> SimState:
+    """Fully-joined initial cohort.
+
+    Every node in ``member_mask`` (default: all N) starts with every other
+    member in its list at heartbeat 0, freshly stamped — the state the
+    reference reaches after all nodes complete the JOIN handshake
+    (reference: slave/slave.go:250-274, 161-167).
+    """
+    n = config.n
+    if member_mask is None:
+        member_mask = jnp.ones((n,), dtype=bool)
+    member_mask = member_mask.astype(bool)
+    # i knows j iff both are initial members
+    know = member_mask[:, None] & member_mask[None, :]
+    return SimState(
+        hb=jnp.zeros((n, n), dtype=jnp.int32),
+        age=jnp.zeros((n, n), dtype=jnp.int32),
+        status=jnp.where(know, MEMBER, UNKNOWN).astype(jnp.int8),
+        alive=member_mask,
+        round=jnp.int32(0),
+    )
+
+
+def member_counts(state: SimState) -> jax.Array:
+    """Size of each node's membership list (int32 [N])."""
+    return jnp.sum((state.status == MEMBER).astype(jnp.int32), axis=1)

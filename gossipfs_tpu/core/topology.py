@@ -1,0 +1,101 @@
+"""Gossip topologies: who merges whose membership table each round.
+
+We express the exchange as **in-edges**: ``A[i, f]`` is the f-th peer whose
+table node *i* merges this round.  This receiver-centric form makes the round
+kernel a plain row gather (no scatter), which is both XLA-friendly and exactly
+local under subject-axis sharding.
+
+Parity mode — the reference *pushes* its full list to the three fixed ring
+neighbours ``self-1, self+1, self+2 (mod N)`` (reference: slave/slave.go:515-524).
+Inverting the push direction, node *i* *receives* from offsets ``+1, -1, -2``;
+``ring_in_edges`` encodes those, so the simulated information flow matches the
+Go wire traffic edge-for-edge.
+
+North-star mode — BASELINE.json generalises to random fanout ``k = ceil(log2 N)``:
+each node merges k uniformly random distinct-from-self peers per round
+(fresh graph every round, seeded — the deterministic stand-in for "pick k
+random gossip targets").
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from gossipfs_tpu.config import SimConfig
+
+
+def ring_edges_from_status(status: jax.Array) -> jax.Array:
+    """int32 [N, 3] — per-receiver ring in-edges over each node's *own* list.
+
+    The reference recomputes its three push targets every heartbeat from its
+    current member-list positions (self-1, self+1, self+2 — reference:
+    slave/slave.go:515-524), so the ring heals as members are removed.  We keep
+    that dynamism but (a) order the ring by node id instead of join order and
+    (b) invert push to receive: with converged lists, node *i* receives from
+    exactly {next member above, first below, second below} in cyclic id order.
+    During transient list disagreement the inversion is approximate (a sender
+    whose list differs from the receiver's may pick different targets).
+
+    Nodes with too few other members fall back to self-edges, which merge as
+    no-ops (senders below min_group don't gossip anyway, slave.go:504-509).
+    """
+    from gossipfs_tpu.core.state import MEMBER
+
+    n = status.shape[0]
+    i = jnp.arange(n, dtype=jnp.int32)[:, None]
+    j = jnp.arange(n, dtype=jnp.int32)[None, :]
+    m = (status == MEMBER) & (j != i)
+    big = jnp.int32(n + 1)
+    dn = jnp.where(m, (j - i) % n, big)
+    next1 = jnp.argmin(dn, axis=1).astype(jnp.int32)
+    dp = jnp.where(m, (i - j) % n, big)
+    prev1 = jnp.argmin(dp, axis=1).astype(jnp.int32)
+    dp2 = dp.at[jnp.arange(n), prev1].set(big)
+    prev2 = jnp.argmin(dp2, axis=1).astype(jnp.int32)
+    cnt = jnp.sum(m, axis=1)
+    self_idx = jnp.arange(n, dtype=jnp.int32)
+    next1 = jnp.where(cnt >= 1, next1, self_idx)
+    prev1 = jnp.where(cnt >= 1, prev1, self_idx)
+    prev2 = jnp.where(cnt >= 2, prev2, self_idx)
+    return jnp.stack([next1, prev1, prev2], axis=1)
+
+
+def random_in_edges(key: jax.Array, n: int, fanout: int) -> jax.Array:
+    """int32 [N, F] — per-round uniform random peers, never self.
+
+    Samples uniformly from the n-1 non-self indices by drawing in ``[0, n-1)``
+    and shifting values >= self up by one (no rejection loop — static shapes,
+    scan-safe).  Peers may repeat within a row (sampling with replacement),
+    matching random-gossip practice; duplicates only waste a merge.
+    """
+    draw = jax.random.randint(key, (n, fanout), 0, n - 1, dtype=jnp.int32)
+    self_idx = jnp.arange(n, dtype=jnp.int32)[:, None]
+    return draw + (draw >= self_idx).astype(jnp.int32)
+
+
+def in_edges(config: SimConfig, key: jax.Array, status: jax.Array) -> jax.Array:
+    """Per-round in-edges for the configured topology (ring needs ``status``)."""
+    if config.topology == "ring":
+        return ring_edges_from_status(status)
+    return random_in_edges(key, config.n, config.fanout)
+
+
+def churn_masks(
+    key: jax.Array,
+    alive: jax.Array,
+    crash_rate: float,
+    rejoin_rate: float = 0.0,
+) -> tuple[jax.Array, jax.Array]:
+    """Random crash-stop + rejoin masks for one round.
+
+    ``crash_rate`` is the per-round probability an alive node crashes
+    (BASELINE configs 3/4: 1% crash-stop, 5% churn); ``rejoin_rate`` the
+    per-round probability a dead node rejoins (churn/preemption recovery).
+    """
+    k1, k2 = jax.random.split(key)
+    u = jax.random.uniform(k1, alive.shape)
+    crash = alive & (u < crash_rate)
+    v = jax.random.uniform(k2, alive.shape)
+    join = (~alive) & (v < rejoin_rate)
+    return crash, join

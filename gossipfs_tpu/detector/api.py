@@ -1,0 +1,51 @@
+"""The FailureDetector interface — the seam BASELINE.json names.
+
+The reference entangles failure detection with its node runtime (heartbeat
+goroutine + UDP receive loop, slave/slave.go:169-544).  Here the detector is
+an interface: feed membership events in, advance time, read each node's
+membership view and the detection event stream out.  Consumers (the SDFS
+master's placement logic, the CLI, the gRPC shim) do not care whether the
+implementation is the batched TPU sim (detector/sim.py) or real UDP sockets
+(detector/udp.py, the 10-node parity path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Protocol, runtime_checkable
+
+
+@dataclasses.dataclass(frozen=True)
+class DetectionEvent:
+    """One detector firing: ``observer`` declared ``subject`` failed at ``round``."""
+
+    round: int
+    observer: int
+    subject: int
+    false_positive: bool  # subject was actually alive (ground truth known in sim)
+
+
+@runtime_checkable
+class FailureDetector(Protocol):
+    """Protocol every detector implementation satisfies."""
+
+    def join(self, node: int) -> None:
+        """Node (re)joins through the introducer (CLI ``join``, README.md:10)."""
+
+    def leave(self, node: int) -> None:
+        """Voluntary departure with LEAVE broadcast (CLI ``leave``)."""
+
+    def crash(self, node: int) -> None:
+        """Crash-stop fault injection (CTRL+C, README.md:30)."""
+
+    def advance(self, rounds: int = 1) -> None:
+        """Advance simulated/real time by whole heartbeat periods."""
+
+    def membership(self, observer: int) -> list[int]:
+        """Observer's current member list (CLI ``lsm``, README.md:12)."""
+
+    def alive_nodes(self) -> list[int]:
+        """Ground-truth live set (what the SDFS master consumes)."""
+
+    def drain_events(self) -> list[DetectionEvent]:
+        """Detection events since the last drain."""

@@ -1,0 +1,103 @@
+"""TPU-sim implementation of the FailureDetector interface.
+
+Wraps the batched round kernel (core/rounds.py) behind the per-node verbs the
+CLI / SDFS shim consume.  Interactive path: one jitted ``gossip_round`` per
+``advance``; bulk experiments should call ``core.rounds.run_rounds`` directly
+(scan, no per-round host sync).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gossipfs_tpu.config import SimConfig
+from gossipfs_tpu.core.rounds import gossip_round
+from gossipfs_tpu.core.state import MEMBER, RoundEvents, SimState, init_state
+from gossipfs_tpu.detector.api import DetectionEvent
+
+
+class SimDetector:
+    """N simulated gossip nodes advanced one tensor step per heartbeat."""
+
+    def __init__(
+        self,
+        config: SimConfig,
+        member_mask: np.ndarray | None = None,
+        seed: int = 0,
+    ):
+        self.config = config
+        self.state: SimState = init_state(
+            config, None if member_mask is None else jnp.asarray(member_mask)
+        )
+        self._key = jax.random.PRNGKey(seed)
+        self._pending_crash: set[int] = set()
+        self._pending_leave: set[int] = set()
+        self._pending_join: set[int] = set()
+        self._events: list[DetectionEvent] = []
+
+    # -- event verbs -------------------------------------------------------
+    def _check(self, node: int) -> int:
+        if not 0 <= node < self.config.n:
+            raise ValueError(f"node id {node} out of range [0, {self.config.n})")
+        return node
+
+    def join(self, node: int) -> None:
+        self._pending_join.add(self._check(node))
+
+    def leave(self, node: int) -> None:
+        self._pending_leave.add(self._check(node))
+
+    def crash(self, node: int) -> None:
+        self._pending_crash.add(self._check(node))
+
+    # -- time --------------------------------------------------------------
+    def advance(self, rounds: int = 1) -> None:
+        n = self.config.n
+        for _ in range(rounds):
+            ev = RoundEvents(
+                crash=self._mask(self._pending_crash),
+                leave=self._mask(self._pending_leave),
+                join=self._mask(self._pending_join),
+            )
+            self._pending_crash.clear()
+            self._pending_leave.clear()
+            self._pending_join.clear()
+            k = jax.random.fold_in(self._key, int(self.state.round))
+            if self.config.topology == "ring":
+                edges = None
+            else:
+                from gossipfs_tpu.core.topology import random_in_edges
+
+                edges = random_in_edges(k, n, self.config.fanout)
+            round_idx = int(self.state.round)
+            self.state, _, fail = gossip_round(self.state, ev, edges, self.config)
+            alive = np.asarray(self.state.alive)
+            for obs, subj in np.argwhere(np.asarray(fail)):
+                self._events.append(
+                    DetectionEvent(
+                        round=round_idx,
+                        observer=int(obs),
+                        subject=int(subj),
+                        false_positive=bool(alive[subj]),
+                    )
+                )
+
+    def _mask(self, nodes: set[int]) -> jax.Array:
+        m = np.zeros((self.config.n,), dtype=bool)
+        if nodes:
+            m[list(nodes)] = True
+        return jnp.asarray(m)
+
+    # -- views -------------------------------------------------------------
+    def membership(self, observer: int) -> list[int]:
+        row = np.asarray(self.state.status[observer])
+        return [int(j) for j in np.nonzero(row == int(MEMBER))[0]]
+
+    def alive_nodes(self) -> list[int]:
+        return [int(j) for j in np.nonzero(np.asarray(self.state.alive))[0]]
+
+    def drain_events(self) -> list[DetectionEvent]:
+        out, self._events = self._events, []
+        return out

@@ -1,0 +1,350 @@
+"""Real-socket parity implementation: N gossip nodes over localhost UDP.
+
+BASELINE config 1 is "10-node UDP gossip on localhost (Go-parity path)".  This
+is a faithful re-implementation of the reference's wire behavior — full
+member-list push to ring neighbours every period, ``<#ENTRY#>``/``<#INFO#>``
+list framing and ``addr<CMD>VERB`` control datagrams (reference:
+slave/slave.go:365-385, 293, 218), max-merge with local timestamping
+(slave.go:414-440), timeout detection with hb<=1 grace (slave.go:460-482),
+REMOVE broadcast (slave.go:338-363) and fail-list cooldown with the entry's
+*original* timestamp (slave.go:276-286) — built on asyncio datagram endpoints
+instead of goroutines, with a configurable period so tests run at 20x
+real-time.  It satisfies the same FailureDetector interface as the TPU sim,
+which is the whole point: consumers can't tell them apart.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+from gossipfs_tpu.detector.api import DetectionEvent
+
+ENTRY_SEP = "<#ENTRY#>"
+FIELD_SEP = "<#INFO#>"
+CMD_SEP = "<CMD>"
+
+
+class _Member:
+    __slots__ = ("hb", "ts")
+
+    def __init__(self, hb: float, ts: float):
+        self.hb = int(hb)
+        self.ts = ts
+
+
+class _NodeProtocol(asyncio.DatagramProtocol):
+    def __init__(self, node: "UdpNode"):
+        self.node = node
+
+    def datagram_received(self, data: bytes, addr) -> None:
+        self.node.handle(data.decode(), addr)
+
+
+class UdpNode:
+    """One gossip process: UDP endpoint + heartbeat task."""
+
+    def __init__(self, cluster: "UdpCluster", idx: int, port: int):
+        self.cluster = cluster
+        self.idx = idx
+        self.port = port
+        self.addr = f"127.0.0.1:{port}"
+        self.alive = False
+        self.members: dict[str, _Member] = {}
+        self.fail_list: dict[str, float] = {}  # addr -> entry's last ts
+        self.transport: asyncio.DatagramTransport | None = None
+        self._hb_task: asyncio.Task | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        self.transport, _ = await loop.create_datagram_endpoint(
+            lambda: _NodeProtocol(self), local_addr=("127.0.0.1", self.port)
+        )
+        self.alive = True
+        self.members = {self.addr: _Member(0, self._now())}
+        self._hb_task = asyncio.create_task(self._heartbeat_loop())
+
+    def stop(self, graceful: bool = False) -> None:
+        """graceful=False models CTRL+C (crash-stop, README.md:30)."""
+        if graceful and self.alive:
+            msg = f"{self.addr}{CMD_SEP}LEAVE"
+            for peer in list(self.members):
+                if peer != self.addr:
+                    self._send(peer, msg)
+        self.alive = False
+        if self._hb_task:
+            self._hb_task.cancel()
+        if self.transport:
+            self.transport.close()
+            self.transport = None
+
+    def _now(self) -> float:
+        return time.monotonic()
+
+    def _send(self, peer_addr: str, msg: str) -> None:
+        if self.transport is None:
+            return
+        host, port = peer_addr.rsplit(":", 1)
+        self.transport.sendto(msg.encode(), (host, int(port)))
+
+    # -- wire codec (slave.go:365-385) -------------------------------------
+    def _encode(self) -> str:
+        return ENTRY_SEP.join(
+            f"{a}{FIELD_SEP}{m.hb}{FIELD_SEP}{m.ts}" for a, m in self.members.items()
+        )
+
+    @staticmethod
+    def _decode(payload: str) -> list[tuple[str, int]]:
+        out = []
+        for chunk in payload.split(ENTRY_SEP):
+            parts = chunk.split(FIELD_SEP)
+            if len(parts) >= 2:
+                out.append((parts[0], int(float(parts[1]))))
+        return out
+
+    # -- receive dispatch (GetMsg, slave.go:207-248) ------------------------
+    def handle(self, payload: str, src) -> None:
+        if not self.alive:
+            return
+        if CMD_SEP in payload:
+            arg, verb = payload.split(CMD_SEP, 1)
+            if verb == "JOIN":
+                self._add_member(arg)
+            elif verb in ("LEAVE", "REMOVE"):
+                self._remove_member(arg)
+        else:
+            self._merge(self._decode(payload))
+
+    def _add_member(self, addr: str) -> None:
+        """Introducer path: append + push full list to everyone
+        (addNewMember, slave.go:250-274)."""
+        if addr not in self.members:
+            self.members[addr] = _Member(0, self._now())
+        msg = self._encode()
+        for peer in list(self.members):
+            if peer != self.addr:
+                self._send(peer, msg)
+
+    def _remove_member(self, addr: str) -> None:
+        """Move the entry onto the fail list (removeMember, slave.go:276-286).
+
+        Faithful mode keeps the entry's existing (stale) timestamp, which
+        gives detector-removed entries a near-zero cooldown; when message
+        latency + scheduling jitter is non-trivial relative to the period,
+        that sustains an endemic re-add/re-detect limit cycle (observed both
+        here and in the tensor sim).  fresh_cooldown stamps removal time
+        instead, restoring a real suppression window.
+        """
+        member = self.members.pop(addr, None)
+        if member is not None and addr not in self.fail_list:
+            self.fail_list[addr] = (
+                self._now() if self.cluster.fresh_cooldown else member.ts
+            )
+
+    def _merge(self, remote: list[tuple[str, int]]) -> None:
+        """Anti-entropy max-merge with local stamping (slave.go:414-440)."""
+        now = self._now()
+        for addr, hb in remote:
+            local = self.members.get(addr)
+            if local is not None:
+                if hb > local.hb:
+                    local.hb = hb
+                    local.ts = now
+            elif addr not in self.fail_list:
+                self.members[addr] = _Member(hb, now)
+
+    # -- heartbeat tick (HeartBeat, slave.go:499-544) -----------------------
+    async def _heartbeat_loop(self) -> None:
+        period = self.cluster.period
+        while self.alive:
+            await asyncio.sleep(period)
+            self.tick()
+
+    def tick(self) -> None:
+        c = self.cluster
+        now = self._now()
+        if not self.alive:
+            return
+        if len(self.members) < c.min_group:
+            for m in self.members.values():
+                m.ts = now  # refresh-only (slave.go:504-509)
+            return
+        me = self.members.get(self.addr)
+        if me is not None:
+            me.hb += 1
+            me.ts = now
+        # detection (slave.go:460-482)
+        t_fail = c.t_fail * c.period
+        for addr in list(self.members):
+            if addr == self.addr:
+                continue
+            m = self.members[addr]
+            if m.hb > 1 and m.ts < now - t_fail:
+                self._remove_member(addr)
+                c.record_detection(self.idx, addr)
+                msg = f"{addr}{CMD_SEP}REMOVE"
+                for peer in list(self.members):
+                    if peer != self.addr:
+                        self._send(peer, msg)
+        # fail-list cooldown (slave.go:484-497)
+        t_cool = c.t_cooldown * c.period
+        for addr in list(self.fail_list):
+            if self.fail_list[addr] < now - t_cool:
+                del self.fail_list[addr]
+        # ring push to list positions self-1, self+1, self+2 (slave.go:515-542)
+        ordered = sorted(self.members)
+        if self.addr not in ordered:
+            return  # removed-self edge case: no push targets defined
+        i = ordered.index(self.addr)
+        n = len(ordered)
+        msg = self._encode()
+        for off in (-1, 1, 2):
+            peer = ordered[(i + off) % n]
+            if peer != self.addr:
+                self._send(peer, msg)
+
+
+class UdpCluster:
+    """FailureDetector over real localhost sockets (asyncio-driven)."""
+
+    def __init__(
+        self,
+        n: int,
+        base_port: int = 18000,
+        period: float = 0.05,
+        t_fail: int = 5,
+        t_cooldown: int = 5,
+        min_group: int = 4,
+        fresh_cooldown: bool = False,
+    ):
+        self.n = n
+        self.period = period
+        self.t_fail = t_fail
+        self.t_cooldown = t_cooldown
+        self.min_group = min_group
+        self.fresh_cooldown = fresh_cooldown
+        self.nodes = [UdpNode(self, i, base_port + i) for i in range(n)]
+        self._addr_to_idx = {node.addr: i for i, node in enumerate(self.nodes)}
+        self._events: list[DetectionEvent] = []
+        self._round = 0
+        self.introducer = 0
+
+    def record_detection(self, observer: int, subject_addr: str) -> None:
+        subject = self._addr_to_idx[subject_addr]
+        self._events.append(
+            DetectionEvent(
+                round=self._round,
+                observer=observer,
+                subject=subject,
+                false_positive=self.nodes[subject].alive,
+            )
+        )
+
+    # -- async lifecycle ----------------------------------------------------
+    async def start_all(self) -> None:
+        for node in self.nodes:
+            await node.start()
+        # everyone joins through the introducer (slave.go:288-308)
+        intro = self.nodes[self.introducer]
+        for node in self.nodes:
+            if node.idx != self.introducer:
+                node._send(intro.addr, f"{node.addr}{CMD_SEP}JOIN")
+        await asyncio.sleep(self.period)
+
+    async def run(self, rounds: int) -> None:
+        for _ in range(rounds):
+            await asyncio.sleep(self.period)
+            self._round += 1
+
+    # -- FailureDetector verbs (used inside the event loop) -----------------
+    def crash(self, node: int) -> None:
+        self.nodes[node].stop(graceful=False)
+
+    def leave(self, node: int) -> None:
+        self.nodes[node].stop(graceful=True)
+
+    async def join(self, node: int) -> None:
+        """(Re)start a node's process and send JOIN to the introducer
+        (slave.go:288-308).  Lost if the introducer is down — SPOF kept."""
+        n = self.nodes[node]
+        if not n.alive:
+            await n.start()
+        n._send(self.nodes[self.introducer].addr, f"{n.addr}{CMD_SEP}JOIN")
+
+    def membership(self, observer: int) -> list[int]:
+        return sorted(
+            self._addr_to_idx[a]
+            for a in self.nodes[observer].members
+            if a in self._addr_to_idx
+        )
+
+    def alive_nodes(self) -> list[int]:
+        return [i for i, node in enumerate(self.nodes) if node.alive]
+
+    def drain_events(self) -> list[DetectionEvent]:
+        out, self._events = self._events, []
+        return out
+
+    def stop_all(self) -> None:
+        for node in self.nodes:
+            if node.alive:
+                node.stop()
+
+
+class UdpDetector:
+    """Synchronous FailureDetector facade over UdpCluster.
+
+    Runs the asyncio event loop on a background thread so the UDP parity path
+    is drop-in interchangeable with detector/sim.SimDetector — same verbs,
+    same views, real datagrams underneath.  ``advance(r)`` blocks for r
+    heartbeat periods of wall time (this detector runs in real time; the sim
+    runs as fast as the chip allows — that asymmetry is the whole point).
+    """
+
+    def __init__(self, n: int, **cluster_kwargs):
+        import concurrent.futures
+        import threading
+
+        self.cluster = UdpCluster(n, **cluster_kwargs)
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._loop.run_forever, daemon=True)
+        self._thread.start()
+        self._call(self.cluster.start_all()).result(timeout=30)
+        self._futures = concurrent.futures
+
+    def _call(self, coro):
+        return asyncio.run_coroutine_threadsafe(coro, self._loop)
+
+    def _sync(self, fn, *args):
+        async def run():
+            return fn(*args)
+
+        return self._call(run()).result(timeout=30)
+
+    # -- FailureDetector protocol ------------------------------------------
+    def join(self, node: int) -> None:
+        self._call(self.cluster.join(node)).result(timeout=30)
+
+    def leave(self, node: int) -> None:
+        self._sync(self.cluster.leave, node)
+
+    def crash(self, node: int) -> None:
+        self._sync(self.cluster.crash, node)
+
+    def advance(self, rounds: int = 1) -> None:
+        self._call(self.cluster.run(rounds)).result(timeout=30 + rounds)
+
+    def membership(self, observer: int) -> list[int]:
+        return self._sync(self.cluster.membership, observer)
+
+    def alive_nodes(self) -> list[int]:
+        return self._sync(self.cluster.alive_nodes)
+
+    def drain_events(self):
+        return self._sync(self.cluster.drain_events)
+
+    def close(self) -> None:
+        self._sync(self.cluster.stop_all)
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=10)

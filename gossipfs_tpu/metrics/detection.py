@@ -1,0 +1,70 @@
+"""Detection-quality metrics: time-to-detect, convergence, false-positive rate.
+
+The reference's entire benchmarking apparatus is one wall-clock print in
+``Get`` (slave/slave.go:888-890) and grep over Machine.log (report.pdf,
+"Testing").  Here the BASELINE.md curves — time-to-detect and FPR vs N —
+are array reductions over the sim outputs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from gossipfs_tpu.core.rounds import MetricsCarry, RoundMetrics
+
+
+@dataclasses.dataclass
+class DetectionReport:
+    """Summary of one simulation run's failure-detection behavior."""
+
+    n: int
+    rounds: int
+    # per tracked crash: rounds from crash to first detection / full removal
+    ttd_first: dict[int, int]        # node -> rounds (or -1 if never detected)
+    ttd_converged: dict[int, int]    # node -> rounds (or -1 if never converged)
+    true_detections: int
+    false_positives: int
+    false_positive_rate: float       # FP events / (alive-observer x subject x round)
+    final_alive: int
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def summarize(
+    carry: MetricsCarry,
+    per_round: RoundMetrics,
+    crash_rounds: dict[int, int] | None = None,
+) -> DetectionReport:
+    """Reduce sim outputs to a DetectionReport.
+
+    ``crash_rounds``: {node: round it was crashed} for scheduled faults whose
+    detection latency should be reported.
+    """
+    first = np.asarray(carry.first_detect)
+    conv = np.asarray(carry.converged)
+    tp = np.asarray(per_round.true_detections)
+    fp = np.asarray(per_round.false_positives)
+    n_alive = np.asarray(per_round.n_alive)
+    rounds = len(tp)
+    n = first.shape[0]
+
+    ttd_first, ttd_conv = {}, {}
+    for node, r0 in (crash_rounds or {}).items():
+        ttd_first[node] = int(first[node] - r0) if first[node] >= 0 else -1
+        ttd_conv[node] = int(conv[node] - r0) if conv[node] >= 0 else -1
+
+    # opportunities ~= sum over rounds of alive * (n - 1) observer-subject pairs
+    opportunities = float(np.sum(n_alive.astype(np.int64)) * max(n - 1, 1))
+    return DetectionReport(
+        n=n,
+        rounds=rounds,
+        ttd_first=ttd_first,
+        ttd_converged=ttd_conv,
+        true_detections=int(tp.sum()),
+        false_positives=int(fp.sum()),
+        false_positive_rate=float(fp.sum()) / opportunities if opportunities else 0.0,
+        final_alive=int(n_alive[-1]),
+    )

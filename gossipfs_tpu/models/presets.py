@@ -1,0 +1,98 @@
+"""Named protocol models: the five BASELINE.json benchmark configurations.
+
+"Models" in this framework are protocol configurations of the gossip
+simulator (the sim *is* the model of the distributed system), the way the
+reference's "model" is its hardcoded constant block (slave/slave.go:21-29).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from gossipfs_tpu.config import SimConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    """A benchmark scenario: protocol config + fault schedule + horizon."""
+
+    name: str
+    config: SimConfig
+    rounds: int
+    crash_rate: float = 0.0
+    rejoin_rate: float = 0.0
+    sdfs_cosim: bool = False
+    n_files: int = 0
+
+
+def reference_parity_10() -> Scenario:
+    """Config 1: 10 nodes, ring fanout 3 — the reference's real deployment
+    shape (use detector/udp.py for actual sockets, this for the sim twin)."""
+    return Scenario(name="parity-10", config=SimConfig(n=10), rounds=120)
+
+
+def sim_1k() -> Scenario:
+    """Config 2: 1k nodes, ring fanout 3, no churn (CPU-feasible)."""
+    return Scenario(name="sim-1k", config=SimConfig(n=1024), rounds=120)
+
+
+def sim_10k_crash() -> Scenario:
+    """Config 3: 10k nodes, 1% crash-stop churn.
+
+    Random log-N fanout with gossip-only dissemination and a real cooldown:
+    at 10k the ring's freshness diameter dwarfs t_fail, so ring mode would be
+    one continuous false-positive storm (see
+    tests/test_rounds.py::test_emergent_false_positives_beyond_reference_scale).
+    """
+    n = 10_000
+    return Scenario(
+        name="sim-10k-crash",
+        config=SimConfig(
+            n=n,
+            topology="random",
+            fanout=SimConfig.log_fanout(n),
+            remove_broadcast=False,
+            fresh_cooldown=True,
+            t_cooldown=12,
+        ),
+        rounds=120,
+        crash_rate=0.01,
+    )
+
+
+def sim_100k() -> Scenario:
+    """Config 4: 100k nodes, fanout log N, 5% churn + preemption (v5e-8)."""
+    n = 100_000
+    return Scenario(
+        name="sim-100k",
+        config=SimConfig(
+            n=n,
+            topology="random",
+            fanout=SimConfig.log_fanout(n),
+            remove_broadcast=False,
+            fresh_cooldown=True,
+            t_cooldown=12,
+        ),
+        rounds=60,
+        crash_rate=0.05,
+        rejoin_rate=0.05,
+    )
+
+
+def sim_100k_sdfs() -> Scenario:
+    """Config 5: config 4 plus SDFS replica re-placement consuming the sim
+    membership view (gossipfs_tpu.cosim)."""
+    sc = sim_100k()
+    return dataclasses.replace(sc, name="sim-100k-sdfs", sdfs_cosim=True, n_files=1000)
+
+
+ALL = {
+    s.name: s
+    for s in (
+        reference_parity_10(),
+        sim_1k(),
+        sim_10k_crash(),
+        sim_100k(),
+        sim_100k_sdfs(),
+    )
+}

@@ -1,0 +1,57 @@
+"""Device-mesh sharding of the simulation state (the sim's "model parallelism").
+
+The scaling axis of this framework is N, the member count (SURVEY §5): the
+reference scales by adding VMs (max ~10, capped by its 1024-byte UDP buffer,
+slave/slave.go:210); we scale to 100k+ by sharding the [N, N] state over a
+``jax.sharding.Mesh``.
+
+Sharding choice — **subject axis (columns)**, ``P(None, AXIS)``:
+
+The per-round merge gathers whole *rows* of the state by sender index
+(``hb[k, :]``).  With column sharding every device holds all rows for a slice
+of subjects, so the row gather needs **no communication at all** — each chip
+merges its slice of every node's table independently.  The only collectives
+XLA inserts are cheap [N]-vector reductions over the subject axis
+(member counts, detection aggregates), which ride ICI.  Row sharding, by
+contrast, would turn the gather into an all-gather of the full matrix.
+
+Everything goes through GSPMD: we annotate inputs with NamedSharding and let
+``jax.jit`` partition the identical round kernel that runs single-chip.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gossipfs_tpu.core.state import SimState
+
+AXIS = "shard"
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """1-D mesh over available devices (v5e-8 -> 8-way column sharding)."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    return Mesh(np.array(devices), (AXIS,))
+
+
+def state_shardings(mesh: Mesh) -> SimState:
+    """NamedShardings matching SimState's pytree structure.
+
+    [N, N] tables shard on the subject (column) axis; the small per-node
+    vectors and the round counter are replicated — they are read on every
+    shard each round and cost O(N) bytes, not O(N^2).
+    """
+    mat = NamedSharding(mesh, P(None, AXIS))
+    rep = NamedSharding(mesh, P())
+    return SimState(hb=mat, age=mat, status=mat, alive=rep, round=rep)
+
+
+def shard_state(state: SimState, mesh: Mesh) -> SimState:
+    """Place an (unsharded) SimState onto the mesh with column sharding."""
+    sh = state_shardings(mesh)
+    return jax.tree.map(jax.device_put, state, sh)

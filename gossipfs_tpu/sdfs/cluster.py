@@ -1,0 +1,172 @@
+"""In-process SDFS cluster: client ops + quorum + read-repair + recovery.
+
+This is the data/control plane of the reference (put/get/delete/ls/store,
+re-replication, election) with its *transport* replaced: where the reference
+moves bytes with sshpass/scp and control with Go net/rpc over TCP
+(reference: slave/slave.go:668-928, T1/T2 in SURVEY §2.3), the TPU build moves
+bytes between LocalStores directly and takes the membership view from the
+failure detector (the sim).  The protocol logic — conflict windows, quorum
+counting, stale-replica self-repair, repair planning, election — is preserved
+verbatim, so BASELINE config 5 (SDFS co-sim over simulated membership) runs
+the same decisions the Go cluster would make.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from gossipfs_tpu.sdfs import election
+from gossipfs_tpu.sdfs.master import SDFSMaster
+from gossipfs_tpu.sdfs.quorum import quorum
+from gossipfs_tpu.sdfs.store import LocalStore
+from gossipfs_tpu.sdfs.types import WRITE_CONFLICT_WINDOW, ReplicatePlan
+
+
+class SDFSCluster:
+    """All nodes' stores plus the master role, driven by a membership view."""
+
+    def __init__(self, n: int, seed: int = 0, introducer: int = 0):
+        self.n = n
+        self.seed = seed
+        self.stores = {i: LocalStore() for i in range(n)}
+        self.master_node = introducer  # initial master = introducer (slave.go:22,99)
+        self.master = SDFSMaster(seed=seed)
+        self.live: list[int] = list(range(n))      # gossip membership VIEW
+        self.reachable: set[int] = set(self.live)  # transport-level reachability
+        self.master.update_member(self.live)
+
+    # -- membership seam ---------------------------------------------------
+    def update_membership(
+        self,
+        view: list[int],
+        reachable: list[int] | None = None,
+        now: int = 0,
+    ) -> None:
+        """Feed the detector's membership *view* in (the slave.go:478 seam).
+
+        ``view`` drives placement and the election trigger — it is gossip
+        data and may lag ground truth (a dead-but-undetected replica stays
+        placeable, exactly like the reference).  ``reachable`` models which
+        processes answer RPC/scp at all (a connection to a dead host fails
+        immediately even before gossip detects it); it defaults to the view.
+        Triggers election when the master is gone from the view
+        (updateMemberList, slave.go:452-457).
+        """
+        self.live = sorted(view)
+        self.reachable = set(reachable) if reachable is not None else set(self.live)
+        self.master.update_member(self.live)
+        if self.master_node not in self.live and self.live:
+            self._elect(now)
+
+    def _elect(self, now: int = 0) -> None:
+        """Fixed-candidate majority vote + metadata rebuild (slave.go:930-1051).
+
+        Every live node votes for the lowest-ordered member; with all votes
+        cast the majority is automatic.  Candidates must actually answer RPC
+        (a dead-but-undetected lowest member can't receive votes).  The new
+        master rebuilds metadata from surviving local registries.
+        """
+        candidates = [x for x in self.live if x in self.reachable]
+        candidate = election.successor(candidates)
+        if candidate is None or not election.tally(set(candidates), len(candidates)):
+            return
+        self.master_node = candidate
+        registries = {
+            i: self.stores[i].listing() for i in self.live if i in self.reachable
+        }
+        # a rebuilt file's true last-write time died with the old master;
+        # treat it as not-recent so the conflict window doesn't spuriously
+        # reject the first post-election put
+        rebuilt = election.rebuild_metadata(
+            registries, now=now - WRITE_CONFLICT_WINDOW
+        )
+        new_master = SDFSMaster(seed=self.seed)
+        new_master.files = rebuilt
+        new_master.update_member(self.live)
+        self.master = new_master
+
+    # -- client ops --------------------------------------------------------
+    def put(
+        self,
+        name: str,
+        data: bytes,
+        now: int,
+        confirm: Callable[[], bool] | None = None,
+    ) -> bool:
+        """Write path with conflict window + quorum (slave.go:668-778).
+
+        On a write-write conflict (another put within 60 rounds) the master
+        asks the requester for confirmation (server.go:74-121); ``confirm``
+        models the interactive yes/no (default: reject, the 30 s-timeout
+        outcome).
+        """
+        if self.master.updated_recently(name, now):
+            if confirm is None or not confirm():
+                return False  # "Write-Write conflicts!" (slave.go:681-686)
+        replicas, version = self.master.handle_put(name, now)
+        if not replicas:
+            return False  # no live members to place on
+        acks = 0
+        for node in replicas:
+            if node in self.reachable:  # scp to a dead host fails, no ack
+                self.stores[node].put(name, data, version)
+                acks += 1
+        return acks >= quorum(len(replicas))
+
+    def get(self, name: str) -> bytes | None:
+        """Read path with quorum of version reports + read-repair
+        (slave.go:780-892)."""
+        replicas, version = self.master.file_info(name)
+        if not replicas or version < 0:
+            return None  # "No File Found" (slave.go:830-834)
+        reports = {
+            node: self.stores[node].version(name)
+            for node in replicas
+            if node in self.reachable
+        }
+        if len(reports) < quorum(len(replicas)):
+            return None  # can't reach a quorum of replicas
+        # stale replicas self-repair by pulling from a fresh one (slave.go:799-813)
+        fresh = [node for node, v in reports.items() if v >= version]
+        if not fresh:
+            return None
+        blob = self.stores[fresh[0]].get(name)
+        for node, v in reports.items():
+            if v < version and blob is not None:
+                self.stores[node].put(name, blob, version)
+        return blob
+
+    def delete(self, name: str) -> bool:
+        """Master drops metadata, replicas drop data (slave.go:1057-1091)."""
+        old = self.master.delete(name)
+        if not old:
+            return False
+        for node in old:
+            self.stores[node].delete(name)
+        return True
+
+    def ls(self, name: str) -> list[int]:
+        """Replica locations of a file (slave.go:894-917)."""
+        replicas, _ = self.master.file_info(name)
+        return replicas
+
+    def store_listing(self, node: int) -> dict[str, int]:
+        """Files stored on one node (slave.go:919-928)."""
+        return self.stores[node].listing()
+
+    # -- failure recovery (slave.go:1093-1175 + master.go:74-127) ----------
+    def fail_recover(self) -> list[ReplicatePlan]:
+        """Re-replicate every under-replicated file from its first healthy
+        replica (Fail_recover + Re_put).  Called RECOVERY_DELAY rounds after a
+        detection in the co-sim driver."""
+        plans = self.master.plan_repairs(self.live)
+        for plan in plans:
+            if plan.source not in self.reachable:
+                continue  # source itself dead-but-undetected: copy fails
+            blob = self.stores[plan.source].get(plan.file)
+            if blob is None:
+                continue
+            for node in plan.new_nodes:
+                if node in self.reachable:
+                    self.stores[node].put(plan.file, blob, plan.version)
+        return plans

@@ -1,0 +1,106 @@
+"""SDFS metadata authority: the master's pure decision logic.
+
+Everything ``master.SDFSMaster`` does (reference: master/master.go:22-259),
+re-cast as a deterministic state machine over a membership snapshot — no
+RPC, no clocks, no goroutines.  The membership snapshot arrives from the
+failure detector exactly through the reference's seam
+(``Update_member``, master.go:46-48 fed from slave.go:478): the placement
+logic does not care whether the view came from 10 UDP processes or from a row
+of the TPU sim tensor.
+"""
+
+from __future__ import annotations
+
+import random
+
+from gossipfs_tpu.sdfs import placement
+from gossipfs_tpu.sdfs.types import (
+    REPLICATION_FACTOR,
+    WRITE_CONFLICT_WINDOW,
+    FileInfo,
+    ReplicatePlan,
+)
+
+
+class SDFSMaster:
+    """File->replica metadata plus placement/repair planning."""
+
+    def __init__(self, seed: int = 0):
+        self.files: dict[str, FileInfo] = {}
+        self.members: list[int] = []
+        self._rng = random.Random(seed)
+
+    # -- membership seam (master.go:46-48) --------------------------------
+    def update_member(self, members: list[int]) -> None:
+        self.members = sorted(members)
+
+    # -- put path (master.go:152-247) -------------------------------------
+    def updated_recently(self, name: str, now: int) -> bool:
+        """Write-write conflict: a put within the last 60 rounds
+        (If_file_updated_recent, master.go:214-229)."""
+        info = self.files.get(name)
+        return info is not None and now - info.timestamp < WRITE_CONFLICT_WINDOW
+
+    def handle_put(self, name: str, now: int) -> tuple[list[int], int]:
+        """Allocate replicas (first put) and bump the version.
+
+        Mirrors Update_timestamp + Init_replica + Handle_put_request
+        (master.go:129-175): placement happens once per file lifetime; later
+        puts reuse the node list and only bump version/timestamp.
+        """
+        info = self.files.get(name)
+        if info is None:
+            nodes = placement.place(self.members, self._rng)
+            info = FileInfo(node_list=nodes, version=0, timestamp=now)
+            self.files[name] = info
+        info.version += 1
+        info.timestamp = now
+        return list(info.node_list), info.version
+
+    # -- read path (master.go:177-212) ------------------------------------
+    def file_info(self, name: str) -> tuple[list[int], int]:
+        """Replica list + version; ([], -1) when absent (Get_file_info)."""
+        info = self.files.get(name)
+        if info is None:
+            return [], -1
+        return list(info.node_list), info.version
+
+    # -- delete (master.go:249-259) ---------------------------------------
+    def delete(self, name: str) -> list[int]:
+        """Drop metadata, return the old replica set for data deletion."""
+        info = self.files.pop(name, None)
+        return list(info.node_list) if info else []
+
+    # -- repair planning (Update_metadata, master.go:74-127) ---------------
+    def plan_repairs(self, live: list[int]) -> list[ReplicatePlan]:
+        """Diff every file's replica set against the live membership.
+
+        For each file with fewer than 4 live replicas: re-place over live
+        members, keep surviving replicas, and order copies from the first
+        healthy source to each newcomer.  (The reference re-creates its plan
+        map inside the per-file loop, so only the last deficient file ever
+        got repaired — master.go:118.  Fixed here: all deficient files are
+        planned; divergence documented and covered by a test.)
+        """
+        live_set = set(live)
+        self.members = sorted(live_set)
+        plans: list[ReplicatePlan] = []
+        for name, info in self.files.items():
+            working = [x for x in info.node_list if x in live_set]
+            if len(working) >= min(REPLICATION_FACTOR, len(live_set)) or not working:
+                # fully replicated — or every replica lost (file unrecoverable)
+                continue
+            need = REPLICATION_FACTOR - len(working)
+            candidates = [x for x in self.members if x not in set(working)]
+            new_nodes = placement.place(candidates, self._rng, k=need)
+            info.node_list = working + new_nodes
+            if new_nodes:
+                plans.append(
+                    ReplicatePlan(
+                        file=name,
+                        source=working[0],
+                        version=info.version,
+                        new_nodes=tuple(new_nodes),
+                    )
+                )
+        return plans

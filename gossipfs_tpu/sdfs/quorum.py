@@ -1,0 +1,15 @@
+"""Quorum arithmetic for replicated reads/writes.
+
+Reference: ``cal_quorum_num`` computes ``Ceil((len+1)/2)`` with *integer*
+division, so the Ceil is a no-op and the quorum is ``floor((n+1)/2)`` — 2 of 4
+replicas (slave/slave.go:717-722; the report claims "ACK by 3 replicas" but the
+code disagrees, BASELINE.md).  We reproduce the code's behavior, which is the
+actually-deployed semantics.
+"""
+
+from __future__ import annotations
+
+
+def quorum(n_replicas: int) -> int:
+    """Acks required before a put/get completes: floor((n+1)/2)."""
+    return (n_replicas + 1) // 2

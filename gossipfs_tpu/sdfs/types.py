@@ -1,0 +1,35 @@
+"""SDFS metadata types.
+
+Reference equivalents: ``master.File_info{Node_list, Version, Timestamp}``
+(reference: master/master.go:22-31) and the per-node filename->version registry
+``sdfs_slave.SDFSSLAVE`` (sdfs_slave/sdfs_slave.go:10-18).  Time is measured in
+gossip rounds (1 round == 1 s), like everything in the TPU build.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+REPLICATION_FACTOR = 4        # 4 replicas, tolerates 3 failures (master.go:104,131)
+WRITE_CONFLICT_WINDOW = 60    # write-write conflict window, rounds (master.go:225)
+CONFIRM_TIMEOUT = 30          # conflict-confirmation timeout, rounds (server.go:172)
+RECOVERY_DELAY = 8            # heartbeats to wait before re-replication (slave.go:1123)
+
+
+@dataclasses.dataclass
+class FileInfo:
+    """Metadata the master keeps per SDFS file (master/master.go:22-31)."""
+
+    node_list: list[int]      # replica node ids
+    version: int
+    timestamp: int            # round of last successful put
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicatePlan:
+    """One file's re-replication order (master.Replicate_info, master.go:27-31)."""
+
+    file: str
+    source: int               # first healthy replica to copy from
+    version: int
+    new_nodes: tuple[int, ...]  # nodes that must receive a copy

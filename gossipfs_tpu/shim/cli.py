@@ -1,0 +1,105 @@
+"""Interactive REPL — the reference CLI, backed by the TPU sim.
+
+Command surface matches README.md:8-29 plus fault/time controls the sim adds:
+
+  join <n> / leave <n> / crash <n>   membership verbs (+ CTRL+C equivalent)
+  lsm <n>                            print node n's membership list
+  IP                                 print node ids (the sim's "addresses")
+  put <local> <sdfs>                 write a file into SDFS (quorum write)
+  get <sdfs> <local>                 read it back (quorum read + repair)
+  delete <sdfs> / ls <sdfs> / store <n>
+  show_metadata                      master's file->replica map
+  advance <r>                        advance simulated time by r rounds
+  events                             detection events so far
+  grep <regex>                       search the event log (MP1 legacy verb)
+
+Run: ``python -m gossipfs_tpu.shim.cli [--n 16] [--topology ring]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import re
+import sys
+
+from gossipfs_tpu.config import SimConfig
+from gossipfs_tpu.cosim import CoSim
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="gossipfs", description=__doc__)
+    p.add_argument("--n", type=int, default=16)
+    p.add_argument("--topology", choices=["ring", "random"], default="ring")
+    p.add_argument("--fanout", type=int, default=3)
+    p.add_argument("--seed", type=int, default=0)
+    return p
+
+
+def dispatch(sim: CoSim, line: str, out=sys.stdout) -> bool:
+    """Execute one REPL command; returns False on quit."""
+    parts = line.strip().split()
+    if not parts:
+        return True
+    cmd, args = parts[0], parts[1:]
+    try:
+        if cmd in ("quit", "exit"):
+            return False
+        elif cmd == "join":
+            sim.detector.join(int(args[0]))
+        elif cmd == "leave":
+            sim.detector.leave(int(args[0]))
+        elif cmd == "crash":
+            sim.detector.crash(int(args[0]))
+        elif cmd == "lsm":
+            print(sim.detector.membership(int(args[0])), file=out)
+        elif cmd == "IP":
+            print(sim.detector.alive_nodes(), file=out)
+        elif cmd == "advance":
+            sim.tick(int(args[0]) if args else 1)
+            print(f"round={sim.round}", file=out)
+        elif cmd == "put":
+            data = pathlib.Path(args[0]).read_bytes()
+            ok = sim.put(args[1], data)
+            print("ok" if ok else "Write-Write conflicts!", file=out)
+        elif cmd == "get":
+            blob = sim.get(args[0])
+            if blob is None:
+                print("No File Found", file=out)
+            else:
+                pathlib.Path(args[1]).write_bytes(blob)
+                print(f"wrote {len(blob)} bytes", file=out)
+        elif cmd == "delete":
+            print("ok" if sim.delete(args[0]) else "No File Found", file=out)
+        elif cmd == "ls":
+            print(sim.cluster.ls(args[0]), file=out)
+        elif cmd == "store":
+            print(sim.cluster.store_listing(int(args[0])), file=out)
+        elif cmd == "show_metadata":
+            for name, info in sim.cluster.master.files.items():
+                print(f"{name}: v{info.version} @ {info.node_list}", file=out)
+        elif cmd == "events":
+            for ev in sim.events:
+                print(ev, file=out)
+        elif cmd == "grep":
+            for entry in sim.log.grep(" ".join(args)):
+                print(entry, file=out)
+        else:
+            print(f"unknown command: {cmd}", file=out)
+    except (IndexError, ValueError, FileNotFoundError, re.error) as e:
+        print(f"error: {e}", file=out)
+    return True
+
+
+def main(argv=None) -> None:
+    args = make_parser().parse_args(argv)
+    cfg = SimConfig(n=args.n, topology=args.topology, fanout=args.fanout)
+    sim = CoSim(cfg, seed=args.seed)
+    print(f"gossipfs sim: {args.n} nodes, {args.topology} topology. 'quit' to exit.")
+    for line in sys.stdin:
+        if not dispatch(sim, line):
+            break
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""Structured event log + grep — the observability layer.
+
+Reference: every significant event appends a text line to ``Machine.log``
+(reopening the file per call — logger/logger.go:28-44), and the distributed
+grep RPC searches it (``TCPServer.Response``, server/server.go:55-72; the
+report's stated test methodology).  Here events are structured (kind + round +
+attributes) with a text rendering, the file handle stays open, and grep is a
+method.  The sim emits the same event kinds the Go cluster logs, so log-grep
+assertions port over.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import re
+
+
+class EventLog:
+    """Append-only structured log, in-memory with optional file mirroring."""
+
+    def __init__(self, path: str | pathlib.Path | None = None):
+        self.entries: list[dict] = []
+        self._fh = open(path, "a", encoding="utf-8") if path is not None else None
+
+    def write(self, message: str, **fields) -> None:
+        entry = {"message": message, **fields}
+        self.entries.append(entry)
+        if self._fh is not None:
+            self._fh.write(json.dumps(entry) + "\n")
+            self._fh.flush()
+
+    def grep(self, pattern: str) -> list[dict]:
+        """Regex search over rendered messages (the MP1 remote-grep verb)."""
+        rx = re.compile(pattern)
+        return [e for e in self.entries if rx.search(e["message"])]
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None

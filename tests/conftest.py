@@ -1,0 +1,28 @@
+"""Test harness: force CPU JAX with an 8-device virtual mesh.
+
+Real benches run on TPU; tests exercise the identical sharded code paths on a
+virtual 8-device CPU mesh (the sim's stand-in for a v5e-8), so multi-chip
+sharding is validated without multi-chip hardware.
+
+This image's sitecustomize registers the 'axon' TPU-tunnel PJRT plugin in
+every interpreter and pins jax to it, so setting JAX_PLATFORMS=cpu here is too
+late — we additionally deregister the axon backend factory before any backend
+is initialized.  Otherwise the first jax.devices() call dials the (single,
+possibly busy) TPU chip from every test process.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+_xb._backend_factories.pop("axon", None)
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)

@@ -1,0 +1,172 @@
+"""Naive per-node Python model of the gossip protocol — the golden oracle.
+
+Deliberately written object-style (one dict per node, explicit message loops),
+mirroring how the reference Go code manipulates per-node ``[]Member`` slices
+(reference: slave/slave.go:414-544), so that it shares *no code shape* with the
+vectorized kernel.  Tests compare the tensor sim against this model
+entry-for-entry every round on small N ("golden-trace equivalence", SURVEY §4).
+
+Synchronous-rounds semantics identical to gossipfs_tpu.core.rounds:
+events -> tick (refresh/bump/detect/remove-broadcast/cooldown) -> merge -> age+1.
+Only rows of *alive* nodes are meaningful (dead processes don't run).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+UNKNOWN, MEMBER, FAILED = 0, 1, 2
+
+
+@dataclasses.dataclass
+class Entry:
+    hb: int = 0
+    age: int = 0
+    status: int = UNKNOWN
+
+
+class NaiveSim:
+    def __init__(self, config, member_mask=None):
+        self.cfg = config
+        n = config.n
+        members = list(range(n)) if member_mask is None else [
+            j for j in range(n) if member_mask[j]
+        ]
+        self.alive = [j in set(members) for j in range(n)]
+        self.tables = []
+        for i in range(n):
+            row = [Entry() for _ in range(n)]
+            if self.alive[i]:
+                for j in members:
+                    row[j] = Entry(hb=0, age=0, status=MEMBER)
+            self.tables.append(row)
+        self.round = 0
+        self.fail_events = []  # list of (round, observer, subject)
+
+    # -- helpers -----------------------------------------------------------
+    def _member_count(self, i):
+        return sum(1 for e in self.tables[i] if e.status == MEMBER)
+
+    def _ring_in_edges(self, i):
+        """Receiver-side ring inversion over i's own table, cyclic id order."""
+        n = self.cfg.n
+        members = [
+            j for j in range(n) if j != i and self.tables[i][j].status == MEMBER
+        ]
+        if not members:
+            return [i, i, i]
+        next1 = min(members, key=lambda j: (j - i) % n)
+        prev1 = min(members, key=lambda j: (i - j) % n)
+        rest = [j for j in members if j != prev1]
+        prev2 = min(rest, key=lambda j: (i - j) % n) if rest else i
+        return [next1, prev1, prev2]
+
+    # -- one synchronous round --------------------------------------------
+    def step(self, edges=None, crash=(), leave=(), join=()):
+        cfg, n = self.cfg, self.cfg.n
+
+        # events: leave broadcast, crash, join via introducer
+        for j in leave:
+            if not self.alive[j]:
+                continue
+            for i in range(n):
+                if self.alive[i] and self.tables[i][j].status == MEMBER:
+                    # faithful mode: fail-list entry keeps its stale timestamp
+                    self.tables[i][j].status = FAILED
+                    if self.cfg.fresh_cooldown:
+                        self.tables[i][j].age = 0
+            self.alive[j] = False
+        for j in crash:
+            self.alive[j] = False
+        joiners = [j for j in join if not self.alive[j] and self.alive[cfg.introducer]]
+        for j in joiners:  # introducer appends unconditionally
+            if j != cfg.introducer:
+                self.tables[cfg.introducer][j] = Entry(0, 0, MEMBER)
+        for j in joiners:  # push to every previously-alive member: add if unknown
+            for i in range(n):
+                if self.alive[i] and self.tables[i][j].status == UNKNOWN:
+                    self.tables[i][j] = Entry(0, 0, MEMBER)
+        for j in joiners:  # joiner adopts the introducer's pushed list
+            row = []
+            for k in range(n):
+                e = self.tables[cfg.introducer][k]
+                row.append(
+                    Entry(e.hb, 0, MEMBER) if e.status == MEMBER else Entry()
+                )
+            row[j] = Entry(0, 0, MEMBER)
+            self.tables[j] = row
+            self.alive[j] = True
+
+        # tick
+        active = [False] * n
+        fails = []
+        for i in range(n):
+            if not self.alive[i]:
+                continue
+            if self._member_count(i) < cfg.min_group:
+                for e in self.tables[i]:
+                    if e.status == MEMBER:
+                        e.age = 0
+                continue
+            active[i] = True
+            me = self.tables[i][i]
+            if me.status == MEMBER:  # no self entry -> no bump (slave.go:443-448)
+                me.hb += 1
+                me.age = 0
+            for j in range(n):
+                e = self.tables[i][j]
+                if (
+                    j != i
+                    and e.status == MEMBER
+                    and e.hb > cfg.hb_grace
+                    and e.age > cfg.t_fail
+                ):
+                    e.status = FAILED
+                    if cfg.fresh_cooldown:
+                        e.age = 0
+                    fails.append((i, j))
+        self.fail_events.extend((self.round, i, j) for i, j in fails)
+        if cfg.remove_broadcast:
+            removed = {j for _, j in fails}
+            for j in removed:
+                for i in range(n):
+                    if self.alive[i] and self.tables[i][j].status == MEMBER:
+                        self.tables[i][j].status = FAILED
+                        if cfg.fresh_cooldown:
+                            self.tables[i][j].age = 0
+        for i in range(n):
+            if not self.alive[i]:
+                continue
+            for e in self.tables[i]:
+                if e.status == FAILED and e.age > cfg.t_cooldown:
+                    e.status = UNKNOWN
+
+        # merge: receivers gather active senders' tables, elementwise max
+        snapshot = [[dataclasses.replace(e) for e in row] for row in self.tables]
+        for i in range(n):
+            if not self.alive[i]:
+                continue
+            row_edges = (
+                self._ring_in_edges(i)
+                if self.cfg.topology == "ring"
+                else [int(e) for e in edges[i]]
+            )
+            for k in row_edges:
+                if not active[k]:
+                    continue
+                for j in range(n):
+                    se = snapshot[k][j]
+                    if se.status != MEMBER:
+                        continue
+                    e = self.tables[i][j]
+                    if e.status == MEMBER and se.hb > e.hb:
+                        e.hb = se.hb
+                        e.age = 0
+                    elif e.status == UNKNOWN:
+                        self.tables[i][j] = Entry(se.hb, 0, MEMBER)
+
+        for i in range(n):
+            if self.alive[i]:
+                for e in self.tables[i]:
+                    e.age += 1
+        self.round += 1

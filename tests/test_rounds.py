@@ -1,0 +1,204 @@
+"""Behavioral tests of the round kernel against protocol semantics.
+
+Constants under test come straight from the reference (BASELINE.md):
+1 round = 1 s heartbeat, t_fail=5, t_cooldown=5, min_group=4, ring fanout 3.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gossipfs_tpu.config import SimConfig
+from gossipfs_tpu.core.rounds import gossip_round, run_rounds
+from gossipfs_tpu.core.state import FAILED, MEMBER, UNKNOWN, RoundEvents, SimState, init_state
+
+
+def schedule(num_rounds, n, crash=(), leave=(), join=()):
+    """Build stacked RoundEvents from {round: [nodes]} dicts."""
+    def mask(spec):
+        m = np.zeros((num_rounds, n), dtype=bool)
+        for r, nodes in dict(spec).items():
+            m[r, list(nodes)] = True
+        return jnp.asarray(m)
+
+    return RoundEvents(crash=mask(crash), leave=mask(leave), join=mask(join))
+
+
+KEY = jax.random.PRNGKey(0)
+
+
+class TestSteadyState:
+    def test_no_false_positives_and_full_membership(self):
+        cfg = SimConfig(n=16)
+        state = init_state(cfg)
+        state, mc, per_round = run_rounds(state, cfg, 30, KEY)
+        assert int(per_round.false_positives.sum()) == 0
+        assert int(per_round.true_detections.sum()) == 0
+        assert bool(jnp.all(state.status == MEMBER))
+        # own heartbeat bumps once per round (slave.go:443-448)
+        assert bool(jnp.all(jnp.diag(state.hb) == 30))
+        # everyone converged: no detect/converge events fired
+        assert bool(jnp.all(mc.first_detect == -1))
+
+    def test_heartbeats_propagate_on_ring(self):
+        cfg = SimConfig(n=16)
+        state = init_state(cfg)
+        state, _, _ = run_rounds(state, cfg, 30, KEY)
+        # every view is at most (ring diameter) behind the subject's own count
+        lag = jnp.diag(state.hb)[None, :] - state.hb
+        assert bool(jnp.all(lag >= 0))
+        assert int(lag.max()) <= cfg.n  # loose bound; ring diameter ~ n/3
+
+
+class TestCrashDetection:
+    def test_detection_time_matches_protocol(self):
+        # n=10 == the reference's actual deployment scale (10 VMs)
+        cfg = SimConfig(n=10)
+        crash_round, victim = 10, 5
+        state = init_state(cfg)
+        ev = schedule(30, cfg.n, crash={crash_round: [victim]})
+        state, mc, per_round = run_rounds(state, cfg, 30, KEY, events=ev)
+        # victim's last bump+push was round crash_round-1; neighbours' entries
+        # stop refreshing, so age exceeds t_fail exactly t_fail+1 rounds later
+        first = int(mc.first_detect[victim])
+        assert first == crash_round - 1 + cfg.t_fail + 1
+        # REMOVE broadcast clears the victim everywhere the same round
+        assert int(mc.converged[victim]) == first
+        assert int(per_round.false_positives.sum()) == 0
+        # detector-removed fail-list entries carry an already-stale timestamp,
+        # so they expire to UNKNOWN immediately (slave.go:276-286, 484-497),
+        # and the REMOVE broadcast left nobody to gossip the victim back
+        col = state.status[:, victim]
+        others = jnp.arange(cfg.n) != victim
+        assert bool(jnp.all(col[others & np.array(state.alive)] == UNKNOWN))
+
+    def test_emergent_false_positives_beyond_reference_scale(self):
+        # At n=16 the ring's freshness diameter exceeds t_fail: when a relay
+        # node dies, some live node's entries go stale before updates arrive
+        # the long way round, and the protocol false-positively removes it.
+        # The reference never saw this (it ran <= 10 VMs, diameter < 5) —
+        # measuring exactly this FPR-vs-N behavior is what the simulator is
+        # for (BASELINE.md curves).
+        cfg = SimConfig(n=16)
+        state = init_state(cfg)
+        ev = schedule(30, cfg.n, crash={10: [5]})
+        state, mc, per_round = run_rounds(state, cfg, 30, KEY, events=ev)
+        assert int(per_round.false_positives.sum()) > 0
+
+    def test_no_broadcast_converges_with_fresh_cooldown(self):
+        # gossip-only dissemination needs a real suppression window that
+        # outlasts the detection spread, else zombies cycle (see next test)
+        cfg = SimConfig(
+            n=16, remove_broadcast=False, fresh_cooldown=True, t_cooldown=10
+        )
+        state = init_state(cfg)
+        ev = schedule(40, cfg.n, crash={10: [5]})
+        state, mc, _ = run_rounds(state, cfg, 40, KEY, events=ev)
+        assert int(mc.first_detect[5]) >= 15
+        assert int(mc.converged[5]) != -1
+        # without broadcast, observers detect independently as their own
+        # entries age out — convergence is later or equal, never earlier
+        assert int(mc.converged[5]) >= int(mc.first_detect[5])
+
+    def test_stale_cooldown_zombies_cycle_without_broadcast(self):
+        # Emergent protocol bug surfaced by the sim: the reference's fail-list
+        # entries keep their stale timestamps (slave.go:276-286), so detector
+        # removals expire instantly; without the REMOVE broadcast masking it,
+        # laggard gossip re-adds the dead member and detection cycles forever.
+        cfg = SimConfig(n=16, remove_broadcast=False)  # faithful cooldown
+        state = init_state(cfg)
+        ev = schedule(60, cfg.n, crash={10: [5]})
+        state, mc, per_round = run_rounds(state, cfg, 60, KEY, events=ev)
+        assert int(mc.converged[5]) == -1
+        # the same dead node keeps getting re-detected, round after round
+        assert int(per_round.true_detections.sum()) > cfg.n
+
+    def test_hb_grace_never_detects_silent_newborn(self):
+        # reference quirk kept: entries with hb <= 1 are exempt from detection
+        # (slave/slave.go:468-469) — a node that crashes before its counter
+        # passes 1 is never detected.
+        cfg = SimConfig(n=16)
+        state = init_state(cfg)
+        ev = schedule(30, cfg.n, crash={0: [5]})  # dies before any bump
+        state, mc, _ = run_rounds(state, cfg, 30, KEY, events=ev)
+        assert int(mc.first_detect[5]) == -1
+        assert bool(jnp.all(state.status[:, 5][np.array(state.alive)] == MEMBER))
+
+
+class TestSmallGroup:
+    def test_below_min_group_never_detects(self):
+        # groups smaller than 4 only refresh timestamps (slave.go:504-509)
+        cfg = SimConfig(n=8)
+        mask = jnp.arange(8) < 3
+        state = init_state(cfg, member_mask=mask)
+        ev = schedule(30, cfg.n, crash={5: [2]})
+        state, mc, per_round = run_rounds(state, cfg, 30, KEY, events=ev)
+        assert int(mc.first_detect[2]) == -1
+        assert int(per_round.true_detections.sum()) == 0
+        # survivors still list the dead node as MEMBER forever
+        assert int(state.status[0, 2]) == MEMBER
+
+    def test_exactly_min_group_detects(self):
+        cfg = SimConfig(n=8)
+        mask = jnp.arange(8) < 4
+        state = init_state(cfg, member_mask=mask)
+        ev = schedule(40, cfg.n, crash={10: [2]})
+        state, mc, _ = run_rounds(state, cfg, 40, KEY, events=ev)
+        assert int(mc.first_detect[2]) != -1
+
+
+class TestLeaveJoin:
+    def test_leave_removes_immediately_without_detection(self):
+        cfg = SimConfig(n=16)
+        state = init_state(cfg)
+        ev = schedule(20, cfg.n, leave={10: [7]})
+        state, mc, per_round = run_rounds(state, cfg, 20, KEY, events=ev)
+        # LEAVE broadcast removes at the leave round; the detector never fires
+        assert int(mc.first_detect[7]) == -1
+        assert int(mc.converged[7]) == 10
+        assert int(per_round.true_detections.sum()) == 0
+        assert not bool(state.alive[7])
+
+    def test_join_spreads_to_everyone(self):
+        cfg = SimConfig(n=16)
+        mask = jnp.arange(16) < 12
+        state = init_state(cfg, member_mask=mask)
+        ev = schedule(20, cfg.n, join={5: [13]})
+        state, _, _ = run_rounds(state, cfg, 20, KEY, events=ev)
+        assert bool(state.alive[13])
+        alive = np.array(state.alive)
+        assert bool(jnp.all(state.status[alive, 13] == MEMBER))
+        # the joiner learned the whole cohort from the introducer's push
+        assert int(jnp.sum(state.status[13] == MEMBER)) == 13
+
+    def test_join_fails_when_introducer_down(self):
+        # the hardcoded introducer is a SPOF in the reference (slave.go:22);
+        # semantics kept: a join while it is down is lost
+        cfg = SimConfig(n=16)
+        mask = jnp.arange(16) < 12
+        state = init_state(cfg, member_mask=mask)
+        ev = schedule(20, cfg.n, crash={3: [0]}, join={5: [13]})
+        state, _, _ = run_rounds(state, cfg, 20, KEY, events=ev)
+        assert not bool(state.alive[13])
+
+
+class TestRandomTopology:
+    def test_random_fanout_detects_and_converges(self):
+        cfg = SimConfig(n=64, topology="random", fanout=SimConfig.log_fanout(64))
+        state = init_state(cfg)
+        ev = schedule(40, cfg.n, crash={10: [17]})
+        state, mc, per_round = run_rounds(state, cfg, 40, KEY, events=ev)
+        assert int(mc.first_detect[17]) != -1
+        assert int(mc.converged[17]) != -1
+        assert int(per_round.false_positives.sum()) == 0
+
+    def test_churn_run_is_stable(self):
+        cfg = SimConfig(n=64, topology="random", fanout=6, remove_broadcast=True)
+        state = init_state(cfg)
+        state, mc, per_round = run_rounds(
+            state, cfg, 60, KEY, crash_rate=0.01, rejoin_rate=0.05
+        )
+        assert int(per_round.n_alive[-1]) > 0
+        # crashes are being noticed
+        assert int(per_round.true_detections.sum()) > 0

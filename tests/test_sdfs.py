@@ -1,0 +1,204 @@
+"""SDFS control-plane logic: placement, quorum, master, election, cluster ops.
+
+Behavioral parity targets cited per test; two documented divergences from the
+reference are bug *fixes* (placement can reach the last member; repair plans
+cover every deficient file), each covered explicitly.
+"""
+
+import random
+
+import pytest
+
+from gossipfs_tpu.sdfs import election, placement
+from gossipfs_tpu.sdfs.cluster import SDFSCluster
+from gossipfs_tpu.sdfs.master import SDFSMaster
+from gossipfs_tpu.sdfs.quorum import quorum
+from gossipfs_tpu.sdfs.store import LocalStore
+from gossipfs_tpu.sdfs.types import WRITE_CONFLICT_WINDOW
+
+
+class TestPlacement:
+    def test_four_distinct_replicas(self):
+        rng = random.Random(0)
+        nodes = placement.place(list(range(10)), rng)
+        assert len(nodes) == 4 and len(set(nodes)) == 4
+
+    def test_small_cluster_gets_everyone(self):
+        rng = random.Random(0)
+        assert sorted(placement.place([3, 7], rng)) == [3, 7]
+
+    def test_last_member_is_reachable(self):
+        # the reference's Intn(len-1) can never pick the last snapshot member
+        # (master/master.go:129-150, latent bug); we place uniformly
+        rng = random.Random(0)
+        hit_last = any(
+            9 in placement.place(list(range(10)), rng) for _ in range(200)
+        )
+        assert hit_last
+
+
+class TestQuorum:
+    def test_reference_integer_division(self):
+        # floor((n+1)/2): 2-of-4 in the deployed code (slave.go:717-722),
+        # not the report's claimed 3-of-4
+        assert quorum(4) == 2
+        assert quorum(3) == 2
+        assert quorum(5) == 3
+        assert quorum(1) == 1
+
+
+class TestMaster:
+    def test_put_allocates_once_and_bumps_version(self):
+        m = SDFSMaster()
+        m.update_member(list(range(8)))
+        nodes1, v1 = m.handle_put("a.txt", now=0)
+        nodes2, v2 = m.handle_put("a.txt", now=100)
+        assert v1 == 1 and v2 == 2
+        assert nodes1 == nodes2  # placement happens once per file lifetime
+
+    def test_write_conflict_window(self):
+        # 60-round write-write window (master.go:214-229)
+        m = SDFSMaster()
+        m.update_member(list(range(8)))
+        m.handle_put("a.txt", now=10)
+        assert m.updated_recently("a.txt", now=10 + WRITE_CONFLICT_WINDOW - 1)
+        assert not m.updated_recently("a.txt", now=10 + WRITE_CONFLICT_WINDOW)
+
+    def test_file_info_and_delete(self):
+        m = SDFSMaster()
+        m.update_member(list(range(8)))
+        assert m.file_info("nope") == ([], -1)  # Get_file_info absent case
+        nodes, _ = m.handle_put("a.txt", now=0)
+        assert m.file_info("a.txt") == (nodes, 1)
+        assert sorted(m.delete("a.txt")) == sorted(nodes)
+        assert m.file_info("a.txt") == ([], -1)
+
+    def test_repair_plans_every_deficient_file(self):
+        # the reference resets its plan map inside the per-file loop so only
+        # the last deficient file survives (master.go:118); fixed here
+        m = SDFSMaster(seed=1)
+        m.update_member(list(range(10)))
+        for name in ("a", "b", "c"):
+            m.handle_put(name, now=0)
+        # kill two nodes that appear in replica sets
+        victims = set(m.files["a"].node_list[:1]) | set(m.files["b"].node_list[:1])
+        live = [x for x in range(10) if x not in victims]
+        plans = m.plan_repairs(live)
+        deficient = [n for n in ("a", "b", "c") if victims & set(m.files[n].node_list)]
+        assert not deficient  # all node lists now live-only
+        for plan in plans:
+            info = m.files[plan.file]
+            assert len(info.node_list) == 4
+            assert set(info.node_list) <= set(live)
+            assert plan.source in live
+
+    def test_unrecoverable_file_left_alone(self):
+        m = SDFSMaster(seed=1)
+        m.update_member(list(range(5)))
+        m.handle_put("a", now=0)
+        dead = set(m.files["a"].node_list)
+        live = [x for x in range(5) if x not in dead]
+        plans = m.plan_repairs(live)
+        assert plans == []  # every replica lost -> nothing to copy from
+
+
+class TestElection:
+    def test_successor_is_lowest_member(self):
+        # fixed-candidate majority voting, lowest member wins (slave.go:930-984)
+        assert election.successor([5, 2, 9]) == 2
+        assert election.successor([]) is None
+
+    def test_majority_tally(self):
+        assert election.tally({1, 2, 3}, 5)
+        assert not election.tally({1, 2}, 5)
+
+    def test_rebuild_keeps_top4_by_version(self):
+        # rebuild_file_meta: holders sorted by version, top 4 kept, version =
+        # max seen (slave.go:986-1043)
+        registries = {
+            1: {"f": 3},
+            2: {"f": 5},
+            3: {"f": 5},
+            4: {"f": 4},
+            5: {"f": 1},
+            6: {"g": 2},
+        }
+        meta = election.rebuild_metadata(registries, now=7)
+        assert meta["f"].version == 5
+        assert len(meta["f"].node_list) == 4
+        assert 5 not in meta["f"].node_list  # lowest version loses the cut
+        assert meta["g"].node_list == [6]
+
+
+class TestLocalStore:
+    def test_roundtrip_and_versions(self, tmp_path):
+        s = LocalStore(root=tmp_path)
+        s.put("f.txt", b"hello", version=2)
+        assert s.get("f.txt") == b"hello"
+        assert s.version("f.txt") == 2
+        assert s.version("missing") == -1
+        assert s.listing() == {"f.txt": 2}
+        assert s.delete("f.txt") and not s.delete("f.txt")
+        assert s.get("f.txt") is None
+
+
+class TestCluster:
+    def test_put_get_delete_roundtrip(self):
+        c = SDFSCluster(n=8, seed=0)
+        assert c.put("a.txt", b"data", now=0)
+        assert c.get("a.txt") == b"data"
+        assert len(c.ls("a.txt")) == 4
+        assert c.delete("a.txt")
+        assert c.get("a.txt") is None
+
+    def test_write_conflict_requires_confirmation(self):
+        c = SDFSCluster(n=8, seed=0)
+        assert c.put("a.txt", b"v1", now=0)
+        # conflicting put inside the 60-round window: default = rejected
+        assert not c.put("a.txt", b"v2", now=30)
+        # explicit confirmation overrides (Ask_for_confirmation, server.go:155-177)
+        assert c.put("a.txt", b"v2", now=30, confirm=lambda: True)
+        assert c.get("a.txt") == b"v2"
+
+    def test_quorum_survives_replica_deaths(self):
+        c = SDFSCluster(n=8, seed=0)
+        assert c.put("a.txt", b"data", now=0)
+        replicas = c.ls("a.txt")
+        c.update_membership([x for x in range(8) if x not in replicas[:2]])
+        # 2 of 4 replicas dead == exactly quorum alive -> reads still work
+        assert c.get("a.txt") == b"data"
+
+    def test_fail_recover_restores_replication(self):
+        c = SDFSCluster(n=10, seed=0)
+        assert c.put("a.txt", b"data", now=0)
+        victim = c.ls("a.txt")[0]
+        live = [x for x in range(10) if x != victim]
+        c.update_membership(live)
+        plans = c.fail_recover()
+        assert len(plans) == 1
+        new_replicas = c.ls("a.txt")
+        assert len(new_replicas) == 4 and victim not in new_replicas
+        for node in new_replicas:
+            assert c.stores[node].get("a.txt") == b"data"
+
+    def test_master_death_triggers_election_and_rebuild(self):
+        c = SDFSCluster(n=8, seed=0)
+        assert c.put("a.txt", b"data", now=0)
+        old_master = c.master_node
+        live = [x for x in range(8) if x != old_master]
+        c.update_membership(live)
+        assert c.master_node == min(live)  # lowest member wins
+        # metadata survived via rebuild from local registries
+        assert c.get("a.txt") == b"data"
+        assert len(c.ls("a.txt")) >= 1
+
+    def test_read_repair_updates_stale_replica(self):
+        c = SDFSCluster(n=8, seed=0)
+        assert c.put("a.txt", b"v1", now=0)
+        assert c.put("a.txt", b"v2", now=100)
+        stale = c.ls("a.txt")[0]
+        c.stores[stale].put("a.txt", b"v1", version=1)  # simulate missed write
+        assert c.get("a.txt") == b"v2"
+        # the stale replica self-repaired (slave.go:799-813)
+        assert c.stores[stale].get("a.txt") == b"v2"
+        assert c.stores[stale].version("a.txt") == 2

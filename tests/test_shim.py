@@ -1,0 +1,75 @@
+"""CLI REPL + event log: the reference's user surface (README.md:8-30)."""
+
+import io
+
+from gossipfs_tpu.config import SimConfig
+from gossipfs_tpu.cosim import CoSim
+from gossipfs_tpu.shim.cli import dispatch
+from gossipfs_tpu.utils.eventlog import EventLog
+
+
+def run(sim, *lines):
+    out = io.StringIO()
+    for line in lines:
+        assert dispatch(sim, line, out=out)
+    return out.getvalue()
+
+
+class TestCli:
+    def test_membership_verbs(self):
+        sim = CoSim(SimConfig(n=8))
+        out = run(sim, "advance 2", "lsm 0", "IP")
+        assert "round=2" in out
+        assert "[0, 1, 2, 3, 4, 5, 6, 7]" in out
+
+    def test_crash_then_lsm_shrinks(self):
+        sim = CoSim(SimConfig(n=8))
+        run(sim, "advance 2", "crash 5", "advance 10")
+        out = run(sim, "lsm 0", "IP", "events", "grep Failure")
+        assert "5" not in out.splitlines()[0].replace("15", "")
+        assert "Failure Detected" in out or "failure" in out.lower()
+
+    def test_put_get_roundtrip_via_files(self, tmp_path):
+        src = tmp_path / "local.txt"
+        src.write_bytes(b"cli payload")
+        dst = tmp_path / "out.txt"
+        sim = CoSim(SimConfig(n=8))
+        out = run(
+            sim,
+            "advance 2",
+            f"put {src} remote.txt",
+            "ls remote.txt",
+            "show_metadata",
+            f"get remote.txt {dst}",
+            "store 0",
+        )
+        assert "ok" in out
+        assert dst.read_bytes() == b"cli payload"
+        assert "remote.txt: v1" in out
+
+    def test_delete_and_missing_file(self, tmp_path):
+        dst = tmp_path / "x"
+        sim = CoSim(SimConfig(n=8))
+        out = run(sim, "advance 2", f"get nope.txt {dst}", "delete nope.txt")
+        assert out.count("No File Found") == 2
+
+    def test_unknown_command(self):
+        sim = CoSim(SimConfig(n=8))
+        assert "unknown command" in run(sim, "frobnicate")
+
+    def test_quit(self):
+        sim = CoSim(SimConfig(n=8))
+        assert not dispatch(sim, "quit", out=io.StringIO())
+
+
+class TestEventLog:
+    def test_grep_and_file_mirror(self, tmp_path):
+        path = tmp_path / "Machine.log"
+        log = EventLog(path)
+        log.write("Failure Detected of node 3 by 1", round=7, kind="failure_detected")
+        log.write("put a.txt -> ok", round=8, kind="put")
+        assert len(log.grep("Failure Detected")) == 1
+        assert log.grep("nomatch") == []
+        log.close()
+        lines = path.read_text().strip().splitlines()
+        assert len(lines) == 2 and "failure_detected" in lines[0]

@@ -1,0 +1,113 @@
+"""Real-socket parity path: 10 nodes gossiping over localhost UDP.
+
+BASELINE config 1.  Runs at 20x real-time (50 ms period).  Timing assertions
+are deliberately tolerant — this validates protocol behavior over real
+sockets, not exact round counts (that's the golden-parity suite's job).
+"""
+
+import asyncio
+
+import pytest
+
+from gossipfs_tpu.detector.udp import UdpCluster
+
+
+def run_async(coro):
+    return asyncio.run(coro)
+
+
+class TestUdpCluster:
+    def test_join_converges_to_full_membership(self):
+        async def scenario():
+            c = UdpCluster(n=10, base_port=19000, period=0.05)
+            try:
+                await c.start_all()
+                await c.run(12)
+                return [c.membership(i) for i in range(10)]
+            finally:
+                c.stop_all()
+
+        views = run_async(scenario())
+        for view in views:
+            assert view == list(range(10))
+
+    def test_crash_detection_and_remove_broadcast(self):
+        async def scenario():
+            # fresh_cooldown: under the faithful stale-timestamp fail list,
+            # event-loop jitter comparable to the period sustains an endemic
+            # re-add/re-detect limit cycle (see test below) — the reference
+            # escapes it only because LAN latency << its 1 s period
+            c = UdpCluster(n=10, base_port=19100, period=0.1, fresh_cooldown=True)
+            try:
+                await c.start_all()
+                await c.run(10)
+                c.crash(4)
+                for _ in range(10):
+                    await c.run(c.t_fail + 5)
+                    views = [c.membership(i) for i in c.alive_nodes()]
+                    if all(4 not in v for v in views):
+                        break
+                return c.drain_events(), views
+            finally:
+                c.stop_all()
+
+        events, views = run_async(scenario())
+        assert any(e.subject == 4 and not e.false_positive for e in events)
+        for view in views:
+            assert 4 not in view
+
+    def test_faithful_cooldown_detection_fires(self):
+        # Faithful stale-timestamp fail list over real sockets.  Detection
+        # must fire; whether the dead node then zombie-cycles (re-add ->
+        # re-detect) depends on event-loop jitter relative to the period —
+        # both outcomes are legitimate protocol behavior, so only the
+        # detection itself is asserted (the cycling is deterministically
+        # reproduced in the tensor sim:
+        # test_rounds.py::test_stale_cooldown_zombies_cycle_without_broadcast).
+        async def scenario():
+            c = UdpCluster(n=10, base_port=19400, period=0.05)
+            try:
+                await c.start_all()
+                await c.run(10)
+                c.crash(4)
+                await c.run(30)
+                return c.drain_events()
+            finally:
+                c.stop_all()
+
+        events = run_async(scenario())
+        assert any(e.subject == 4 and not e.false_positive for e in events)
+
+    def test_leave_removes_without_detection_event(self):
+        async def scenario():
+            c = UdpCluster(n=10, base_port=19200, period=0.05)
+            try:
+                await c.start_all()
+                await c.run(10)
+                c.leave(7)
+                await c.run(4)
+                return c.drain_events(), [c.membership(i) for i in c.alive_nodes()]
+            finally:
+                c.stop_all()
+
+        events, views = run_async(scenario())
+        assert not any(e.subject == 7 for e in events)
+        for view in views:
+            assert 7 not in view
+
+    def test_heartbeats_advance(self):
+        async def scenario():
+            c = UdpCluster(n=10, base_port=19300, period=0.05)
+            try:
+                await c.start_all()
+                await c.run(15)
+                node = c.nodes[3]
+                return {a: m.hb for a, m in node.members.items()}, node.addr
+            finally:
+                c.stop_all()
+
+        hbs, self_addr = run_async(scenario())
+        assert hbs[self_addr] >= 10
+        # gossip carried everyone's counters forward too
+        others = [v for a, v in hbs.items() if a != self_addr]
+        assert all(v >= 5 for v in others)
