@@ -1,0 +1,267 @@
+"""Benchmark runners for the five BASELINE.json configurations.
+
+The reference's entire benchmark apparatus is one wall-clock print in ``Get``
+(reference: slave/slave.go:888-890); BASELINE.json replaces it with the
+north-star metrics: simulated gossip rounds/sec plus time-to-detect and
+false-positive-rate curves.  This module turns a ``models.presets.Scenario``
+into those numbers:
+
+  python -m gossipfs_tpu.bench.run --scenario sim-1k
+  python -m gossipfs_tpu.bench.run --scenario sim-10k-crash --n 2048 --rounds 60
+
+Each run injects a handful of *tracked* deterministic crashes (the sim's
+CTRL+C, reference: README.md:30) on top of the scenario's random churn so the
+time-to-detect distribution is measured against known crash rounds, times the
+compiled scan, and reports one JSON document.
+
+Config 5 (``sim-100k-sdfs``) additionally drives the SDFS control plane off
+the simulated membership (the slave.go:478 seam) at the reference's recovery
+cadence: the detector advances in RECOVERY_DELAY-round chunks (8 rounds =
+the sleep in Fail_recover, slave.go:1123), and between chunks the master's own
+membership row feeds placement + repair planning — the co-sim equivalent of
+`detect -> wait 8 heartbeats -> Get_Update_Meta -> Re_put`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gossipfs_tpu.config import SimConfig
+from gossipfs_tpu.core.rounds import MetricsCarry, RoundMetrics, run_rounds
+from gossipfs_tpu.core.state import MEMBER, RoundEvents, SimState, init_state
+from gossipfs_tpu.metrics.detection import summarize
+from gossipfs_tpu.models import presets
+from gossipfs_tpu.sdfs.cluster import SDFSCluster
+from gossipfs_tpu.sdfs.types import RECOVERY_DELAY
+
+
+def tracked_crash_events(
+    cfg: SimConfig, rounds: int, track: int, at: int
+) -> tuple[RoundEvents, dict[int, int], jnp.ndarray]:
+    """Schedule ``track`` deterministic crashes at round ``at``.
+
+    Nodes are spread evenly across the id space, skipping the introducer
+    (crashing it would also sever rejoins, slave.go:22 SPOF).  Returns the
+    stacked [rounds, N] event arrays, {node: crash_round} for the
+    detection-latency report, and a ``churn_ok`` mask excluding the tracked
+    nodes from random churn — a random rejoin would reset their
+    detection/convergence carry mid-measurement (core/rounds._update_carry).
+    """
+    n = cfg.n
+    track = min(track, n - 1)
+    stride = max(n // (track + 1), 1)
+    nodes = [(cfg.introducer + (k + 1) * stride) % n for k in range(track)]
+    nodes = sorted({x for x in nodes if x != cfg.introducer})
+    crash = np.zeros((rounds, n), dtype=bool)
+    at = min(at, rounds - 1)
+    crash[at, nodes] = True
+    zeros = jnp.zeros((rounds, n), dtype=bool)
+    events = RoundEvents(crash=jnp.asarray(crash), leave=zeros, join=zeros)
+    churn_ok = np.ones((n,), dtype=bool)
+    churn_ok[nodes] = False
+    return events, {node: at for node in nodes}, jnp.asarray(churn_ok)
+
+
+def _timed_run(
+    state: SimState,
+    cfg: SimConfig,
+    rounds: int,
+    key: jax.Array,
+    events: RoundEvents,
+    sc: presets.Scenario,
+    churn_ok: jax.Array | None = None,
+) -> tuple[SimState, MetricsCarry, RoundMetrics, float]:
+    """Compile (warmup) then time one full scan; returns outputs + seconds."""
+    run = lambda: run_rounds(
+        state,
+        cfg,
+        rounds,
+        key,
+        events=events,
+        crash_rate=sc.crash_rate,
+        rejoin_rate=sc.rejoin_rate,
+        churn_ok=churn_ok,
+    )
+    jax.block_until_ready(run())  # compile + warm caches
+    t0 = time.perf_counter()
+    final, carry, per_round = run()
+    jax.block_until_ready(final)
+    return final, carry, per_round, time.perf_counter() - t0
+
+
+def run_cosim(
+    sc: presets.Scenario,
+    cfg: SimConfig,
+    rounds: int,
+    seed: int,
+    mesh=None,
+) -> dict:
+    """Config-5 co-sim: SDFS control plane consuming the sim membership.
+
+    Uses chunked advancement (one ``run_rounds`` scan per RECOVERY_DELAY
+    rounds) instead of the interactive per-round ``CoSim.tick`` so the TPU
+    never stalls on per-round host sync; the control plane reacts exactly at
+    the cadence the reference does (8 heartbeats after detection,
+    slave.go:1123).
+    """
+    from gossipfs_tpu.cosim import select_observer
+
+    cluster = SDFSCluster(cfg.n, seed=seed, introducer=cfg.introducer)
+    for f in range(sc.n_files):
+        cluster.put(f"file{f}.txt", b"payload-%d" % f, now=0)
+    state = init_state(cfg)
+    if mesh is not None:
+        from gossipfs_tpu.parallel.mesh import shard_state
+
+        state = shard_state(state, mesh)
+    key = jax.random.PRNGKey(seed)
+    # equal-size chunks only: num_rounds is a static jit arg on run_rounds, so
+    # a ragged final chunk would trigger a second full XLA compilation
+    chunk = RECOVERY_DELAY
+    n_chunks = max(1, -(-rounds // chunk))
+    repairs = 0
+    elections = 0
+    done = 0
+    alive: list[int] = []
+    # warm up the chunk kernel so compile time stays out of the timed region
+    jax.block_until_ready(
+        run_rounds(
+            state, cfg, chunk, key, crash_rate=sc.crash_rate, rejoin_rate=sc.rejoin_rate
+        )[0]
+    )
+    t0 = time.perf_counter()
+    for _ in range(n_chunks):
+        state, _, _ = run_rounds(
+            state, cfg, chunk, key, crash_rate=sc.crash_rate, rejoin_rate=sc.rejoin_rate
+        )
+        done += chunk
+        alive = np.nonzero(np.asarray(state.alive))[0].tolist()
+        if not alive:
+            break
+        observer = select_observer(cluster.live, set(alive), cluster.master_node)
+        if observer is None:
+            continue
+        view = np.nonzero(np.asarray(state.status[observer]) == int(MEMBER))[0]
+        old_master = cluster.master_node
+        cluster.update_membership(view.tolist(), reachable=alive, now=done)
+        if cluster.master_node != old_master:
+            elections += 1
+        repairs += len(cluster.fail_recover())
+    elapsed = time.perf_counter() - t0
+    # durability: how many files still answer a quorum read at the end
+    readable = sum(
+        1 for f in range(sc.n_files) if cluster.get(f"file{f}.txt") is not None
+    )
+    return {
+        "rounds": done,
+        "elapsed_s": round(elapsed, 3),
+        "rounds_per_sec": round(done / elapsed, 2) if elapsed else None,
+        "files": sc.n_files,
+        "files_readable": readable,
+        "repair_plans": repairs,
+        "elections": elections,
+        "final_alive": len(alive),
+    }
+
+
+def run_scenario(
+    sc: presets.Scenario | str,
+    *,
+    n_override: int | None = None,
+    rounds_override: int | None = None,
+    seed: int = 0,
+    track: int = 4,
+    crash_at: int = 10,
+    mesh=None,
+) -> dict:
+    """Run one BASELINE scenario and return its report dict.
+
+    ``n_override`` shrinks (or grows) the member count — fanout is rescaled
+    for random topologies — so the 100k presets can be smoke-run on small
+    hosts.  ``mesh``: optional ``jax.sharding.Mesh`` to shard the state over
+    (see parallel/mesh.py).
+    """
+    if isinstance(sc, str):
+        sc = presets.ALL[sc]
+    cfg = sc.config
+    if n_override is not None and n_override != cfg.n:
+        fanout = (
+            cfg.fanout if cfg.topology == "ring" else SimConfig.log_fanout(n_override)
+        )
+        cfg = dataclasses.replace(cfg, n=n_override, fanout=fanout)
+    rounds = rounds_override or sc.rounds
+
+    events, crash_rounds, churn_ok = tracked_crash_events(cfg, rounds, track, crash_at)
+    state = init_state(cfg)
+    if mesh is not None:
+        from gossipfs_tpu.parallel.mesh import shard_state
+
+        state = shard_state(state, mesh)
+    key = jax.random.PRNGKey(seed)
+    final, carry, per_round, elapsed = _timed_run(
+        state, cfg, rounds, key, events, sc, churn_ok
+    )
+    report = summarize(carry, per_round, crash_rounds)
+
+    result = {
+        "scenario": sc.name,
+        "n": cfg.n,
+        "topology": cfg.topology,
+        "fanout": cfg.fanout,
+        "rounds": rounds,
+        "crash_rate": sc.crash_rate,
+        "rejoin_rate": sc.rejoin_rate,
+        "platform": jax.devices()[0].platform,
+        "devices": 1 if mesh is None else mesh.devices.size,
+        "elapsed_s": round(elapsed, 4),
+        "rounds_per_sec": round(rounds / elapsed, 2),
+        # the reference advances 1 round per wall-clock second (main.go:27-33)
+        "speedup_vs_realtime": round(rounds / elapsed, 2),
+        "detection": report.as_dict(),
+    }
+    if sc.sdfs_cosim:
+        result["cosim"] = run_cosim(sc, cfg, rounds, seed, mesh=mesh)
+    return result
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--scenario", choices=sorted(presets.ALL), default="sim-1k")
+    p.add_argument("--n", type=int, default=None, help="override member count")
+    p.add_argument("--rounds", type=int, default=None)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--track", type=int, default=4, help="tracked crashes for TTD")
+    p.add_argument("--shard", action="store_true", help="shard over all devices")
+    p.add_argument("--out", type=str, default=None, help="also write JSON here")
+    args = p.parse_args(argv)
+
+    mesh = None
+    if args.shard:
+        from gossipfs_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh()
+    result = run_scenario(
+        args.scenario,
+        n_override=args.n,
+        rounds_override=args.rounds,
+        seed=args.seed,
+        track=args.track,
+        mesh=mesh,
+    )
+    doc = json.dumps(result)
+    print(doc)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(doc + "\n")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

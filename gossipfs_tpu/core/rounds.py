@@ -292,12 +292,16 @@ def run_rounds(
     events: RoundEvents | None = None,
     crash_rate: float = 0.0,
     rejoin_rate: float = 0.0,
+    churn_ok: jax.Array | None = None,
 ) -> tuple[SimState, MetricsCarry, RoundMetrics]:
     """Scan ``num_rounds`` gossip rounds.
 
     ``events``: optional pre-scheduled RoundEvents stacked to [num_rounds, N]
     (deterministic fault injection — the sim's CTRL+C).  ``crash_rate`` /
     ``rejoin_rate`` add per-round random churn on top (BASELINE configs 3/4).
+    ``churn_ok``: optional bool [N] mask of nodes eligible for *random* churn
+    — benchmark runs exclude their tracked crash victims so a random rejoin
+    can't reset the tracked detection/convergence rounds mid-measurement.
     Returns final state, per-subject detection/convergence rounds, and
     per-round metrics stacked over the horizon.
     """
@@ -312,6 +316,8 @@ def run_rounds(
         k_edge, k_churn = jax.random.split(k)
         if crash_rate > 0.0 or rejoin_rate > 0.0:
             crash, join = topology.churn_masks(k_churn, st.alive, crash_rate, rejoin_rate)
+            if churn_ok is not None:
+                crash, join = crash & churn_ok, join & churn_ok
             ev = RoundEvents(crash=ev.crash | crash, leave=ev.leave, join=ev.join | join)
         edges = (
             None

@@ -23,6 +23,26 @@ from gossipfs_tpu.sdfs.types import RECOVERY_DELAY
 from gossipfs_tpu.utils.eventlog import EventLog
 
 
+def select_observer(
+    view_live: list[int], reachable: set[int], master: int
+) -> int | None:
+    """Whose membership view the metadata authority consumes.
+
+    Normally the master's own row (slave.go:478).  If the master process is
+    down (its RPC port refuses — observable immediately, unlike gossip
+    detection), consumers fall through to the election candidate: the lowest
+    node of the previous view that answers RPC; failing that, any reachable
+    node.  Shared by the interactive CoSim and the chunked bench co-sim so
+    config-5 observer semantics can't drift between them.
+    """
+    if master in reachable:
+        return master
+    candidates = [x for x in view_live if x in reachable]
+    if candidates:
+        return min(candidates)
+    return min(reachable) if reachable else None
+
+
 class CoSim:
     """Gossip detector + SDFS cluster advancing in lockstep rounds."""
 
@@ -39,23 +59,11 @@ class CoSim:
         return int(self.detector.state.round)
 
     def _observer(self) -> int | None:
-        """Whose membership view the metadata authority consumes.
-
-        Normally the master's own row (slave.go:478).  If the master process
-        is down (its RPC port refuses — observable immediately, unlike gossip
-        detection), consumers fall through to the election candidate: the
-        lowest node of the previous view that answers RPC.  The *view itself*
-        stays pure gossip data — dead-but-undetected members remain in it, so
-        placement/election react at detection time, not at crash time.
-        """
+        """See ``select_observer`` — the *view itself* stays pure gossip data:
+        dead-but-undetected members remain in it, so placement/election react
+        at detection time, not at crash time."""
         alive = set(self.detector.alive_nodes())  # == "answers RPC"
-        master = self.cluster.master_node
-        if master in alive:
-            return master
-        candidates = [x for x in self.cluster.live if x in alive]
-        if candidates:
-            return min(candidates)
-        return min(alive) if alive else None
+        return select_observer(self.cluster.live, alive, self.cluster.master_node)
 
     def tick(self, rounds: int = 1) -> None:
         """Advance the detector and let the control plane react per round."""

@@ -68,7 +68,10 @@ class SDFSCluster:
         """
         candidates = [x for x in self.live if x in self.reachable]
         candidate = election.successor(candidates)
-        if candidate is None or not election.tally(set(candidates), len(candidates)):
+        # majority is counted against the full member list (Receive_vote,
+        # slave.go:968-984): with most of the view unreachable, the election
+        # stalls rather than letting a minority rebuild (and shrink) metadata
+        if candidate is None or not election.tally(set(candidates), len(self.live)):
             return
         self.master_node = candidate
         registries = {
@@ -158,15 +161,30 @@ class SDFSCluster:
     def fail_recover(self) -> list[ReplicatePlan]:
         """Re-replicate every under-replicated file from its first healthy
         replica (Fail_recover + Re_put).  Called RECOVERY_DELAY rounds after a
-        detection in the co-sim driver."""
-        plans = self.master.plan_repairs(self.live)
+        detection in the co-sim driver.
+
+        Metadata commits *after* the copies: a file's node_list only gains
+        replicas that actually received the bytes, so a failed copy (target
+        dead-but-undetected) leaves the file under-replicated in metadata and
+        eligible for retry on the next recovery pass.
+        """
+        plans = self.master.plan_repairs(self.live, reachable=self.reachable)
         for plan in plans:
-            if plan.source not in self.reachable:
-                continue  # source itself dead-but-undetected: copy fails
-            blob = self.stores[plan.source].get(plan.file)
+            # a listed survivor can hold no bytes (put acked by quorum while
+            # it was unreachable, then it rejoined): fall through the other
+            # reachable survivors instead of livelocking on an empty source
+            blob = None
+            for src in (plan.source, *plan.survivors):
+                if src in self.reachable:
+                    blob = self.stores[src].get(plan.file)
+                    if blob is not None:
+                        break
             if blob is None:
                 continue
+            copied = []
             for node in plan.new_nodes:
                 if node in self.reachable:
                     self.stores[node].put(plan.file, blob, plan.version)
+                    copied.append(node)
+            self.master.commit_repair(plan.file, list(plan.survivors) + copied)
         return plans

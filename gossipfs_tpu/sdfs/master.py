@@ -72,17 +72,24 @@ class SDFSMaster:
         return list(info.node_list) if info else []
 
     # -- repair planning (Update_metadata, master.go:74-127) ---------------
-    def plan_repairs(self, live: list[int]) -> list[ReplicatePlan]:
+    def plan_repairs(
+        self, live: list[int], reachable: set[int] | None = None
+    ) -> list[ReplicatePlan]:
         """Diff every file's replica set against the live membership.
 
         For each file with fewer than 4 live replicas: re-place over live
         members, keep surviving replicas, and order copies from the first
-        healthy source to each newcomer.  (The reference re-creates its plan
-        map inside the per-file loop, so only the last deficient file ever
-        got repaired — master.go:118.  Fixed here: all deficient files are
-        planned; divergence documented and covered by a test.)
+        *reachable* healthy source to each newcomer.  (The reference
+        re-creates its plan map inside the per-file loop, so only the last
+        deficient file ever got repaired — master.go:118 — and it blindly
+        uses working[0] as source even when that node no longer answers RPC.
+        Fixed here: all deficient files are planned, the source must be
+        reachable, and the caller commits the new node_list only for copies
+        that succeeded — see ``commit_repair``.  Divergences documented and
+        covered by tests.)
         """
         live_set = set(live)
+        reach = live_set if reachable is None else (set(reachable) & live_set)
         self.members = sorted(live_set)
         plans: list[ReplicatePlan] = []
         for name, info in self.files.items():
@@ -90,17 +97,28 @@ class SDFSMaster:
             if len(working) >= min(REPLICATION_FACTOR, len(live_set)) or not working:
                 # fully replicated — or every replica lost (file unrecoverable)
                 continue
+            sources = [x for x in working if x in reach]
+            if not sources:
+                # no reachable healthy copy right now: leave metadata as-is
+                # so the file stays under-replicated and is retried later
+                continue
             need = REPLICATION_FACTOR - len(working)
             candidates = [x for x in self.members if x not in set(working)]
             new_nodes = placement.place(candidates, self._rng, k=need)
-            info.node_list = working + new_nodes
             if new_nodes:
                 plans.append(
                     ReplicatePlan(
                         file=name,
-                        source=working[0],
+                        source=sources[0],
                         version=info.version,
                         new_nodes=tuple(new_nodes),
+                        survivors=tuple(working),
                     )
                 )
         return plans
+
+    def commit_repair(self, name: str, node_list: list[int]) -> None:
+        """Record the post-repair replica set (survivors + successful copies)."""
+        info = self.files.get(name)
+        if info is not None:
+            info.node_list = list(node_list)

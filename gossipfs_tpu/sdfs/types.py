@@ -30,6 +30,7 @@ class ReplicatePlan:
     """One file's re-replication order (master.Replicate_info, master.go:27-31)."""
 
     file: str
-    source: int               # first healthy replica to copy from
+    source: int               # first reachable healthy replica to copy from
     version: int
     new_nodes: tuple[int, ...]  # nodes that must receive a copy
+    survivors: tuple[int, ...] = ()  # replicas that already hold the data

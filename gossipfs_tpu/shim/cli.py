@@ -92,8 +92,12 @@ def dispatch(sim: CoSim, line: str, out=sys.stdout) -> bool:
 
 
 def main(argv=None) -> None:
-    args = make_parser().parse_args(argv)
-    cfg = SimConfig(n=args.n, topology=args.topology, fanout=args.fanout)
+    parser = make_parser()
+    args = parser.parse_args(argv)
+    try:
+        cfg = SimConfig(n=args.n, topology=args.topology, fanout=args.fanout)
+    except ValueError as e:
+        parser.error(str(e))
     sim = CoSim(cfg, seed=args.seed)
     print(f"gossipfs sim: {args.n} nodes, {args.topology} topology. 'quit' to exit.")
     for line in sys.stdin:

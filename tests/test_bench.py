@@ -1,0 +1,84 @@
+"""Bench runners (gossipfs_tpu/bench/run.py) on shrunken BASELINE scenarios."""
+
+import json
+
+import pytest
+
+from gossipfs_tpu.bench import run as bench_run
+from gossipfs_tpu.config import SimConfig
+from gossipfs_tpu.models import presets
+
+
+def test_presets_cover_all_five_baseline_configs():
+    assert set(presets.ALL) == {
+        "parity-10",
+        "sim-1k",
+        "sim-10k-crash",
+        "sim-100k",
+        "sim-100k-sdfs",
+    }
+
+
+def test_tracked_crash_events_spread_and_skip_introducer():
+    cfg = SimConfig(n=64)
+    events, crash_rounds, churn_ok = bench_run.tracked_crash_events(
+        cfg, rounds=30, track=4, at=10
+    )
+    assert events.crash.shape == (30, 64)
+    assert set(crash_rounds.values()) == {10}
+    assert cfg.introducer not in crash_rounds
+    assert len(crash_rounds) == 4
+    # tracked victims are excluded from random churn (TTD measurement guard)
+    import numpy as np
+
+    ok = np.asarray(churn_ok)
+    assert not ok[list(crash_rounds)].any() and ok.sum() == 60
+
+
+def test_run_scenario_parity_10_detects_tracked_crashes():
+    result = bench_run.run_scenario("parity-10", rounds_override=60, track=2)
+    assert result["n"] == 10 and result["topology"] == "ring"
+    assert result["rounds_per_sec"] > 0
+    det = result["detection"]
+    # every tracked crash detected within t_fail + propagation slack
+    for node, ttd in det["ttd_first"].items():
+        assert 0 < ttd <= 15, (node, ttd)
+    for node, ttd in det["ttd_converged"].items():
+        assert 0 < ttd <= 25, (node, ttd)
+
+
+def test_run_scenario_shrunken_churn_config_runs_and_reports():
+    result = bench_run.run_scenario(
+        "sim-10k-crash", n_override=256, rounds_override=40, track=3
+    )
+    assert result["n"] == 256
+    assert result["fanout"] == SimConfig.log_fanout(256)
+    assert result["detection"]["true_detections"] > 0
+    json.dumps(result)  # report must be JSON-serializable
+
+
+def test_run_scenario_cosim_keeps_files_readable():
+    sc = presets.ALL["sim-100k-sdfs"]
+    import dataclasses
+
+    small = dataclasses.replace(sc, n_files=20, crash_rate=0.01, rejoin_rate=0.02)
+    result = bench_run.run_scenario(
+        small, n_override=128, rounds_override=32, track=2
+    )
+    co = result["cosim"]
+    assert co["files"] == 20
+    # 4-way replication + re-replication keeps a large majority readable
+    # under 1% crash churn over a short horizon
+    assert co["files_readable"] >= 15
+    assert co["final_alive"] > 0
+    json.dumps(result)
+
+
+def test_cli_main_prints_json(capsys, tmp_path):
+    out = tmp_path / "r.json"
+    bench_run.main(
+        ["--scenario", "parity-10", "--rounds", "20", "--track", "1", "--out", str(out)]
+    )
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["scenario"] == "parity-10"
+    assert json.loads(out.read_text())["scenario"] == "parity-10"

@@ -84,13 +84,17 @@ class TestMaster:
         victims = set(m.files["a"].node_list[:1]) | set(m.files["b"].node_list[:1])
         live = [x for x in range(10) if x not in victims]
         plans = m.plan_repairs(live)
-        deficient = [n for n in ("a", "b", "c") if victims & set(m.files[n].node_list)]
-        assert not deficient  # all node lists now live-only
+        planned = {p.file for p in plans}
+        deficient = {n for n in ("a", "b", "c") if victims & set(m.files[n].node_list)}
+        assert deficient <= planned  # every deficient file got a plan
         for plan in plans:
+            assert len(plan.survivors) + len(plan.new_nodes) == 4
+            assert set(plan.survivors) | set(plan.new_nodes) <= set(live)
+            assert plan.source in plan.survivors
+            # metadata commits only after the copies succeed
+            m.commit_repair(plan.file, list(plan.survivors) + list(plan.new_nodes))
             info = m.files[plan.file]
-            assert len(info.node_list) == 4
-            assert set(info.node_list) <= set(live)
-            assert plan.source in live
+            assert len(info.node_list) == 4 and set(info.node_list) <= set(live)
 
     def test_unrecoverable_file_left_alone(self):
         m = SDFSMaster(seed=1)
@@ -180,6 +184,69 @@ class TestCluster:
         assert len(new_replicas) == 4 and victim not in new_replicas
         for node in new_replicas:
             assert c.stores[node].get("a.txt") == b"data"
+
+    def test_fail_recover_commits_only_successful_copies(self):
+        # a planned copy target that is dead-but-undetected must not become a
+        # phantom replica: metadata keeps the file under-replicated so the
+        # next recovery pass retries (divergence from master.go:118 noted in
+        # SDFSMaster.plan_repairs)
+        c = SDFSCluster(n=6, seed=0)
+        assert c.put("a.txt", b"data", now=0)
+        replicas = c.ls("a.txt")
+        victim, survivors = replicas[0], replicas[1:]
+        live = [x for x in range(6) if x != victim]
+        # every placement candidate (live non-replica) refuses connections
+        reach = [x for x in live if x in replicas]
+        c.update_membership(live, reachable=reach)
+        c.fail_recover()
+        assert set(c.ls("a.txt")) == set(survivors)  # no phantom replicas
+        # targets come back up -> repair retries and completes
+        c.update_membership(live, reachable=live)
+        c.fail_recover()
+        healed = c.ls("a.txt")
+        assert len(healed) == 4
+        for node in healed:
+            assert c.stores[node].get("a.txt") == b"data"
+
+    def test_fail_recover_falls_through_empty_source(self):
+        # a survivor listed in node_list may hold no bytes (quorum-acked put
+        # while it was unreachable, then rejoined); recovery must fall
+        # through to a survivor that actually has the data
+        c = SDFSCluster(n=8, seed=0)
+        assert c.put("a.txt", b"data", now=0)
+        replicas = c.ls("a.txt")
+        victim = replicas[-1]
+        c.stores[replicas[0]].delete("a.txt")  # first survivor is empty
+        c.update_membership([x for x in range(8) if x != victim])
+        c.fail_recover()
+        healed = c.ls("a.txt")
+        assert len(healed) == 4 and victim not in healed
+        assert c.get("a.txt") == b"data"  # read-repair also refills the gap
+
+    def test_plan_repairs_requires_reachable_source(self):
+        m = SDFSMaster(seed=0)
+        m.update_member(list(range(8)))
+        m.handle_put("a", now=0)
+        nodes = m.files["a"].node_list
+        # all surviving replicas unreachable: no plan, metadata untouched
+        live = list(range(8))
+        plans = m.plan_repairs(
+            [x for x in live if x != nodes[0]],
+            reachable={x for x in live if x not in nodes},
+        )
+        assert plans == []
+        assert m.files["a"].node_list == nodes
+
+    def test_minority_cannot_elect_master(self):
+        # majority is counted against the member list (slave.go:968-984): 3
+        # reachable nodes out of a 9-member view must not rebuild metadata
+        c = SDFSCluster(n=10, seed=0)
+        old = c.master_node
+        live = [x for x in range(10) if x != old]
+        c.update_membership(live, reachable=live[:3])
+        assert c.master_node == old  # election stalled
+        c.update_membership(live, reachable=live)
+        assert c.master_node == min(live)
 
     def test_master_death_triggers_election_and_rebuild(self):
         c = SDFSCluster(n=8, seed=0)
