@@ -145,6 +145,9 @@ def run_cosim(
         done += chunk
         alive = np.nonzero(np.asarray(state.alive))[0].tolist()
         if not alive:
+            # feed the empty membership so the closing durability check can't
+            # satisfy quorum against stores of dead nodes
+            cluster.update_membership([], reachable=[], now=done)
             break
         observer = select_observer(cluster.live, set(alive), cluster.master_node)
         if observer is None:

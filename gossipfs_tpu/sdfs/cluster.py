@@ -13,6 +13,7 @@ the same decisions the Go cluster would make.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Callable
 
 from gossipfs_tpu.sdfs import election
@@ -167,15 +168,26 @@ class SDFSCluster:
         replicas that actually received the bytes, so a failed copy (target
         dead-but-undetected) leaves the file under-replicated in metadata and
         eligible for retry on the next recovery pass.
+
+        Returns only *executed* plans, with ``new_nodes`` narrowed to the
+        copies that actually landed — what the event log and the bench's
+        repair count should reflect.
         """
         plans = self.master.plan_repairs(self.live, reachable=self.reachable)
+        executed: list[ReplicatePlan] = []
         for plan in plans:
             # a listed survivor can hold no bytes (put acked by quorum while
             # it was unreachable, then it rejoined): fall through the other
             # reachable survivors instead of livelocking on an empty source
+            # ... and a survivor can hold *stale* bytes (same rejoin story,
+            # one version behind): only a source at the plan's version may
+            # seed copies, else old bytes get re-stamped as current
             blob = None
-            for src in (plan.source, *plan.survivors):
-                if src in self.reachable:
+            for src in plan.survivors:  # plan.source == first survivor in reach
+                if (
+                    src in self.reachable
+                    and self.stores[src].version(plan.file) >= plan.version
+                ):
                     blob = self.stores[src].get(plan.file)
                     if blob is not None:
                         break
@@ -187,4 +199,8 @@ class SDFSCluster:
                     self.stores[node].put(plan.file, blob, plan.version)
                     copied.append(node)
             self.master.commit_repair(plan.file, list(plan.survivors) + copied)
-        return plans
+            if copied:
+                executed.append(
+                    dataclasses.replace(plan, new_nodes=tuple(copied))
+                )
+        return executed

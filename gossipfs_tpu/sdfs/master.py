@@ -28,6 +28,7 @@ class SDFSMaster:
     def __init__(self, seed: int = 0):
         self.files: dict[str, FileInfo] = {}
         self.members: list[int] = []
+        self._seed = seed
         self._rng = random.Random(seed)
 
     # -- membership seam (master.go:46-48) --------------------------------
@@ -90,7 +91,13 @@ class SDFSMaster:
         """
         live_set = set(live)
         reach = live_set if reachable is None else (set(reachable) & live_set)
-        self.members = sorted(live_set)
+        # pure w.r.t. master state: membership updates flow only through
+        # update_member (the slave.go:478 seam), and placement draws come
+        # from a membership-keyed derived RNG rather than the shared one —
+        # so a planning call with a stale snapshot (shim GetUpdateMeta)
+        # neither redirects later placement nor perturbs its determinism
+        members = sorted(live_set)
+        rng = random.Random(f"{self._seed}:{members}")
         plans: list[ReplicatePlan] = []
         for name, info in self.files.items():
             working = [x for x in info.node_list if x in live_set]
@@ -103,8 +110,12 @@ class SDFSMaster:
                 # so the file stays under-replicated and is retried later
                 continue
             need = REPLICATION_FACTOR - len(working)
-            candidates = [x for x in self.members if x not in set(working)]
-            new_nodes = placement.place(candidates, self._rng, k=need)
+            # candidates must be reachable: a copy to an unreachable node
+            # can't land, and with the derived (deterministic) RNG an
+            # unreachable pick would be re-picked forever for an unchanged
+            # view — reachable-only placement keeps retries progressing
+            candidates = [x for x in reach if x not in set(working)]
+            new_nodes = placement.place(candidates, rng, k=need)
             if new_nodes:
                 plans.append(
                     ReplicatePlan(

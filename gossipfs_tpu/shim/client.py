@@ -1,0 +1,81 @@
+"""gRPC shim client — what the reference's ``rpc.Dial`` call sites become.
+
+Every SDFS client op in the reference dials the master and calls a
+string-named method (e.g. ``rpc.Dial("tcp", master:9000)`` then
+``TCPServer.Get_put_info``, reference: slave/slave.go:669-678).  This client
+is the same shape over gRPC: one channel, methods addressed by name under
+``/gossipfs.Shim/``.  JSON in, JSON out — no codegen.
+"""
+
+from __future__ import annotations
+
+import base64
+
+import grpc
+
+from gossipfs_tpu.shim.service import SERVICE, _deser, _ser
+
+
+class ShimClient:
+    """Thin dynamic proxy: ``client.call("GetFileInfo", file="x")``."""
+
+    def __init__(self, address: str, timeout: float = 30.0):
+        self.channel = grpc.insecure_channel(address)
+        self.timeout = timeout
+        self._methods: dict[str, grpc.UnaryUnaryMultiCallable] = {}
+
+    def call(self, method: str, **request):
+        fn = self._methods.get(method)
+        if fn is None:
+            fn = self._methods[method] = self.channel.unary_unary(
+                f"/{SERVICE}/{method}",
+                request_serializer=_ser,
+                response_deserializer=_deser,
+            )
+        return fn(request, timeout=self.timeout)
+
+    # -- convenience wrappers for the common verbs -------------------------
+    def join(self, node: int) -> None:
+        self.call("Join", node=node)
+
+    def leave(self, node: int) -> None:
+        self.call("Leave", node=node)
+
+    def crash(self, node: int) -> None:
+        self.call("Crash", node=node)
+
+    def lsm(self, observer: int) -> list[int]:
+        return self.call("Lsm", observer=observer)["members"]
+
+    def alive_nodes(self) -> list[int]:
+        return self.call("AliveNodes")["nodes"]
+
+    def advance(self, rounds: int = 1) -> int:
+        return self.call("Advance", rounds=rounds)["round"]
+
+    def put(self, file: str, data: bytes, confirm: bool = False) -> bool:
+        return self.call(
+            "Put", file=file, data_b64=base64.b64encode(data).decode(),
+            confirm=confirm,
+        )["ok"]
+
+    def get(self, file: str) -> bytes | None:
+        resp = self.call("Get", file=file)
+        if not resp["found"]:
+            return None
+        return base64.b64decode(resp["data_b64"])
+
+    def delete(self, file: str) -> bool:
+        return self.call("Delete", file=file)["ok"]
+
+    def ls(self, file: str) -> list[int]:
+        return self.call("Ls", file=file)["replicas"]
+
+    def store(self, node: int) -> dict[str, int]:
+        return self.call("Store", node=node)["listing"]
+
+    def grep(self, pattern: str) -> list[dict]:
+        return self.call("Grep", pattern=pattern)["lines"]
+
+    def close(self) -> None:
+        self.channel.close()

@@ -1,0 +1,315 @@
+"""gRPC shim: the process boundary the BASELINE north star names.
+
+The reference's control plane is a Go ``net/rpc`` server over TCP :9000
+exposing 12 string-named methods (reference: server/server.go:19-251).  This
+module is its TPU-native equivalent: a real gRPC server whose method surface
+mirrors all 12 RPCs one-for-one, backed by the simulated detector + SDFS
+control plane (``CoSim``), plus the membership verbs (join/leave/lsm) the
+north star says external consumers keep using across the shim.
+
+No ``.proto`` codegen is required: messages are JSON dicts over gRPC's
+generic-handler API (``grpc.method_handlers_generic_handler``) — the wire is
+still HTTP/2 gRPC, so any language with a gRPC runtime can call it by method
+path ``/gossipfs.Shim/<Method>`` with a JSON body.
+
+Method map (reference server/server.go -> here):
+
+  Response (remote grep, :55-72)        -> Grep
+  Get_put_info (:74-121)                -> GetPutInfo
+  Get_file_data (:123-131)              -> GetFileData
+  Get_file_info (:133-142)              -> GetFileInfo
+  Ask_for_confirmation (:155-177)       -> AskForConfirmation
+  Get_delete_info (:214-219)            -> GetDeleteInfo
+  Delete_file_data (:221-223)           -> DeleteFileData
+  Remote_reput (:225-229)               -> RemoteReput
+  Vote (:231-234)                       -> Vote
+  Assign_new_master (:236-239)          -> AssignNewMaster
+  Update_file_version (:241-245)        -> UpdateFileVersion
+  Get_Update_Meta (:247-251)            -> GetUpdateMeta
+
+plus Join/Leave/Crash/Lsm/AliveNodes/Advance/Events (membership seam,
+slave/slave.go:288-336, 546-613) and whole-op verbs Put/Get/Delete/Ls/Store/
+ShowMetadata matching the CLI surface (README.md:8-29).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+from concurrent import futures
+
+import grpc
+
+from gossipfs_tpu.cosim import CoSim
+from gossipfs_tpu.sdfs import election
+
+SERVICE = "gossipfs.Shim"
+
+
+def _ser(obj) -> bytes:
+    return json.dumps(obj).encode("utf-8")
+
+
+def _deser(data: bytes):
+    return json.loads(data.decode("utf-8")) if data else {}
+
+
+class ShimServicer:
+    """The RPC method implementations over one CoSim (single-writer lock)."""
+
+    def __init__(self, sim: CoSim, auto_confirm: bool = False):
+        self.sim = sim
+        self.auto_confirm = auto_confirm
+        self._lock = threading.Lock()
+        # Vote tallies: candidate -> set of voters (Receive_vote state,
+        # reference: slave/slave.go:53-57, 968-984)
+        self._votes: dict[int, set[int]] = {}
+
+    # -- membership verbs (the north-star seam) ----------------------------
+    def Join(self, req, ctx):
+        with self._lock:
+            self.sim.detector.join(int(req["node"]))
+        return {"ok": True}
+
+    def Leave(self, req, ctx):
+        with self._lock:
+            self.sim.detector.leave(int(req["node"]))
+        return {"ok": True}
+
+    def Crash(self, req, ctx):
+        with self._lock:
+            self.sim.detector.crash(int(req["node"]))
+        return {"ok": True}
+
+    def Lsm(self, req, ctx):
+        with self._lock:
+            return {"members": self.sim.detector.membership(int(req["observer"]))}
+
+    def AliveNodes(self, req, ctx):
+        with self._lock:
+            return {"nodes": self.sim.detector.alive_nodes()}
+
+    def Advance(self, req, ctx):
+        with self._lock:
+            self.sim.tick(int(req.get("rounds", 1)))
+            return {"round": self.sim.round}
+
+    def Events(self, req, ctx):
+        with self._lock:
+            return {
+                "events": [
+                    {
+                        "round": e.round,
+                        "observer": e.observer,
+                        "subject": e.subject,
+                        "false_positive": e.false_positive,
+                    }
+                    for e in self.sim.events
+                ]
+            }
+
+    # -- the 12 reference RPCs --------------------------------------------
+    def Grep(self, req, ctx):
+        """TCPServer.Response — distributed log grep (server.go:55-72)."""
+        with self._lock:
+            return {"lines": self.sim.log.grep(req["pattern"])}
+
+    def GetPutInfo(self, req, ctx):
+        """Conflict check + placement + version bump (server.go:74-121).
+
+        On a write within the 60-round window the master asks for
+        confirmation; ``confirm`` in the request (or server-side
+        ``auto_confirm``) stands in for the interactive yes/no whose absence
+        times out to a reject after 30 s (server.go:144-177).
+        """
+        name = req["file"]
+        with self._lock:
+            now = self.sim.round
+            master = self.sim.cluster.master
+            if master.updated_recently(name, now):
+                if not (req.get("confirm", False) or self.auto_confirm):
+                    return {"ok": False, "conflict": True}
+            replicas, version = master.handle_put(name, now)
+            return {"ok": bool(replicas), "replicas": replicas, "version": version}
+
+    def GetFileData(self, req, ctx):
+        """Replica-side version report (server.go:123-131, slave.go:799-813)."""
+        with self._lock:
+            store = self.sim.cluster.stores[int(req["node"])]
+            return {"local_version": store.version(req["file"])}
+
+    def GetFileInfo(self, req, ctx):
+        """Replica list + version; ([], -1) when absent (server.go:133-142)."""
+        with self._lock:
+            replicas, version = self.sim.cluster.master.file_info(req["file"])
+            return {"replicas": replicas, "version": version}
+
+    def AskForConfirmation(self, req, ctx):
+        """The interactive conflict prompt (server.go:155-177); the no-answer
+        outcome (30 s timeout -> reject) is the default policy."""
+        return {"confirm": self.auto_confirm}
+
+    def GetDeleteInfo(self, req, ctx):
+        """Master drops metadata, returns old replicas (server.go:214-219)."""
+        with self._lock:
+            return {"old_replicas": self.sim.cluster.master.delete(req["file"])}
+
+    def DeleteFileData(self, req, ctx):
+        """Replica-local delete (server.go:221-223, sdfs_slave.go:63-77)."""
+        with self._lock:
+            ok = self.sim.cluster.stores[int(req["node"])].delete(req["file"])
+            return {"ok": ok}
+
+    def RemoteReput(self, req, ctx):
+        """Ask a healthy source to push a file to a new replica
+        (server.go:225-229 -> slave.Re_put, slave.go:1093-1120)."""
+        with self._lock:
+            stores = self.sim.cluster.stores
+            blob = stores[int(req["source"])].get(req["file"])
+            if blob is None:
+                return {"ok": False}
+            stores[int(req["target"])].put(req["file"], blob, int(req["version"]))
+            return {"ok": True}
+
+    def Vote(self, req, ctx):
+        """Election vote (server.go:231-234 -> Receive_vote, slave.go:968-984):
+        candidate counts distinct voters; on majority of the current view it
+        becomes master."""
+        candidate, voter = int(req["candidate"]), int(req["voter"])
+        with self._lock:
+            voters = self._votes.setdefault(candidate, set())
+            voters.add(voter)
+            elected = election.tally(voters, len(self.sim.cluster.live))
+            if elected:
+                self.sim.cluster.master_node = candidate
+                # election over: clear ALL tallies so losers' votes can't
+                # leak into a later election (VoteStatus reset,
+                # slave.go:968-975)
+                self._votes.clear()
+            return {"elected": elected, "votes": len(voters)}
+
+    def AssignNewMaster(self, req, ctx):
+        """Tell a node the new master; it answers with its local registry for
+        the metadata rebuild (server.go:236-239 -> slave.go:1045-1051)."""
+        with self._lock:
+            self.sim.cluster.master_node = int(req["master"])
+            listing = self.sim.cluster.stores[int(req["node"])].listing()
+            return {"listing": listing}
+
+    def UpdateFileVersion(self, req, ctx):
+        """Registry-only version write on a replica (server.go:241-245 ->
+        sdfs_slave.go:20-25)."""
+        with self._lock:
+            store = self.sim.cluster.stores[int(req["node"])]
+            store.set_version(req["file"], int(req["version"]))
+            return {"ok": True}
+
+    def GetUpdateMeta(self, req, ctx):
+        """Feed a membership snapshot, get the repair plan back
+        (server.go:247-251 -> master.go:74-127).  Planning only — executing
+        the copies and committing is the caller's job, like the reference;
+        the cluster's own view/reachability/master state is untouched (the
+        snapshot may be stale relative to the detector)."""
+        with self._lock:
+            cluster = self.sim.cluster
+            view = sorted(int(x) for x in req["membership"])
+            reach = cluster.reachable & set(view)
+            plans = cluster.master.plan_repairs(view, reachable=reach)
+            return {
+                "plans": [
+                    {
+                        "file": p.file,
+                        "source": p.source,
+                        "version": p.version,
+                        "new_nodes": list(p.new_nodes),
+                        "survivors": list(p.survivors),
+                    }
+                    for p in plans
+                ]
+            }
+
+    # -- whole-op verbs (CLI surface, README.md:8-29) ----------------------
+    def Put(self, req, ctx):
+        data = base64.b64decode(req["data_b64"])
+        with self._lock:
+            ok = self.sim.put(req["file"], data, confirm=(
+                (lambda: True) if (req.get("confirm") or self.auto_confirm) else None
+            ))
+            return {"ok": ok}
+
+    def Get(self, req, ctx):
+        with self._lock:
+            blob = self.sim.get(req["file"])
+        if blob is None:
+            return {"found": False}
+        return {"found": True, "data_b64": base64.b64encode(blob).decode()}
+
+    def Delete(self, req, ctx):
+        with self._lock:
+            return {"ok": self.sim.delete(req["file"])}
+
+    def Ls(self, req, ctx):
+        with self._lock:
+            return {"replicas": self.sim.cluster.ls(req["file"])}
+
+    def Store(self, req, ctx):
+        with self._lock:
+            return {"listing": self.sim.cluster.store_listing(int(req["node"]))}
+
+    def ShowMetadata(self, req, ctx):
+        with self._lock:
+            return {
+                "files": {
+                    name: {
+                        "version": info.version,
+                        "node_list": list(info.node_list),
+                    }
+                    for name, info in self.sim.cluster.master.files.items()
+                }
+            }
+
+    # -- plumbing -----------------------------------------------------------
+    METHODS = [
+        "Join", "Leave", "Crash", "Lsm", "AliveNodes", "Advance", "Events",
+        "Grep", "GetPutInfo", "GetFileData", "GetFileInfo",
+        "AskForConfirmation", "GetDeleteInfo", "DeleteFileData", "RemoteReput",
+        "Vote", "AssignNewMaster", "UpdateFileVersion", "GetUpdateMeta",
+        "Put", "Get", "Delete", "Ls", "Store", "ShowMetadata",
+    ]
+
+    def generic_handler(self) -> grpc.GenericRpcHandler:
+        handlers = {
+            name: grpc.unary_unary_rpc_method_handler(
+                getattr(self, name),
+                request_deserializer=_deser,
+                response_serializer=_ser,
+            )
+            for name in self.METHODS
+        }
+        return grpc.method_handlers_generic_handler(SERVICE, handlers)
+
+
+class ShimServer:
+    """Owns the grpc.Server lifecycle around one ShimServicer."""
+
+    def __init__(
+        self,
+        sim: CoSim,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        auto_confirm: bool = False,
+        max_workers: int = 8,
+    ):
+        self.servicer = ShimServicer(sim, auto_confirm=auto_confirm)
+        self.server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+        self.server.add_generic_rpc_handlers((self.servicer.generic_handler(),))
+        self.port = self.server.add_insecure_port(f"{host}:{port}")
+        self.address = f"{host}:{self.port}"
+
+    def start(self) -> "ShimServer":
+        self.server.start()
+        return self
+
+    def stop(self, grace: float = 0.5) -> None:
+        self.server.stop(grace).wait()

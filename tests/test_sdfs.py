@@ -198,8 +198,13 @@ class TestCluster:
         # every placement candidate (live non-replica) refuses connections
         reach = [x for x in live if x in replicas]
         c.update_membership(live, reachable=reach)
-        c.fail_recover()
-        assert set(c.ls("a.txt")) == set(survivors)  # no phantom replicas
+        assert c.fail_recover() == []  # no reachable candidates -> no repair
+        # no phantom replicas: nothing beyond the original set is listed,
+        # and nothing new holds bytes
+        assert set(c.ls("a.txt")) <= set(replicas)
+        assert all(
+            c.stores[x].get("a.txt") is None for x in live if x not in replicas
+        )
         # targets come back up -> repair retries and completes
         c.update_membership(live, reachable=live)
         c.fail_recover()
@@ -222,6 +227,65 @@ class TestCluster:
         healed = c.ls("a.txt")
         assert len(healed) == 4 and victim not in healed
         assert c.get("a.txt") == b"data"  # read-repair also refills the gap
+
+    def test_fail_recover_skips_stale_version_source(self):
+        # a survivor can hold bytes one version behind (rejoined after a
+        # quorum-acked put it missed): it must not seed copies, else old
+        # bytes get re-stamped as the current version
+        c = SDFSCluster(n=8, seed=0)
+        assert c.put("a.txt", b"v1", now=0)
+        replicas = c.ls("a.txt")
+        straggler, victim = replicas[0], replicas[-1]
+        # straggler misses the v2 write (unreachable during the put)
+        c.update_membership(list(range(8)), reachable=[x for x in range(8) if x != straggler])
+        assert c.put("a.txt", b"v2", now=100)
+        assert c.stores[straggler].version("a.txt") == 1  # stale bytes kept
+        # victim dies; straggler (back up) is the plan's first source
+        c.update_membership([x for x in range(8) if x != victim])
+        c.fail_recover()
+        for node in c.ls("a.txt"):
+            blob = c.stores[node].get("a.txt")
+            if c.stores[node].version("a.txt") == 2 and blob is not None:
+                assert blob == b"v2"  # nobody serves v1 bytes stamped v2
+
+    def test_plan_repairs_is_pure_wrt_members(self):
+        # a planning call with a stale/shrunken snapshot must not redirect
+        # subsequent placement (the shim's GetUpdateMeta is planning-only)
+        m = SDFSMaster(seed=0)
+        m.update_member(list(range(12)))
+        m.handle_put("a", now=0)
+        m.plan_repairs([0, 1], reachable={0, 1})
+        assert m.members == list(range(12))
+        m.handle_put("b", now=0)
+        replicas, _ = m.file_info("b")
+        assert len(replicas) == 4  # placed over all 12, not the [0,1] snapshot
+        # determinism: a twin master that never planned places identically
+        # (planning must not advance the shared placement RNG)
+        twin = SDFSMaster(seed=0)
+        twin.update_member(list(range(12)))
+        twin.handle_put("a", now=0)
+        twin.handle_put("b", now=0)
+        assert twin.files["b"].node_list == list(replicas)
+
+    def test_fail_recover_returns_only_executed_plans(self):
+        # skipped plans (no reachable copy targets) must not be reported as
+        # repairs — the event log / bench would otherwise claim copies that
+        # never happened
+        c = SDFSCluster(n=6, seed=0)
+        assert c.put("a.txt", b"data", now=0)
+        replicas = c.ls("a.txt")
+        victim = replicas[0]
+        live = [x for x in range(6) if x != victim]
+        # all placement candidates refuse connections -> plan exists, 0 copies
+        c.update_membership(live, reachable=[x for x in live if x in replicas])
+        assert c.fail_recover() == []
+        # candidates back up -> the retry executes and is reported
+        c.update_membership(live, reachable=live)
+        executed = c.fail_recover()
+        assert len(executed) == 1
+        assert all(
+            c.stores[n].get("a.txt") == b"data" for n in executed[0].new_nodes
+        )
 
     def test_plan_repairs_requires_reachable_source(self):
         m = SDFSMaster(seed=0)
