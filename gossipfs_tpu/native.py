@@ -1,0 +1,211 @@
+"""ctypes bindings for the native (C++) gossip runtime.
+
+The reference's runtime is Go: a blocking UDP receive goroutine plus a 1 s
+heartbeat driver per process (reference: slave/slave.go:207-248, main.go:27-33).
+The TPU build's native equivalent lives in ``native/``: an epoll-driven C++
+engine running all N protocol nodes over real localhost UDP sockets, speaking
+the reference wire format (``<#ENTRY#>``/``<#INFO#>``/``<CMD>`` framing,
+slave.go:365-385).  This module builds it on demand (``make`` in ``native/``)
+and wraps it in the same ``FailureDetector`` interface as the TPU sim and the
+Python asyncio parity path — three interchangeable engines, one seam.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import pathlib
+import subprocess
+import threading
+
+from gossipfs_tpu.detector.api import DetectionEvent
+
+_NATIVE_DIR = pathlib.Path(__file__).resolve().parent.parent / "native"
+_LIB_PATH = _NATIVE_DIR / "libgossipfs_native.so"
+_build_lock = threading.Lock()
+_lib = None
+
+
+class NativeBuildError(RuntimeError):
+    """The shared library could not be built (no toolchain, compile error)."""
+
+
+def _build() -> None:
+    proc = subprocess.run(
+        ["make", "-C", str(_NATIVE_DIR)], capture_output=True, text=True
+    )
+    if proc.returncode != 0:
+        raise NativeBuildError(
+            f"native build failed:\n{proc.stdout}\n{proc.stderr}"
+        )
+
+
+def load_library() -> ctypes.CDLL:
+    """Build (if needed) and load the native runtime, caching the handle."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _build_lock:
+        if _lib is not None:
+            return _lib
+        sources = [_NATIVE_DIR / "codec.cc", _NATIVE_DIR / "engine.cc",
+                   _NATIVE_DIR / "codec.h"]
+        if not _LIB_PATH.exists() or any(
+            s.stat().st_mtime > _LIB_PATH.stat().st_mtime for s in sources
+        ):
+            _build()
+        lib = ctypes.CDLL(str(_LIB_PATH))
+        lib.gfs_cluster_create.restype = ctypes.c_void_p
+        lib.gfs_cluster_create.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_double, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.gfs_cluster_start.argtypes = [ctypes.c_void_p]
+        lib.gfs_cluster_start.restype = ctypes.c_int
+        lib.gfs_cluster_destroy.argtypes = [ctypes.c_void_p]
+        for fn in (lib.gfs_crash, lib.gfs_leave, lib.gfs_join):
+            fn.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.gfs_advance.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.gfs_round.argtypes = [ctypes.c_void_p]
+        lib.gfs_round.restype = ctypes.c_int
+        for fn in (lib.gfs_membership,):
+            fn.argtypes = [
+                ctypes.c_void_p, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+            ]
+            fn.restype = ctypes.c_int
+        for fn in (lib.gfs_alive, lib.gfs_drain_events):
+            fn.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_int), ctypes.c_int
+            ]
+            fn.restype = ctypes.c_int
+        for fn in (lib.gfs_codec_encode, lib.gfs_codec_decode):
+            fn.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+            fn.restype = ctypes.c_int
+        _lib = lib
+        return _lib
+
+
+# -- codec (parity-testable against detector/udp.py's Python codec) ---------
+
+def _call_sized(fn, data: bytes, initial_cap: int) -> bytes:
+    """Call a snprintf-style C function, growing the buffer on truncation
+    (the function returns the full required length)."""
+    cap = initial_cap
+    while True:
+        out = ctypes.create_string_buffer(cap)
+        need = fn(data, out, cap)
+        if need < cap:
+            return out.raw[:need]
+        cap = need + 1
+
+
+def codec_encode(entries: list[tuple[str, int, float]]) -> str:
+    """Members -> reference wire string, through the C++ codec."""
+    lib = load_library()
+    lines = "\n".join(f"{a} {hb} {ts}" for a, hb, ts in entries).encode()
+    return _call_sized(lib.gfs_codec_encode, lines, 3 * len(lines) + 64).decode()
+
+
+def codec_decode(wire: str) -> list[tuple[str, int, float]]:
+    """Reference wire string -> members, through the C++ codec."""
+    lib = load_library()
+    raw = _call_sized(
+        lib.gfs_codec_decode, wire.encode(), 2 * len(wire) + 64
+    ).decode()
+    entries = []
+    for line in raw.splitlines():
+        addr, hb, ts = line.split(" ")
+        entries.append((addr, int(hb), float(ts)))
+    return entries
+
+
+# -- the engine behind the FailureDetector seam -----------------------------
+
+class NativeUdpDetector:
+    """FailureDetector over the C++ epoll engine (real localhost datagrams).
+
+    Same verbs and views as ``detector.sim.SimDetector`` and
+    ``detector.udp.UdpDetector`` — the config-1 parity path at native speed.
+    ``advance(r)`` blocks for r heartbeat periods of wall time (the native
+    engine, like the reference, runs in real time).
+    """
+
+    def __init__(
+        self,
+        n: int,
+        base_port: int = 19000,
+        period: float = 0.05,
+        t_fail: int = 5,
+        t_cooldown: int = 5,
+        min_group: int = 4,
+        fresh_cooldown: bool = False,
+        introducer: int = 0,
+    ):
+        self._lib = load_library()
+        self.n = n
+        self._h = self._lib.gfs_cluster_create(
+            n, base_port, period, t_fail, t_cooldown, min_group,
+            int(fresh_cooldown), introducer,
+        )
+        if self._lib.gfs_cluster_start(self._h) != 0:
+            self._lib.gfs_cluster_destroy(self._h)
+            self._h = None
+            raise RuntimeError(
+                f"native cluster failed to start (ports {base_port}..{base_port + n - 1} busy?)"
+            )
+
+    # -- FailureDetector protocol ------------------------------------------
+    def join(self, node: int) -> None:
+        self._lib.gfs_join(self._h, node)
+
+    def leave(self, node: int) -> None:
+        self._lib.gfs_leave(self._h, node)
+
+    def crash(self, node: int) -> None:
+        self._lib.gfs_crash(self._h, node)
+
+    def advance(self, rounds: int = 1) -> None:
+        self._lib.gfs_advance(self._h, rounds)
+
+    @property
+    def round(self) -> int:
+        return self._lib.gfs_round(self._h)
+
+    def membership(self, observer: int) -> list[int]:
+        buf = (ctypes.c_int * self.n)()
+        count = self._lib.gfs_membership(self._h, observer, buf, self.n)
+        return list(buf[:count])
+
+    def alive_nodes(self) -> list[int]:
+        buf = (ctypes.c_int * self.n)()
+        count = self._lib.gfs_alive(self._h, buf, self.n)
+        return list(buf[:count])
+
+    def drain_events(self) -> list[DetectionEvent]:
+        cap = 4096 * 4
+        buf = (ctypes.c_int * cap)()
+        events = []
+        while True:
+            count = self._lib.gfs_drain_events(self._h, buf, cap)
+            for i in range(count):
+                events.append(
+                    DetectionEvent(
+                        round=buf[i * 4 + 0],
+                        observer=buf[i * 4 + 1],
+                        subject=buf[i * 4 + 2],
+                        false_positive=bool(buf[i * 4 + 3]),
+                    )
+                )
+            if count < cap // 4:
+                return events
+
+    def close(self) -> None:
+        if self._h is not None:
+            self._lib.gfs_cluster_destroy(self._h)
+            self._h = None
+
+    def __enter__(self) -> "NativeUdpDetector":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

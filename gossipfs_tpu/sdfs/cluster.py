@@ -183,13 +183,15 @@ class SDFSCluster:
             # one version behind): only a source at the plan's version may
             # seed copies, else old bytes get re-stamped as current
             blob = None
-            for src in plan.survivors:  # plan.source == first survivor in reach
+            used_source = plan.source  # == first survivor in reach
+            for src in plan.survivors:
                 if (
                     src in self.reachable
                     and self.stores[src].version(plan.file) >= plan.version
                 ):
                     blob = self.stores[src].get(plan.file)
                     if blob is not None:
+                        used_source = src
                         break
             if blob is None:
                 continue
@@ -200,7 +202,11 @@ class SDFSCluster:
                     copied.append(node)
             self.master.commit_repair(plan.file, list(plan.survivors) + copied)
             if copied:
+                # report the survivor that actually served the bytes, which
+                # can differ from plan.source (stale/empty-source fallthrough)
                 executed.append(
-                    dataclasses.replace(plan, new_nodes=tuple(copied))
+                    dataclasses.replace(
+                        plan, source=used_source, new_nodes=tuple(copied)
+                    )
                 )
         return executed

@@ -13,7 +13,7 @@ import base64
 
 import grpc
 
-from gossipfs_tpu.shim.service import SERVICE, _deser, _ser
+from gossipfs_tpu.shim.wire import SERVICE, deser as _deser, ser as _ser
 
 
 class ShimClient:

@@ -35,7 +35,6 @@ ShowMetadata matching the CLI surface (README.md:8-29).
 from __future__ import annotations
 
 import base64
-import json
 import threading
 from concurrent import futures
 
@@ -43,16 +42,9 @@ import grpc
 
 from gossipfs_tpu.cosim import CoSim
 from gossipfs_tpu.sdfs import election
+from gossipfs_tpu.shim.wire import SERVICE, deser as _deser, ser as _ser
 
-SERVICE = "gossipfs.Shim"
-
-
-def _ser(obj) -> bytes:
-    return json.dumps(obj).encode("utf-8")
-
-
-def _deser(data: bytes):
-    return json.loads(data.decode("utf-8")) if data else {}
+__all__ = ["SERVICE", "ShimServicer", "ShimServer"]
 
 
 class ShimServicer:
@@ -96,7 +88,12 @@ class ShimServicer:
             return {"round": self.sim.round}
 
     def Events(self, req, ctx):
+        """Detection events from cursor ``since`` (default 0) on; the reply's
+        ``next`` is the cursor for the following poll, so long-running
+        monitors don't re-download (or double-count) the whole history."""
+        since = int(req.get("since", 0))
         with self._lock:
+            events = self.sim.events[since:]
             return {
                 "events": [
                     {
@@ -105,8 +102,9 @@ class ShimServicer:
                         "subject": e.subject,
                         "false_positive": e.false_positive,
                     }
-                    for e in self.sim.events
-                ]
+                    for e in events
+                ],
+                "next": since + len(events),
             }
 
     # -- the 12 reference RPCs --------------------------------------------

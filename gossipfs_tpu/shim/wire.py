@@ -1,0 +1,22 @@
+"""Shared shim wire definitions: service name + JSON codec.
+
+Dependency-free on purpose: the client (``shim/client.py``) must stay a thin
+process that imports neither the server stack nor jax — only this module and
+``grpc``.  Messages are JSON dicts; the gRPC method path is
+``/gossipfs.Shim/<Method>`` (see shim/service.py for the method map onto the
+reference's net/rpc surface, server/server.go:19-251).
+"""
+
+from __future__ import annotations
+
+import json
+
+SERVICE = "gossipfs.Shim"
+
+
+def ser(obj) -> bytes:
+    return json.dumps(obj).encode("utf-8")
+
+
+def deser(data: bytes):
+    return json.loads(data.decode("utf-8")) if data else {}

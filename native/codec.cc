@@ -1,0 +1,67 @@
+#include "codec.h"
+
+#include <cstdlib>
+#include <sstream>
+
+namespace gossipfs {
+namespace {
+
+std::vector<std::string> Split(const std::string& s, const std::string& sep) {
+  std::vector<std::string> out;
+  size_t start = 0;
+  while (true) {
+    size_t pos = s.find(sep, start);
+    if (pos == std::string::npos) {
+      out.push_back(s.substr(start));
+      return out;
+    }
+    out.push_back(s.substr(start, pos - start));
+    start = pos + sep.size();
+  }
+}
+
+}  // namespace
+
+std::string EncodeMembers(const std::vector<MemberEntry>& members) {
+  std::ostringstream out;
+  bool first = true;
+  for (const auto& m : members) {
+    if (!first) out << kEntrySep;
+    first = false;
+    out << m.addr << kFieldSep << m.hb << kFieldSep << m.ts;
+  }
+  return out.str();
+}
+
+std::vector<MemberEntry> DecodeMembers(const std::string& payload) {
+  std::vector<MemberEntry> out;
+  if (payload.empty()) return out;
+  for (const auto& chunk : Split(payload, kEntrySep)) {
+    auto fields = Split(chunk, kFieldSep);
+    if (fields.size() < 2 || fields[0].empty()) continue;
+    char* end = nullptr;
+    double hb = std::strtod(fields[1].c_str(), &end);
+    if (end == fields[1].c_str()) continue;  // non-numeric hb: skip
+    MemberEntry m;
+    m.addr = fields[0];
+    m.hb = static_cast<long long>(hb);
+    m.ts = fields.size() >= 3 ? std::strtod(fields[2].c_str(), nullptr) : 0.0;
+    out.push_back(std::move(m));
+  }
+  return out;
+}
+
+std::string EncodeControl(const std::string& addr, const std::string& verb) {
+  return addr + kCmdSep + verb;
+}
+
+std::optional<ControlMsg> DecodeControl(const std::string& payload) {
+  size_t pos = payload.find(kCmdSep);
+  if (pos == std::string::npos) return std::nullopt;
+  ControlMsg msg;
+  msg.arg = payload.substr(0, pos);
+  msg.verb = payload.substr(pos + sizeof(kCmdSep) - 1);
+  return msg;
+}
+
+}  // namespace gossipfs

@@ -1,0 +1,49 @@
+// Wire codec for the gossip membership protocol.
+//
+// Byte-for-byte the reference's framing (reference: slave/slave.go:365-385):
+// membership lists are entries joined by "<#ENTRY#>" with fields joined by
+// "<#INFO#>" (address, heartbeat count, timestamp); control datagrams are
+// "addr<CMD>VERB" with VERB in {JOIN, LEAVE, REMOVE} (slave.go:293, 218).
+// This is the native (C++) half of the framework's runtime: the same frames
+// the Python asyncio parity path (gossipfs_tpu/detector/udp.py) speaks.
+
+#pragma once
+
+#include <optional>
+#include <string>
+#include <vector>
+
+namespace gossipfs {
+
+inline constexpr char kEntrySep[] = "<#ENTRY#>";
+inline constexpr char kFieldSep[] = "<#INFO#>";
+inline constexpr char kCmdSep[] = "<CMD>";
+
+struct MemberEntry {
+  std::string addr;
+  long long hb = 0;
+  double ts = 0.0;  // sender-local timestamp; receivers re-stamp locally
+};
+
+struct ControlMsg {
+  std::string arg;   // the address the verb applies to
+  std::string verb;  // JOIN | LEAVE | REMOVE
+};
+
+// Membership list -> wire string (encode, slave.go:365-373).
+std::string EncodeMembers(const std::vector<MemberEntry>& members);
+
+// Wire string -> entries (decode, slave.go:375-385).  Malformed chunks
+// (fewer than 2 fields, non-numeric hb) are skipped, like the reference's
+// silent parse behavior.
+std::vector<MemberEntry> DecodeMembers(const std::string& payload);
+
+// Control framing: "addr<CMD>VERB".
+std::string EncodeControl(const std::string& addr, const std::string& verb);
+
+// Returns the control message if the payload contains "<CMD>", else nullopt
+// (in which case the payload is a membership list — GetMsg's dispatch rule,
+// slave.go:207-248).
+std::optional<ControlMsg> DecodeControl(const std::string& payload);
+
+}  // namespace gossipfs

@@ -1,0 +1,576 @@
+// Native gossip runtime: N protocol nodes over real localhost UDP sockets,
+// driven by one epoll loop — the C++ equivalent of the reference's Go
+// runtime (goroutine heartbeat driver main.go:27-33, blocking UDP receive
+// loop slave/slave.go:207-248), for the BASELINE config-1 parity path.
+//
+// Protocol semantics mirror the reference exactly (and the Python asyncio
+// twin, gossipfs_tpu/detector/udp.py):
+//   - join through the introducer, which appends and pushes its full list to
+//     every member (addNewMember, slave.go:250-274)
+//   - per-period tick: refresh-only below min_group (slave.go:504-509), bump
+//     own heartbeat, detect members with hb > 1 silent past t_fail periods
+//     (slave.go:460-476), REMOVE broadcast (slave.go:338-363), fail-list
+//     cooldown expiry (slave.go:484-497), then full-list push to ring
+//     neighbours at sorted positions self-1, self+1, self+2 (slave.go:515-542)
+//   - merge: shared members take max heartbeat + LOCAL timestamp; unknown
+//     members are added unless on the fail list (slave.go:414-440)
+//
+// Exposed through a C ABI (extern "C") for ctypes — see gossipfs_tpu/native.py.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "codec.h"
+
+namespace gossipfs {
+namespace {
+
+double MonotonicNow() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+struct Member {
+  long long hb = 0;
+  double ts = 0.0;
+};
+
+struct DetectionEvent {
+  int round;
+  int observer;
+  int subject;
+  int false_positive;
+};
+
+struct Config {
+  int n = 10;
+  int base_port = 19000;
+  double period = 0.05;  // seconds per heartbeat round
+  int t_fail = 5;        // periods of silence before declaring failure
+  int t_cooldown = 5;    // fail-list suppression periods
+  int min_group = 4;     // below this size: refresh-only
+  bool fresh_cooldown = false;  // stamp fail-list entries at removal time
+  int introducer = 0;
+};
+
+class Cluster;
+
+class Node {
+ public:
+  Node(Cluster* cluster, int idx, int port);
+  ~Node() { Close(); }
+
+  bool Open();   // bind the UDP socket
+  void Close();
+
+  void HandleDatagram(const std::string& payload);
+  void Tick(double now);
+  void StopGraceful();  // LEAVE broadcast then die
+  void StopCrash();     // silent death (CTRL+C)
+  void ResetState();    // fresh process state for a rejoin
+
+  int fd() const { return fd_; }
+  int idx() const { return idx_; }
+  bool alive() const { return alive_; }
+  const std::string& addr() const { return addr_; }
+  std::vector<std::string> MemberAddrs() const;
+
+ private:
+  void Send(const std::string& peer_addr, const std::string& msg);
+  void AddMember(const std::string& addr, double now);   // introducer path
+  void RemoveMember(const std::string& addr, double now);
+  void Merge(const std::vector<MemberEntry>& remote, double now);
+  std::string EncodeSelf() const;
+
+  Cluster* cluster_;
+  int idx_;
+  int port_;
+  std::string addr_;
+  int fd_ = -1;
+  bool alive_ = false;
+  std::map<std::string, Member> members_;     // sorted: ring order by address
+  std::map<std::string, double> fail_list_;   // addr -> cooldown-start ts
+
+  friend class Cluster;
+};
+
+class Cluster {
+ public:
+  explicit Cluster(const Config& cfg) : cfg_(cfg) {
+    nodes_.reserve(cfg.n);
+    for (int i = 0; i < cfg.n; ++i) {
+      nodes_.emplace_back(new Node(this, i, cfg.base_port + i));
+      addr_to_idx_[nodes_.back()->addr()] = i;
+    }
+  }
+  ~Cluster() { Stop(); }
+
+  bool Start();
+  void Stop();
+
+  // Control verbs (thread-safe; callable from Python while the loop runs).
+  void Crash(int i);
+  void Leave(int i);
+  void Join(int i);
+
+  // Blocks for `rounds` heartbeat periods of wall time (real-time runtime).
+  void Advance(int rounds);
+
+  int Round() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return round_;
+  }
+  int Membership(int observer, int* out, int cap);
+  int AliveNodes(int* out, int cap);
+  int DrainEvents(int* out, int cap);  // quadruples per event
+
+  const Config& cfg() const { return cfg_; }
+  void RecordDetection(int observer, const std::string& subject_addr) {
+    auto it = addr_to_idx_.find(subject_addr);
+    if (it == addr_to_idx_.end()) return;
+    events_.push_back(DetectionEvent{round_, observer, it->second,
+                                     nodes_[it->second]->alive() ? 1 : 0});
+  }
+  int IdxOf(const std::string& addr) const {
+    auto it = addr_to_idx_.find(addr);
+    return it == addr_to_idx_.end() ? -1 : it->second;
+  }
+
+ private:
+  void LoopBody();
+
+  Config cfg_;
+  std::vector<std::unique_ptr<Node>> nodes_;
+  std::map<std::string, int> addr_to_idx_;
+  std::vector<DetectionEvent> events_;
+  std::mutex mu_;  // guards all protocol state; the loop thread holds it
+                   // while processing one batch of datagrams / one tick
+  std::thread loop_;
+  std::atomic<bool> running_{false};
+  int epoll_fd_ = -1;
+  int round_ = 0;
+  double next_tick_ = 0.0;
+};
+
+// ---------------------------------------------------------------------------
+// Node
+
+Node::Node(Cluster* cluster, int idx, int port)
+    : cluster_(cluster), idx_(idx), port_(port) {
+  addr_ = "127.0.0.1:" + std::to_string(port);
+}
+
+bool Node::Open() {
+  fd_ = ::socket(AF_INET, SOCK_DGRAM | SOCK_NONBLOCK, 0);
+  if (fd_ < 0) return false;
+  int one = 1;
+  ::setsockopt(fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in sa{};
+  sa.sin_family = AF_INET;
+  sa.sin_port = htons(static_cast<uint16_t>(port_));
+  sa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  if (::bind(fd_, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) != 0) {
+    ::close(fd_);
+    fd_ = -1;
+    return false;
+  }
+  return true;
+}
+
+void Node::Close() {
+  if (fd_ >= 0) {
+    ::close(fd_);
+    fd_ = -1;
+  }
+}
+
+void Node::ResetState() {
+  members_.clear();
+  fail_list_.clear();
+  // a fresh process knows only itself (InitMembership, slave.go:161-167)
+  members_[addr_] = Member{0, MonotonicNow()};
+  alive_ = true;
+}
+
+void Node::Send(const std::string& peer_addr, const std::string& msg) {
+  if (fd_ < 0) return;
+  size_t colon = peer_addr.rfind(':');
+  if (colon == std::string::npos) return;
+  // wire-derived addresses are untrusted: validate the port and IP parses
+  // and skip bad entries (like DecodeMembers does for hb) — an exception
+  // here would terminate the host process from the epoll thread
+  const std::string port_text = peer_addr.substr(colon + 1);
+  char* end = nullptr;
+  long port = std::strtol(port_text.c_str(), &end, 10);
+  if (end == port_text.c_str() || *end != '\0' || port <= 0 || port > 65535)
+    return;
+  sockaddr_in sa{};
+  sa.sin_family = AF_INET;
+  sa.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, peer_addr.substr(0, colon).c_str(),
+                  &sa.sin_addr) != 1)
+    return;
+  ::sendto(fd_, msg.data(), msg.size(), 0, reinterpret_cast<sockaddr*>(&sa),
+           sizeof(sa));
+}
+
+std::string Node::EncodeSelf() const {
+  std::vector<MemberEntry> entries;
+  entries.reserve(members_.size());
+  for (const auto& [addr, m] : members_)
+    entries.push_back(MemberEntry{addr, m.hb, m.ts});
+  return EncodeMembers(entries);
+}
+
+void Node::HandleDatagram(const std::string& payload) {
+  if (!alive_) return;
+  double now = MonotonicNow();
+  if (auto ctrl = DecodeControl(payload)) {
+    if (ctrl->verb == "JOIN") {
+      AddMember(ctrl->arg, now);
+    } else if (ctrl->verb == "LEAVE" || ctrl->verb == "REMOVE") {
+      RemoveMember(ctrl->arg, now);
+    }
+    return;
+  }
+  Merge(DecodeMembers(payload), now);
+}
+
+void Node::AddMember(const std::string& addr, double now) {
+  // introducer path: append at hb=0, push the full list to every member
+  // (addNewMember, slave.go:250-274)
+  if (members_.find(addr) == members_.end()) members_[addr] = Member{0, now};
+  std::string msg = EncodeSelf();
+  for (const auto& [peer, m] : members_)
+    if (peer != addr_) Send(peer, msg);
+}
+
+void Node::RemoveMember(const std::string& addr, double now) {
+  auto it = members_.find(addr);
+  if (it == members_.end()) return;
+  if (fail_list_.find(addr) == fail_list_.end()) {
+    // faithful mode keeps the entry's (stale) timestamp on the fail list
+    // (removeMember appends the live struct, slave.go:276-286);
+    // fresh_cooldown stamps removal time for a real suppression window
+    fail_list_[addr] = cluster_->cfg().fresh_cooldown ? now : it->second.ts;
+  }
+  members_.erase(it);
+}
+
+void Node::Merge(const std::vector<MemberEntry>& remote, double now) {
+  // anti-entropy max-merge with LOCAL re-stamping (slave.go:414-440)
+  for (const auto& entry : remote) {
+    auto it = members_.find(entry.addr);
+    if (it != members_.end()) {
+      if (entry.hb > it->second.hb) {
+        it->second.hb = entry.hb;
+        it->second.ts = now;
+      }
+    } else if (fail_list_.find(entry.addr) == fail_list_.end()) {
+      members_[entry.addr] = Member{entry.hb, now};
+    }
+  }
+}
+
+void Node::Tick(double now) {
+  if (!alive_) return;
+  const Config& cfg = cluster_->cfg();
+  if (static_cast<int>(members_.size()) < cfg.min_group) {
+    for (auto& [addr, m] : members_) m.ts = now;  // refresh-only
+    return;
+  }
+  auto self = members_.find(addr_);
+  if (self != members_.end()) {
+    self->second.hb += 1;
+    self->second.ts = now;
+  }
+  // failure detection (slave.go:460-476)
+  double t_fail = cfg.t_fail * cfg.period;
+  std::vector<std::string> failed;
+  for (const auto& [addr, m] : members_) {
+    if (addr == addr_) continue;
+    if (m.hb > 1 && m.ts < now - t_fail) failed.push_back(addr);
+  }
+  for (const auto& addr : failed) {
+    RemoveMember(addr, now);
+    cluster_->RecordDetection(idx_, addr);
+    std::string msg = EncodeControl(addr, "REMOVE");
+    for (const auto& [peer, m] : members_)
+      if (peer != addr_) Send(peer, msg);
+  }
+  // fail-list cooldown expiry (slave.go:484-497)
+  double t_cool = cfg.t_cooldown * cfg.period;
+  for (auto it = fail_list_.begin(); it != fail_list_.end();) {
+    if (it->second < now - t_cool)
+      it = fail_list_.erase(it);
+    else
+      ++it;
+  }
+  // ring push to sorted list positions self-1, self+1, self+2
+  // (slave.go:515-542); std::map iteration order == sorted addresses
+  if (members_.find(addr_) == members_.end()) return;  // removed-self
+  std::vector<const std::string*> ordered;
+  ordered.reserve(members_.size());
+  for (const auto& [addr, m] : members_) ordered.push_back(&addr);
+  int n = static_cast<int>(ordered.size());
+  int self_i = 0;
+  for (int i = 0; i < n; ++i)
+    if (*ordered[i] == addr_) self_i = i;
+  std::string msg = EncodeSelf();
+  for (int off : {-1, 1, 2}) {
+    const std::string& peer = *ordered[((self_i + off) % n + n) % n];
+    if (peer != addr_) Send(peer, msg);
+  }
+}
+
+void Node::StopGraceful() {
+  if (alive_) {
+    std::string msg = EncodeControl(addr_, "LEAVE");
+    for (const auto& [peer, m] : members_)
+      if (peer != addr_) Send(peer, msg);
+  }
+  alive_ = false;
+}
+
+void Node::StopCrash() { alive_ = false; }
+
+std::vector<std::string> Node::MemberAddrs() const {
+  std::vector<std::string> out;
+  out.reserve(members_.size());
+  for (const auto& [addr, m] : members_) out.push_back(addr);
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// Cluster
+
+bool Cluster::Start() {
+  epoll_fd_ = ::epoll_create1(0);
+  if (epoll_fd_ < 0) return false;
+  for (auto& node : nodes_) {
+    if (!node->Open()) return false;
+    node->ResetState();
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.u32 = static_cast<uint32_t>(node->idx());
+    ::epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, node->fd(), &ev);
+  }
+  // everyone joins through the introducer (slave.go:288-308)
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    Node* intro = nodes_[cfg_.introducer].get();
+    for (auto& node : nodes_)
+      if (node->idx() != cfg_.introducer)
+        node->Send(intro->addr(), EncodeControl(node->addr(), "JOIN"));
+    next_tick_ = MonotonicNow() + cfg_.period;
+  }
+  running_ = true;
+  loop_ = std::thread([this] {
+    while (running_) LoopBody();
+  });
+  return true;
+}
+
+void Cluster::LoopBody() {
+  epoll_event events[64];
+  double now = MonotonicNow();
+  double wait_s = next_tick_ - now;
+  int timeout_ms = wait_s > 0 ? static_cast<int>(wait_s * 1000) + 1 : 0;
+  int nfds = ::epoll_wait(epoll_fd_, events, 64, std::min(timeout_ms, 50));
+  std::lock_guard<std::mutex> lk(mu_);
+  char buf[65536];
+  for (int e = 0; e < nfds; ++e) {
+    Node* node = nodes_[events[e].data.u32].get();
+    while (true) {
+      ssize_t len = ::recv(node->fd(), buf, sizeof(buf), 0);
+      if (len <= 0) break;
+      node->HandleDatagram(std::string(buf, static_cast<size_t>(len)));
+    }
+  }
+  now = MonotonicNow();
+  if (now >= next_tick_) {
+    for (auto& node : nodes_) node->Tick(now);
+    round_ += 1;
+    next_tick_ += cfg_.period;
+    if (next_tick_ < now) next_tick_ = now + cfg_.period;  // fell behind
+  }
+}
+
+void Cluster::Stop() {
+  if (running_.exchange(false)) loop_.join();
+  if (epoll_fd_ >= 0) {
+    ::close(epoll_fd_);
+    epoll_fd_ = -1;
+  }
+  for (auto& node : nodes_) node->Close();
+}
+
+void Cluster::Crash(int i) {
+  std::lock_guard<std::mutex> lk(mu_);
+  nodes_[i]->StopCrash();
+}
+
+void Cluster::Leave(int i) {
+  std::lock_guard<std::mutex> lk(mu_);
+  nodes_[i]->StopGraceful();
+}
+
+void Cluster::Join(int i) {
+  std::lock_guard<std::mutex> lk(mu_);
+  Node* node = nodes_[i].get();
+  if (!node->alive()) node->ResetState();
+  // JOIN to the introducer; lost if the introducer is down (SPOF kept,
+  // slave.go:22)
+  node->Send(nodes_[cfg_.introducer]->addr(),
+             EncodeControl(node->addr(), "JOIN"));
+}
+
+void Cluster::Advance(int rounds) {
+  int target;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    target = round_ + rounds;
+  }
+  while (running_) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (round_ >= target) return;
+    }
+    std::this_thread::sleep_for(
+        std::chrono::duration<double>(cfg_.period / 4));
+  }
+}
+
+int Cluster::Membership(int observer, int* out, int cap) {
+  std::lock_guard<std::mutex> lk(mu_);
+  std::vector<int> ids;
+  for (const auto& addr : nodes_[observer]->MemberAddrs()) {
+    int idx = IdxOf(addr);
+    if (idx >= 0) ids.push_back(idx);
+  }
+  std::sort(ids.begin(), ids.end());
+  int n = std::min(static_cast<int>(ids.size()), cap);
+  std::copy(ids.begin(), ids.begin() + n, out);
+  return n;
+}
+
+int Cluster::AliveNodes(int* out, int cap) {
+  std::lock_guard<std::mutex> lk(mu_);
+  int count = 0;
+  for (const auto& node : nodes_)
+    if (node->alive() && count < cap) out[count++] = node->idx();
+  return count;
+}
+
+int Cluster::DrainEvents(int* out, int cap) {
+  std::lock_guard<std::mutex> lk(mu_);
+  int n = std::min(static_cast<int>(events_.size()), cap / 4);
+  for (int i = 0; i < n; ++i) {
+    out[i * 4 + 0] = events_[i].round;
+    out[i * 4 + 1] = events_[i].observer;
+    out[i * 4 + 2] = events_[i].subject;
+    out[i * 4 + 3] = events_[i].false_positive;
+  }
+  events_.erase(events_.begin(), events_.begin() + n);
+  return n;
+}
+
+}  // namespace
+}  // namespace gossipfs
+
+// ---------------------------------------------------------------------------
+// C ABI for ctypes (gossipfs_tpu/native.py)
+
+extern "C" {
+
+void* gfs_cluster_create(int n, int base_port, double period_s, int t_fail,
+                         int t_cooldown, int min_group, int fresh_cooldown,
+                         int introducer) {
+  gossipfs::Config cfg;
+  cfg.n = n;
+  cfg.base_port = base_port;
+  cfg.period = period_s;
+  cfg.t_fail = t_fail;
+  cfg.t_cooldown = t_cooldown;
+  cfg.min_group = min_group;
+  cfg.fresh_cooldown = fresh_cooldown != 0;
+  cfg.introducer = introducer;
+  return new gossipfs::Cluster(cfg);
+}
+
+int gfs_cluster_start(void* h) {
+  return static_cast<gossipfs::Cluster*>(h)->Start() ? 0 : -1;
+}
+
+void gfs_cluster_destroy(void* h) {
+  delete static_cast<gossipfs::Cluster*>(h);
+}
+
+void gfs_crash(void* h, int i) { static_cast<gossipfs::Cluster*>(h)->Crash(i); }
+void gfs_leave(void* h, int i) { static_cast<gossipfs::Cluster*>(h)->Leave(i); }
+void gfs_join(void* h, int i) { static_cast<gossipfs::Cluster*>(h)->Join(i); }
+
+void gfs_advance(void* h, int rounds) {
+  static_cast<gossipfs::Cluster*>(h)->Advance(rounds);
+}
+
+int gfs_round(void* h) { return static_cast<gossipfs::Cluster*>(h)->Round(); }
+
+int gfs_membership(void* h, int observer, int* out, int cap) {
+  return static_cast<gossipfs::Cluster*>(h)->Membership(observer, out, cap);
+}
+
+int gfs_alive(void* h, int* out, int cap) {
+  return static_cast<gossipfs::Cluster*>(h)->AliveNodes(out, cap);
+}
+
+int gfs_drain_events(void* h, int* out, int cap) {
+  return static_cast<gossipfs::Cluster*>(h)->DrainEvents(out, cap);
+}
+
+// Codec surface for parity tests: input lines "addr hb ts\n", output the
+// wire string (and the reverse).  snprintf semantics: writes at most cap-1
+// bytes + NUL and returns the FULL required length, so callers can detect
+// truncation and retry with a bigger buffer.
+static int CopyOut(const std::string& text, char* out, int cap) {
+  int n = std::min(static_cast<int>(text.size()), cap - 1);
+  if (n > 0) std::memcpy(out, text.data(), static_cast<size_t>(n));
+  if (cap > 0) out[n] = '\0';
+  return static_cast<int>(text.size());
+}
+
+int gfs_codec_encode(const char* lines, char* out, int cap) {
+  std::vector<gossipfs::MemberEntry> entries;
+  std::istringstream in(lines);
+  std::string addr;
+  long long hb;
+  double ts;
+  while (in >> addr >> hb >> ts)
+    entries.push_back(gossipfs::MemberEntry{addr, hb, ts});
+  return CopyOut(gossipfs::EncodeMembers(entries), out, cap);
+}
+
+int gfs_codec_decode(const char* wire, char* out, int cap) {
+  auto entries = gossipfs::DecodeMembers(wire);
+  std::ostringstream os;
+  for (const auto& e : entries) os << e.addr << ' ' << e.hb << ' ' << e.ts << '\n';
+  return CopyOut(os.str(), out, cap);
+}
+
+}  // extern "C"

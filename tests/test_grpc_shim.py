@@ -38,8 +38,13 @@ def test_membership_verbs_roundtrip(shim):
     assert r == 13
     assert 5 not in client.alive_nodes()
     assert 5 not in client.lsm(0)
-    events = client.call("Events")["events"]
+    resp = client.call("Events")
+    events = resp["events"]
     assert any(e["subject"] == 5 and not e["false_positive"] for e in events)
+    # cursor semantics: polling from `next` returns only new events
+    follow_up = client.call("Events", since=resp["next"])
+    assert follow_up["events"] == []
+    assert follow_up["next"] == resp["next"]
 
 
 def test_put_get_delete_ls_store(shim):

@@ -1,0 +1,156 @@
+"""Native (C++) gossip runtime: codec parity + live epoll-engine behavior.
+
+The C++ engine (native/engine.cc) must speak exactly the wire format and
+protocol semantics of the Python asyncio parity path (detector/udp.py), both
+mirroring the reference (slave/slave.go).  Timing-dependent tests use generous
+periods for the 1-core box.
+"""
+
+from __future__ import annotations
+
+import shutil
+
+import pytest
+
+if shutil.which("g++") is None and shutil.which("make") is None:
+    pytest.skip("no native toolchain", allow_module_level=True)
+
+from gossipfs_tpu import native
+from gossipfs_tpu.detector.udp import ENTRY_SEP, FIELD_SEP, UdpNode
+
+
+class TestCodecParity:
+    def test_encode_matches_python_framing(self):
+        entries = [
+            ("127.0.0.1:8000", 17, 3.5),
+            ("127.0.0.1:8001", 0, 0.0),
+        ]
+        wire = native.codec_encode(entries)
+        assert ENTRY_SEP in wire and FIELD_SEP in wire
+        # the Python decoder reads the C++ encoder's output
+        decoded = UdpNode._decode(wire)
+        assert decoded == [("127.0.0.1:8000", 17), ("127.0.0.1:8001", 0)]
+
+    def test_cpp_decodes_python_style_wire(self):
+        wire = ENTRY_SEP.join(
+            f"addr{i}{FIELD_SEP}{i * 3}{FIELD_SEP}{i}.25" for i in range(5)
+        )
+        decoded = native.codec_decode(wire)
+        assert [(a, hb) for a, hb, _ in decoded] == [
+            (f"addr{i}", i * 3) for i in range(5)
+        ]
+
+    def test_roundtrip(self):
+        entries = [(f"10.0.0.{i}:8000", i * 7, float(i)) for i in range(1, 9)]
+        assert native.codec_decode(native.codec_encode(entries)) == entries
+
+    def test_malformed_chunks_skipped(self):
+        wire = f"good{FIELD_SEP}5{FIELD_SEP}1.0{ENTRY_SEP}bad-no-fields{ENTRY_SEP}x{FIELD_SEP}NaNish"
+        decoded = native.codec_decode(wire)
+        assert decoded[0][:2] == ("good", 5)
+        assert all(a != "bad-no-fields" for a, _, _ in decoded)
+
+
+class TestNativeEngine:
+    def test_converges_detects_and_rejoins(self):
+        with native.NativeUdpDetector(
+            n=8, base_port=19500, period=0.1, fresh_cooldown=True
+        ) as det:
+            det.advance(4)
+            # full convergence: everyone sees everyone
+            for obs in range(8):
+                assert det.membership(obs) == list(range(8))
+            assert det.alive_nodes() == list(range(8))
+
+            det.crash(5)
+            det.advance(12)  # t_fail=5 periods + dissemination slack
+            assert 5 not in det.alive_nodes()
+            events = det.drain_events()
+            assert any(
+                e.subject == 5 and not e.false_positive for e in events
+            ), events
+            for obs in (0, 3, 7):
+                assert 5 not in det.membership(obs)
+
+            # rejoin through the introducer; cooldown must expire first
+            det.advance(8)
+            det.join(5)
+            det.advance(10)
+            assert 5 in det.alive_nodes()
+            assert 5 in det.membership(0)
+
+    def test_three_engine_detection_parity(self):
+        """Native C++, Python asyncio-UDP, and the tensor sim all detect a
+        crash in the same round band: crash at round r with warm heartbeats
+        -> first detection within [r + t_fail - 1, r + t_fail + slack]
+        (slack covers real-socket scheduling jitter; the sim is exact —
+        tests/test_golden_parity.py pins it per-round)."""
+        import asyncio
+
+        import jax.numpy as jnp
+
+        from gossipfs_tpu.config import SimConfig
+        from gossipfs_tpu.core.rounds import run_rounds
+        from gossipfs_tpu.core.state import RoundEvents, init_state
+        from gossipfs_tpu.detector.udp import UdpCluster
+
+        t_fail, n, crash_at, slack = 5, 10, 8, 4
+        bands = {}
+
+        # native C++ engine
+        with native.NativeUdpDetector(
+            n=n, base_port=19700, period=0.1, fresh_cooldown=True
+        ) as det:
+            det.advance(crash_at)
+            r0 = det.round
+            det.crash(4)
+            det.advance(t_fail + slack + 2)
+            events = [e for e in det.drain_events() if e.subject == 4]
+            assert events, "native engine never detected the crash"
+            bands["native"] = min(e.round for e in events) - r0
+
+        # python asyncio engine
+        async def py_scenario():
+            c = UdpCluster(n=n, base_port=19800, period=0.1, fresh_cooldown=True)
+            try:
+                await c.start_all()
+                await c.run(crash_at)
+                r0 = c._round
+                c.crash(4)
+                await c.run(t_fail + slack + 2)
+                return [e for e in c.drain_events() if e.subject == 4], r0
+            finally:
+                c.stop_all()
+
+        events, r0 = asyncio.run(py_scenario())
+        assert events, "python engine never detected the crash"
+        bands["python"] = min(e.round for e in events) - r0
+
+        # tensor sim (ring parity config, same constants)
+        cfg = SimConfig(n=n, t_fail=t_fail, fresh_cooldown=True)
+        rounds = crash_at + t_fail + slack + 2
+        crash = jnp.zeros((rounds, n), dtype=bool).at[crash_at, 4].set(True)
+        zeros = jnp.zeros((rounds, n), dtype=bool)
+        events_sched = RoundEvents(crash=crash, leave=zeros, join=zeros)
+        import jax
+
+        _, carry, _ = run_rounds(
+            init_state(cfg), cfg, rounds, jax.random.PRNGKey(0),
+            events=events_sched,
+        )
+        bands["sim"] = int(carry.first_detect[4]) - crash_at
+
+        for engine, rel in bands.items():
+            assert t_fail - 1 <= rel <= t_fail + slack, (engine, bands)
+
+    def test_graceful_leave_disseminates(self):
+        with native.NativeUdpDetector(
+            n=6, base_port=19600, period=0.1, fresh_cooldown=True
+        ) as det:
+            det.advance(4)
+            det.leave(2)
+            det.advance(3)  # LEAVE broadcast: removal is immediate, no t_fail
+            assert 2 not in det.alive_nodes()
+            assert 2 not in det.membership(0)
+            # a voluntary leave is not a failure detection
+            assert all(e.subject != 2 for e in det.drain_events())

@@ -242,7 +242,12 @@ class TestCluster:
         assert c.stores[straggler].version("a.txt") == 1  # stale bytes kept
         # victim dies; straggler (back up) is the plan's first source
         c.update_membership([x for x in range(8) if x != victim])
-        c.fail_recover()
+        executed = c.fail_recover()
+        # the reported source is the survivor that actually served the bytes
+        for plan in executed:
+            if plan.file == "a.txt":
+                assert plan.source != straggler
+                assert c.stores[plan.source].version("a.txt") == 2
         for node in c.ls("a.txt"):
             blob = c.stores[node].get("a.txt")
             if c.stores[node].version("a.txt") == 2 and blob is not None:
