@@ -66,6 +66,9 @@ def main() -> None:
         remove_broadcast=False,
         fresh_cooldown=True,
         t_cooldown=12,
+        # the pallas DMA merge kernel (ops/merge_pallas.py) runs the hot op
+        # at the HBM ceiling (~4x XLA's gather); CPU keeps the XLA path
+        merge_kernel="pallas" if use_tpu else "xla",
     )
     key = jax.random.PRNGKey(0)
     state = init_state(cfg)

@@ -51,6 +51,13 @@ class SimConfig:
                                      # in gossip-only dissemination mode)
     introducer: int = 0              # node index playing the hardcoded introducer
                                      # (reference: slave/slave.go:22)
+    merge_kernel: str = "xla"        # "xla" | "pallas": implementation of the
+                                     # per-round fanout max-merge (the hot op).
+                                     # "pallas" is the hand-written TPU DMA
+                                     # kernel (ops/merge_pallas.py, ~4x the
+                                     # XLA gather's bandwidth); "pallas_interpret"
+                                     # runs the same kernel in interpreter mode
+                                     # (CPU tests only — slow)
 
     def __post_init__(self) -> None:
         if self.n < 2:
@@ -61,6 +68,8 @@ class SimConfig:
             raise ValueError("ring (parity) topology is defined for fanout=3")
         if self.t_fail < 1 or self.t_cooldown < 0:
             raise ValueError("t_fail >= 1 and t_cooldown >= 0 required")
+        if self.merge_kernel not in ("xla", "pallas", "pallas_interpret"):
+            raise ValueError(f"unknown merge_kernel: {self.merge_kernel!r}")
 
     @staticmethod
     def log_fanout(n: int) -> int:

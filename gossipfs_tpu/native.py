@@ -30,9 +30,12 @@ class NativeBuildError(RuntimeError):
 
 
 def _build() -> None:
-    proc = subprocess.run(
-        ["make", "-C", str(_NATIVE_DIR)], capture_output=True, text=True
-    )
+    try:
+        proc = subprocess.run(
+            ["make", "-C", str(_NATIVE_DIR)], capture_output=True, text=True
+        )
+    except FileNotFoundError as e:  # no make on PATH
+        raise NativeBuildError(f"native build needs make: {e}") from e
     if proc.returncode != 0:
         raise NativeBuildError(
             f"native build failed:\n{proc.stdout}\n{proc.stderr}"

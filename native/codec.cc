@@ -1,6 +1,8 @@
 #include "codec.h"
 
+#include <cmath>
 #include <cstdlib>
+#include <iomanip>
 #include <sstream>
 
 namespace gossipfs {
@@ -24,6 +26,10 @@ std::vector<std::string> Split(const std::string& s, const std::string& sep) {
 
 std::string EncodeMembers(const std::vector<MemberEntry>& members) {
   std::ostringstream out;
+  // full round-trip precision for the timestamp: receivers re-stamp locally
+  // (slave.go:426) so only addr/hb matter semantically, but a lossy default
+  // 6-significant-digit print would corrupt any uptime > ~1 day
+  out << std::setprecision(17);
   bool first = true;
   for (const auto& m : members) {
     if (!first) out << kEntrySep;
@@ -41,7 +47,8 @@ std::vector<MemberEntry> DecodeMembers(const std::string& payload) {
     if (fields.size() < 2 || fields[0].empty()) continue;
     char* end = nullptr;
     double hb = std::strtod(fields[1].c_str(), &end);
-    if (end == fields[1].c_str()) continue;  // non-numeric hb: skip
+    // skip non-numeric hb; NaN/inf would make the long long cast UB
+    if (end == fields[1].c_str() || !std::isfinite(hb)) continue;
     MemberEntry m;
     m.addr = fields[0];
     m.hb = static_cast<long long>(hb);

@@ -27,6 +27,7 @@
 #include <atomic>
 #include <chrono>
 #include <cstring>
+#include <iomanip>
 #include <map>
 #include <memory>
 #include <mutex>
@@ -569,6 +570,7 @@ int gfs_codec_encode(const char* lines, char* out, int cap) {
 int gfs_codec_decode(const char* wire, char* out, int cap) {
   auto entries = gossipfs::DecodeMembers(wire);
   std::ostringstream os;
+  os << std::setprecision(17);
   for (const auto& e : entries) os << e.addr << ' ' << e.hb << ' ' << e.ts << '\n';
   return CopyOut(os.str(), out, cap);
 }

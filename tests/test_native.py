@@ -12,7 +12,7 @@ import shutil
 
 import pytest
 
-if shutil.which("g++") is None and shutil.which("make") is None:
+if shutil.which("g++") is None or shutil.which("make") is None:
     pytest.skip("no native toolchain", allow_module_level=True)
 
 from gossipfs_tpu import native
@@ -44,11 +44,20 @@ class TestCodecParity:
         entries = [(f"10.0.0.{i}:8000", i * 7, float(i)) for i in range(1, 9)]
         assert native.codec_decode(native.codec_encode(entries)) == entries
 
+    def test_roundtrip_preserves_large_timestamps(self):
+        # monotonic clocks on long-uptime hosts exceed 1e5 s; sub-second
+        # resolution must survive the wire (full round-trip precision)
+        entries = [("10.0.0.1:8000", 42, 1785344960.123456)]
+        assert native.codec_decode(native.codec_encode(entries)) == entries
+
     def test_malformed_chunks_skipped(self):
         wire = f"good{FIELD_SEP}5{FIELD_SEP}1.0{ENTRY_SEP}bad-no-fields{ENTRY_SEP}x{FIELD_SEP}NaNish"
         decoded = native.codec_decode(wire)
         assert decoded[0][:2] == ("good", 5)
         assert all(a != "bad-no-fields" for a, _, _ in decoded)
+        # "NaNish" parses as NaN under strtod: entry must be skipped, not
+        # cast (undefined behavior) into a garbage heartbeat
+        assert all(a != "x" for a, _, _ in decoded)
 
 
 class TestNativeEngine:
