@@ -206,33 +206,33 @@ def _merge(
     over the gossip view (hb where the entry is in a sent message, -1
     otherwise); heartbeats are always >= 0, so ``best_hb >= 0`` is exactly
     "some peer's message contained this entry".  config.merge_kernel picks
-    the XLA gather loop (one [N, N] temp regardless of fanout) or the pallas
-    DMA kernel (ops/merge_pallas.py — the TPU fast path).
+    the XLA gather loop or the pallas DMA kernel (ops/merge_pallas.py — the
+    TPU fast path); shapes the kernel's tiling can't express fall back to
+    XLA.  One definition of the op serves both paths, so the kernel-parity
+    tests pin exactly what production runs.
     """
     hb, age, status, alive = state.hb, state.age, state.status, state.alive
 
-    if config.merge_kernel == "xla":
-        def body(f, acc):
-            best_hb, any_member = acc
-            k = lax.dynamic_index_in_dim(edges, f, axis=1, keepdims=False)  # [N]
-            ok = senders[k][:, None]                     # sender actually gossiped
-            s_member = (status[k, :] == MEMBER) & ok     # entry present in message
-            s_hb = jnp.where(s_member, hb[k, :], -1)
-            return jnp.maximum(best_hb, s_hb), any_member | s_member
+    from gossipfs_tpu.ops import merge_pallas
 
-        init = (
-            jnp.full(hb.shape, -1, dtype=hb.dtype),
-            jnp.zeros(hb.shape, dtype=bool),
-        )
-        best_hb, any_member = lax.fori_loop(0, edges.shape[1], body, init)
+    # the gossip view: what a sender's datagram contains for each subject
+    # (absent entries as -1 — heartbeats are never negative)
+    view = jnp.where((status == MEMBER) & senders[:, None], hb, -1)
+    interpret = config.merge_kernel == "pallas_interpret"
+    use_pallas = (
+        config.merge_kernel != "xla"
+        and merge_pallas.supported(state.n, edges.shape[1])
+        # the compiled kernel is Mosaic/TPU-only; "pallas" on a CPU/GPU
+        # backend (preset smoke-runs) falls back rather than failing to
+        # lower ("pallas_interpret" runs anywhere, for tests)
+        and (interpret or jax.default_backend() == "tpu")
+    )
+    if use_pallas:
+        best_hb = merge_pallas.fanout_max_merge(view, edges, interpret=interpret)
     else:
-        from gossipfs_tpu.ops import merge_pallas
-
-        view = jnp.where((status == MEMBER) & senders[:, None], hb, -1)
-        best_hb = merge_pallas.fanout_max_merge(
-            view, edges, interpret=(config.merge_kernel == "pallas_interpret")
-        )
-        any_member = best_hb >= 0
+        # XLA gather path: also the fallback for unsupported shapes/backends
+        best_hb = merge_pallas.fanout_max_merge_xla(view, edges)
+    any_member = best_hb >= 0
 
     recv = alive[:, None]
     advance = recv & (status == MEMBER) & (best_hb > hb)       # max-merge + stamp

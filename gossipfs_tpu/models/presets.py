@@ -61,8 +61,14 @@ def sim_10k_crash() -> Scenario:
 
 
 def sim_100k() -> Scenario:
-    """Config 4: 100k nodes, fanout log N, 5% churn + preemption (v5e-8)."""
-    n = 100_000
+    """Config 4: 100k nodes, fanout log N, 5% churn + preemption (v5e-8).
+
+    N is 102,400 — the first ">= 100k" count whose tiling (multiples of
+    4096) lets the pallas merge kernel (ops/merge_pallas.py) run at full
+    block sizes; a non-lane-aligned N would silently fall back to the XLA
+    gather path at a quarter of the bandwidth.
+    """
+    n = 102_400
     return Scenario(
         name="sim-100k",
         config=SimConfig(
@@ -72,6 +78,7 @@ def sim_100k() -> Scenario:
             remove_broadcast=False,
             fresh_cooldown=True,
             t_cooldown=12,
+            merge_kernel="pallas",
         ),
         rounds=60,
         crash_rate=0.05,

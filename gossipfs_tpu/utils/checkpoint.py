@@ -1,0 +1,78 @@
+"""Checkpoint/resume for long simulation runs.
+
+The reference has no checkpointing: a crashed master reconstructs metadata
+from surviving nodes' registries (``rebuild_file_meta``, reference:
+slave/slave.go:986-1043) and file durability comes from 4-way replication.
+The TPU build's sim state is a small closed pytree — ``SimState`` plus the
+PRNG key — so long 100k-member runs (SURVEY §5) checkpoint trivially through
+orbax, which also handles device-sharded arrays (the 100k state lives
+column-sharded across the mesh; orbax saves each shard from its device).
+
+Resume is exact: ``run_rounds`` derives every round's randomness by folding
+the key with ``state.round`` (core/rounds.py), so a restored (state, key)
+pair continues the identical trajectory — asserted by
+tests/test_checkpoint.py.
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+import jax
+import jax.numpy as jnp
+import orbax.checkpoint as ocp
+from jax.sharding import Mesh
+
+from gossipfs_tpu.config import SimConfig
+from gossipfs_tpu.core.state import SimState
+
+
+def save_checkpoint(
+    path: str | pathlib.Path, state: SimState, key: jax.Array
+) -> None:
+    """Write (state, key) under ``path`` (a directory, created fresh)."""
+    path = pathlib.Path(path).resolve()
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, {"state": state._asdict(), "key": key}, force=True)
+
+
+def _abstract_like(config: SimConfig, mesh: Mesh | None) -> dict:
+    n = config.n
+    shardings = None
+    if mesh is not None:
+        from gossipfs_tpu.parallel.mesh import state_shardings
+
+        shardings = state_shardings(mesh)
+
+    def spec(shape, dtype, sh):
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=sh)
+
+    sh = shardings or SimState(hb=None, age=None, status=None, alive=None, round=None)
+    state = SimState(
+        hb=spec((n, n), jnp.int32, sh.hb),
+        age=spec((n, n), jnp.int32, sh.age),
+        status=spec((n, n), jnp.int8, sh.status),
+        alive=spec((n,), jnp.bool_, sh.alive),
+        round=spec((), jnp.int32, sh.round),
+    )
+    return {
+        "state": state._asdict(),
+        # the key rides replicated so it composes with sharded state args
+        "key": spec((2,), jnp.uint32, sh.round),
+    }
+
+
+def restore_checkpoint(
+    path: str | pathlib.Path, config: SimConfig, mesh: Mesh | None = None
+) -> tuple[SimState, jax.Array]:
+    """Load (state, key) saved by ``save_checkpoint`` for this config's N.
+
+    Pass the run's ``mesh`` to restore every array directly onto its run
+    sharding ([N, N] tables column-sharded, vectors + key replicated) —
+    without it, orbax commits everything to one device and mixing the result
+    with mesh-sharded arrays in a jitted call is an error.
+    """
+    path = pathlib.Path(path).resolve()
+    with ocp.StandardCheckpointer() as ckptr:
+        restored = ckptr.restore(path, _abstract_like(config, mesh))
+    return SimState(**restored["state"]), restored["key"]

@@ -1,0 +1,67 @@
+"""Profiling — the observability the reference never had.
+
+The reference's entire performance tooling is one wall-clock print in ``Get``
+(reference: slave/slave.go:888-890).  Here: JAX profiler traces of the
+compiled round program (open in Perfetto / TensorBoard) and a slope-based
+round timer that is robust to fixed per-program dispatch overhead — on this
+image the TPU is reached through a network tunnel whose per-call latency
+dwarfs small kernels, so naive "time one call" numbers are garbage; timing
+two scan lengths and fitting the slope isolates true per-round device time
+(this is how the BASELINE kernel numbers were measured).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import pathlib
+import time
+from typing import Iterator
+
+import jax
+
+from gossipfs_tpu.config import SimConfig
+from gossipfs_tpu.core.rounds import run_rounds
+from gossipfs_tpu.core.state import SimState
+
+
+@contextlib.contextmanager
+def trace(log_dir: str | pathlib.Path) -> Iterator[None]:
+    """``with trace("/tmp/trace"):`` — wraps jax.profiler.trace."""
+    with jax.profiler.trace(str(log_dir)):
+        yield
+
+
+def time_rounds(
+    state: SimState,
+    config: SimConfig,
+    key: jax.Array,
+    *,
+    short: int = 2,
+    long: int = 10,
+    crash_rate: float = 0.0,
+    rejoin_rate: float = 0.0,
+) -> dict:
+    """Slope-timed per-round cost: (T(long) - T(short)) / (long - short).
+
+    Compiles both scan lengths first, then times one execution of each.
+    Returns seconds per round and rounds/sec, free of dispatch overhead.
+    """
+    def run(k: int) -> float:
+        out = run_rounds(
+            state, config, k, key, crash_rate=crash_rate, rejoin_rate=rejoin_rate
+        )
+        jax.block_until_ready(out[0])
+        t0 = time.perf_counter()
+        out = run_rounds(
+            state, config, k, key, crash_rate=crash_rate, rejoin_rate=rejoin_rate
+        )
+        jax.block_until_ready(out[0])
+        return time.perf_counter() - t0
+
+    t_short, t_long = run(short), run(long)
+    per_round = max((t_long - t_short) / (long - short), 1e-9)
+    return {
+        "seconds_per_round": per_round,
+        "rounds_per_sec": 1.0 / per_round,
+        "dispatch_overhead_s": max(t_short - short * per_round, 0.0),
+    }

@@ -1,0 +1,40 @@
+"""Profiling + distributed helpers (single-process behaviors)."""
+
+from __future__ import annotations
+
+import jax
+
+from gossipfs_tpu.config import SimConfig
+from gossipfs_tpu.core.state import init_state
+from gossipfs_tpu.parallel import distributed
+from gossipfs_tpu.utils.profiling import time_rounds, trace
+
+
+def test_time_rounds_reports_positive_rates():
+    cfg = SimConfig(n=64, topology="random", fanout=3, remove_broadcast=False,
+                    fresh_cooldown=True)
+    report = time_rounds(
+        init_state(cfg), cfg, jax.random.PRNGKey(0), short=2, long=6
+    )
+    assert report["seconds_per_round"] > 0
+    assert report["rounds_per_sec"] > 0
+    assert report["dispatch_overhead_s"] >= 0
+
+
+def test_trace_writes_profile(tmp_path):
+    cfg = SimConfig(n=16)
+    with trace(tmp_path):
+        jax.block_until_ready(init_state(cfg).hb)
+    assert any(tmp_path.rglob("*"))  # profiler emitted something
+
+
+def test_initialize_noop_single_process(monkeypatch):
+    for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES", "JAX_PROCESS_ID"):
+        monkeypatch.delenv(var, raising=False)
+    assert distributed.initialize() is False
+
+
+def test_global_mesh_covers_all_devices():
+    mesh = distributed.global_mesh()
+    assert mesh.devices.size == len(jax.devices())
+    assert mesh.axis_names == ("shard",)
