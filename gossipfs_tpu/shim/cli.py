@@ -8,7 +8,7 @@ Command surface matches README.md:8-29 plus fault/time controls the sim adds:
   put <local> <sdfs>                 write a file into SDFS (quorum write)
   get <sdfs> <local>                 read it back (quorum read + repair)
   delete <sdfs> / ls <sdfs> / store <n>
-  show_metadata                      master's file->replica map
+  show_metadata | check              master's file->replica map
   advance <r>                        advance simulated time by r rounds
   events                             detection events so far
   grep <regex>                       search the event log (MP1 legacy verb)
@@ -75,7 +75,8 @@ def dispatch(sim: CoSim, line: str, out=sys.stdout) -> bool:
             print(sim.cluster.ls(args[0]), file=out)
         elif cmd == "store":
             print(sim.cluster.store_listing(int(args[0])), file=out)
-        elif cmd == "show_metadata":
+        elif cmd in ("show_metadata", "check"):  # "check" = reference alias
+                                                 # (CheckInput, slave.go:608-610)
             for name, info in sim.cluster.master.files.items():
                 print(f"{name}: v{info.version} @ {info.node_list}", file=out)
         elif cmd == "events":

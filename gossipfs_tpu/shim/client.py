@@ -19,8 +19,15 @@ from gossipfs_tpu.shim.wire import SERVICE, deser as _deser, ser as _ser
 class ShimClient:
     """Thin dynamic proxy: ``client.call("GetFileInfo", file="x")``."""
 
-    def __init__(self, address: str, timeout: float = 30.0):
-        self.channel = grpc.insecure_channel(address)
+    def __init__(self, address: str, timeout: float = 30.0, max_message_mb: int = 64):
+        # match the server's raised message cap (multi-MB file payloads)
+        self.channel = grpc.insecure_channel(
+            address,
+            options=[
+                ("grpc.max_receive_message_length", max_message_mb * 1024 * 1024),
+                ("grpc.max_send_message_length", max_message_mb * 1024 * 1024),
+            ],
+        )
         self.timeout = timeout
         self._methods: dict[str, grpc.UnaryUnaryMultiCallable] = {}
 

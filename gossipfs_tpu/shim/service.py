@@ -298,9 +298,19 @@ class ShimServer:
         host: str = "127.0.0.1",
         auto_confirm: bool = False,
         max_workers: int = 8,
+        max_message_mb: int = 64,
     ):
         self.servicer = ShimServicer(sim, auto_confirm=auto_confirm)
-        self.server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+        # the reference's benchmark workload is multi-MB files (file1-10.txt,
+        # ~4 MB Wikipedia shards); raise gRPC's default 4 MB message cap so
+        # a whole-file Put/Get (base64-inflated ~1.33x) fits in one message
+        opts = [
+            ("grpc.max_receive_message_length", max_message_mb * 1024 * 1024),
+            ("grpc.max_send_message_length", max_message_mb * 1024 * 1024),
+        ]
+        self.server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers), options=opts
+        )
         self.server.add_generic_rpc_handlers((self.servicer.generic_handler(),))
         self.port = self.server.add_insecure_port(f"{host}:{port}")
         self.address = f"{host}:{self.port}"

@@ -61,6 +61,18 @@ def test_put_get_delete_ls_store(shim):
     assert client.ls("file1.txt") == []
 
 
+def test_multi_mb_payload_roundtrip(shim):
+    """The reference's benchmark workload is ~4 MB files (file1-10.txt);
+    a whole file must survive one Put/Get across the shim (the default
+    gRPC 4 MB message cap would reject the base64-inflated payload)."""
+    sim, client = shim
+    import os
+
+    payload = os.urandom(4 * 1024 * 1024)
+    assert client.put("file5.txt", payload)
+    assert client.get("file5.txt") == payload
+
+
 def test_write_write_conflict_window(shim):
     sim, client = shim
     assert client.put("f.txt", b"v1")
