@@ -1,0 +1,58 @@
+"""Sweep pallas merge-kernel tile parameters on the real chip.
+
+Produced the merge_block_r/merge_block_c/merge_slots defaults in config.py
+(see BASELINE.md): the kernel is DMA-descriptor-issue bound once the view is
+int16, so large column blocks win until the output block exhausts VMEM.
+
+Run: JAX_PLATFORMS=axon python -m gossipfs_tpu.bench.sweep_merge
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+
+import jax
+
+from gossipfs_tpu.config import SimConfig
+from gossipfs_tpu.core.rounds import run_rounds
+from gossipfs_tpu.core.state import init_state
+
+N, ROUNDS = 16_384, 50
+
+
+def timed(cfg: SimConfig, key: jax.Array) -> float:
+    state = init_state(cfg)
+    st, _, _ = run_rounds(state, cfg, ROUNDS, key, crash_rate=0.01)
+    jax.block_until_ready(st)
+    t0 = time.perf_counter()
+    st, _, _ = run_rounds(state, cfg, ROUNDS, key, crash_rate=0.01)
+    jax.block_until_ready(st)
+    return ROUNDS / (time.perf_counter() - t0)
+
+
+def main() -> None:
+    key = jax.random.PRNGKey(0)
+    results = []
+    for br, bc, slots in itertools.product(
+        (64, 128, 256), (4096, 8192, 16384), (2, 4, 8)
+    ):
+        cfg = SimConfig(
+            n=N, topology="random", fanout=SimConfig.log_fanout(N),
+            remove_broadcast=False, fresh_cooldown=True, t_cooldown=12,
+            merge_kernel="pallas", merge_block_r=br, merge_block_c=bc,
+            merge_slots=slots,
+        )
+        try:
+            rps = timed(cfg, key)
+        except Exception as e:  # VMEM exhaustion at large out blocks
+            print(f"br={br} bc={bc} slots={slots}: FAIL {type(e).__name__}", flush=True)
+            continue
+        results.append((rps, br, bc, slots))
+        print(f"br={br} bc={bc} slots={slots}: {rps:.1f} rounds/s", flush=True)
+    rps, br, bc, slots = max(results)
+    print(f"best: {rps:.1f} rounds/s at br={br} bc={bc} slots={slots}")
+
+
+if __name__ == "__main__":
+    main()

@@ -21,6 +21,19 @@ from typing import Literal
 
 Topology = Literal["ring", "random"]
 
+# The ``age`` lane is stored as int8 and saturates here: every protocol
+# comparison is against a small threshold (t_fail, t_cooldown), so any age
+# beyond the clamp behaves identically.  Kept < 127 so ``age + 1`` can never
+# overflow before the clamp is applied.
+AGE_CLAMP = 100
+
+# Per-subject heartbeat rebasing window for the gossip view (core/rounds.py
+# ``_merge``).  Gossipable entries lag the freshest copy of a subject's
+# counter by O(t_fail) rounds per hop; 16384 is orders of magnitude beyond any
+# reachable lag, and keeps the rebased view well inside int16 — which halves
+# the HBM traffic of the fanout max-merge, the round's dominant cost.
+REBASE_WINDOW = 16_384
+
 
 @dataclasses.dataclass(frozen=True)
 class SimConfig:
@@ -51,6 +64,11 @@ class SimConfig:
                                      # in gossip-only dissemination mode)
     introducer: int = 0              # node index playing the hardcoded introducer
                                      # (reference: slave/slave.go:22)
+    merge_block_r: int = 128         # pallas merge tile: receiver rows per block
+    merge_block_c: int = 8192        # pallas merge tile: subject columns per DMA —
+                                     # larger units amortize DMA descriptor issue,
+                                     # the kernel's limiter once the view is int16
+    merge_slots: int = 4             # pallas merge DMA double-buffer depth
     merge_kernel: str = "xla"        # "xla" | "pallas": implementation of the
                                      # per-round fanout max-merge (the hot op).
                                      # "pallas" is the hand-written TPU DMA
@@ -68,8 +86,21 @@ class SimConfig:
             raise ValueError("ring (parity) topology is defined for fanout=3")
         if self.t_fail < 1 or self.t_cooldown < 0:
             raise ValueError("t_fail >= 1 and t_cooldown >= 0 required")
+        if self.t_fail >= AGE_CLAMP or self.t_cooldown >= AGE_CLAMP:
+            raise ValueError(
+                f"t_fail and t_cooldown must be < AGE_CLAMP ({AGE_CLAMP}); "
+                "the age lane saturates there"
+            )
         if self.merge_kernel not in ("xla", "pallas", "pallas_interpret"):
             raise ValueError(f"unknown merge_kernel: {self.merge_kernel!r}")
+        for name, lo in (("merge_block_r", 8), ("merge_block_c", 128)):
+            v = getattr(self, name)
+            # the kernel shrinks blocks by halving until they tile N, which
+            # only terminates sanely for powers of two
+            if v < lo or (v & (v - 1)) != 0:
+                raise ValueError(f"{name} must be a power of two >= {lo}, got {v}")
+        if self.merge_slots < 2:
+            raise ValueError(f"merge_slots must be >= 2, got {self.merge_slots}")
 
     @staticmethod
     def log_fanout(n: int) -> int:

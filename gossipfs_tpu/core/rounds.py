@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from gossipfs_tpu.config import SimConfig
+from gossipfs_tpu.config import AGE_CLAMP, REBASE_WINDOW, SimConfig
 from gossipfs_tpu.core import topology
 from gossipfs_tpu.core.state import FAILED, MEMBER, UNKNOWN, RoundEvents, SimState
 
@@ -215,9 +215,28 @@ def _merge(
 
     from gossipfs_tpu.ops import merge_pallas
 
-    # the gossip view: what a sender's datagram contains for each subject
-    # (absent entries as -1 — heartbeats are never negative)
-    view = jnp.where((status == MEMBER) & senders[:, None], hb, -1)
+    # The gossip view: what a sender's datagram contains for each subject
+    # (absent entries as -1 — heartbeats are never negative).  Heartbeat
+    # counts are rebased per subject so the view fits int16, halving the HBM
+    # traffic of the F-way gather — the round's dominant cost.  The base is
+    # derived from *gossip-eligible* copies only: hb lanes of FAILED/UNKNOWN
+    # entries and dead nodes' frozen rows keep crash-time counters forever,
+    # and anchoring on those would mask a rejoining node's fresh hb=0
+    # entries out of gossip once the run is > REBASE_WINDOW rounds old.
+    # Gossip-eligible entries (MEMBER, so age <= t_fail at the holder) lag
+    # the freshest eligible copy by O(t_fail) per hop, so same-incarnation
+    # copies never fall REBASE_WINDOW behind.  The one reachable clamp: a
+    # rejoin while a zombie MEMBER copy of the old incarnation (counter
+    # > REBASE_WINDOW ahead) survives somewhere — the fresh entries drop out
+    # of gossip, but the reference's incarnation-free max-merge dominates
+    # those counts anyway (slave.go:419-424); dissemination rides the
+    # introducer's join broadcast in both worlds.
+    elig = (status == MEMBER) & senders[:, None]
+    colmax = jnp.max(jnp.where(elig, hb, 0), axis=0)        # int32 [N]
+    base = jnp.maximum(colmax - REBASE_WINDOW, 0)
+    rel = hb - base[None, :]
+    gossiped = elig & (rel >= 0)
+    view = jnp.where(gossiped, rel, -1).astype(jnp.int16)
     interpret = config.merge_kernel == "pallas_interpret"
     use_pallas = (
         config.merge_kernel != "xla"
@@ -228,11 +247,22 @@ def _merge(
         and (interpret or jax.default_backend() == "tpu")
     )
     if use_pallas:
-        best_hb = merge_pallas.fanout_max_merge(view, edges, interpret=interpret)
+        best_rel = merge_pallas.fanout_max_merge(
+            view,
+            edges,
+            block_r=config.merge_block_r,
+            block_c=config.merge_block_c,
+            slots=config.merge_slots,
+            interpret=interpret,
+        )
     else:
         # XLA gather path: also the fallback for unsupported shapes/backends
-        best_hb = merge_pallas.fanout_max_merge_xla(view, edges)
-    any_member = best_hb >= 0
+        best_rel = merge_pallas.fanout_max_merge_xla(view, edges)
+    any_member = best_rel >= 0
+    # un-rebase; keep absent entries at -1 (base can exceed any real hb)
+    best_hb = jnp.where(
+        any_member, best_rel.astype(jnp.int32) + base[None, :], -1
+    )
 
     recv = alive[:, None]
     advance = recv & (status == MEMBER) & (best_hb > hb)       # max-merge + stamp
@@ -264,8 +294,12 @@ def gossip_round(
     assert edges is not None
     state = _merge(state, edges, active, config)
 
-    # age advances for every entry not refreshed this round (refreshes wrote 0)
-    state = state._replace(age=state.age + 1, round=state.round + 1)
+    # age advances for every entry not refreshed this round (refreshes wrote
+    # 0); saturates at AGE_CLAMP, beyond every protocol threshold (config.py)
+    state = state._replace(
+        age=jnp.minimum(state.age + 1, AGE_CLAMP).astype(jnp.int8),
+        round=state.round + 1,
+    )
 
     dead = ~state.alive
     metrics = RoundMetrics(

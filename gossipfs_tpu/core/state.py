@@ -9,7 +9,11 @@ N tables at once as a structure-of-arrays ``[N, N]`` state — row *i* is node
   ``hb[i, j]``     heartbeat count *i* currently knows for *j*
                    (reference ``Member.HeartbeatCount``)
   ``age[i, j]``    rounds since the entry was last refreshed — the round-time
-                   equivalent of ``now - Member.UpdateTime`` (slave.go:426,470)
+                   equivalent of ``now - Member.UpdateTime`` (slave.go:426,470).
+                   Stored int8, saturating at ``config.AGE_CLAMP``: the
+                   protocol only ever compares age against small thresholds
+                   (t_fail, t_cooldown), so the clamp is invisible to the
+                   semantics and quarters the lane's HBM footprint
   ``status[i, j]`` UNKNOWN (not in *i*'s list) / MEMBER (in the list) /
                    FAILED (removed, on the RecentFailList cooldown —
                    slave/slave.go:276-286, 484-497)
@@ -42,7 +46,7 @@ class SimState(NamedTuple):
     """Pytree of the full simulation state (see module docstring)."""
 
     hb: jax.Array       # int32 [N, N]
-    age: jax.Array      # int32 [N, N]
+    age: jax.Array      # int8  [N, N], saturates at config.AGE_CLAMP
     status: jax.Array   # int8  [N, N]
     alive: jax.Array    # bool  [N]
     round: jax.Array    # int32 scalar
@@ -85,7 +89,7 @@ def init_state(config: SimConfig, member_mask: jax.Array | None = None) -> SimSt
     know = member_mask[:, None] & member_mask[None, :]
     return SimState(
         hb=jnp.zeros((n, n), dtype=jnp.int32),
-        age=jnp.zeros((n, n), dtype=jnp.int32),
+        age=jnp.zeros((n, n), dtype=jnp.int8),
         status=jnp.where(know, MEMBER, UNKNOWN).astype(jnp.int8),
         alive=member_mask,
         round=jnp.int32(0),

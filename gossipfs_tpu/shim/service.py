@@ -42,6 +42,7 @@ import grpc
 
 from gossipfs_tpu.cosim import CoSim
 from gossipfs_tpu.sdfs import election
+from gossipfs_tpu.shim import wire
 from gossipfs_tpu.shim.wire import SERVICE, deser as _deser, ser as _ser
 
 __all__ = ["SERVICE", "ShimServicer", "ShimServer"]
@@ -298,16 +299,11 @@ class ShimServer:
         host: str = "127.0.0.1",
         auto_confirm: bool = False,
         max_workers: int = 8,
-        max_message_mb: int = 64,
+        max_message_mb: int = wire.MAX_MESSAGE_MB,
     ):
         self.servicer = ShimServicer(sim, auto_confirm=auto_confirm)
-        # the reference's benchmark workload is multi-MB files (file1-10.txt,
-        # ~4 MB Wikipedia shards); raise gRPC's default 4 MB message cap so
-        # a whole-file Put/Get (base64-inflated ~1.33x) fits in one message
-        opts = [
-            ("grpc.max_receive_message_length", max_message_mb * 1024 * 1024),
-            ("grpc.max_send_message_length", max_message_mb * 1024 * 1024),
-        ]
+        # same cap as the client (wire.py — multi-MB file payloads)
+        opts = wire.message_size_options(max_message_mb)
         self.server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers), options=opts
         )

@@ -13,6 +13,21 @@ import json
 
 SERVICE = "gossipfs.Shim"
 
+# One message cap for both ends of the channel.  The reference's benchmark
+# workload is multi-MB files (file1-10.txt, ~4 MB Wikipedia shards); raise
+# gRPC's default 4 MB cap so a whole-file Put/Get (base64-inflated ~1.33x)
+# fits in one message.  Client and server must agree or large transfers die
+# with RESOURCE_EXHAUSTED on one side only.
+MAX_MESSAGE_MB = 64
+
+
+def message_size_options(max_message_mb: int = MAX_MESSAGE_MB):
+    """grpc channel/server options raising the message size cap."""
+    return [
+        ("grpc.max_receive_message_length", max_message_mb * 1024 * 1024),
+        ("grpc.max_send_message_length", max_message_mb * 1024 * 1024),
+    ]
+
 
 def ser(obj) -> bytes:
     return json.dumps(obj).encode("utf-8")

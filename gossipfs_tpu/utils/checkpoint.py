@@ -50,7 +50,7 @@ def _abstract_like(config: SimConfig, mesh: Mesh | None) -> dict:
     sh = shardings or SimState(hb=None, age=None, status=None, alive=None, round=None)
     state = SimState(
         hb=spec((n, n), jnp.int32, sh.hb),
-        age=spec((n, n), jnp.int32, sh.age),
+        age=spec((n, n), jnp.int8, sh.age),
         status=spec((n, n), jnp.int8, sh.status),
         alive=spec((n,), jnp.bool_, sh.alive),
         round=spec((), jnp.int32, sh.round),
@@ -73,6 +73,22 @@ def restore_checkpoint(
     with mesh-sharded arrays in a jitted call is an error.
     """
     path = pathlib.Path(path).resolve()
+    abstract = _abstract_like(config, mesh)
     with ocp.StandardCheckpointer() as ckptr:
-        restored = ckptr.restore(path, _abstract_like(config, mesh))
+        try:
+            restored = ckptr.restore(path, abstract)
+        except (ValueError, TypeError):
+            # legacy checkpoints (pre int8 age lane) stored age as int32 and
+            # unclamped; restore with the old spec, then apply the saturation
+            # clamp — beyond it, all ages behave identically (config.py)
+            from gossipfs_tpu.config import AGE_CLAMP
+
+            old = abstract["state"]["age"]
+            abstract["state"]["age"] = jax.ShapeDtypeStruct(
+                old.shape, jnp.int32, sharding=old.sharding
+            )
+            restored = ckptr.restore(path, abstract)
+            restored["state"]["age"] = jnp.clip(
+                restored["state"]["age"], 0, AGE_CLAMP
+            ).astype(jnp.int8)
     return SimState(**restored["state"]), restored["key"]

@@ -202,3 +202,69 @@ class TestRandomTopology:
         assert int(per_round.n_alive[-1]) > 0
         # crashes are being noticed
         assert int(per_round.true_detections.sum()) > 0
+
+
+class TestHeartbeatRebasing:
+    def test_column_shift_invariance(self):
+        """The int16 gossip-view rebasing (core/rounds.py _merge) must make
+        round semantics invariant to a uniform shift of heartbeat counters:
+        shifting every hb by a constant far beyond REBASE_WINDOW and running
+        the same rounds yields the same state, shifted back."""
+        shift = 1_000_000
+        cfg = SimConfig(n=64, topology="random", fanout=6)
+        state = init_state(cfg)
+        # settle so every live entry is past the hb grace in both runs
+        ev = schedule(10, cfg.n)
+        state, _, _ = run_rounds(state, cfg, 10, KEY, events=ev)
+
+        shifted = state._replace(hb=state.hb + shift)
+        ev = schedule(25, cfg.n, crash={3: [7], 12: [40]}, leave={5: [2]})
+        out_a, mc_a, pr_a = run_rounds(state, cfg, 25, KEY, events=ev)
+        out_b, mc_b, pr_b = run_rounds(shifted, cfg, 25, KEY, events=ev)
+
+        assert jnp.array_equal(out_b.hb, out_a.hb + shift)
+        assert jnp.array_equal(out_b.age, out_a.age)
+        assert jnp.array_equal(out_b.status, out_a.status)
+        assert jnp.array_equal(mc_b.first_detect, mc_a.first_detect)
+        assert jnp.array_equal(pr_b.true_detections, pr_a.true_detections)
+
+    def test_age_saturates_without_overflow(self):
+        from gossipfs_tpu.config import AGE_CLAMP
+
+        cfg = SimConfig(n=8)  # below min_group=4? n=8 fine
+        state = init_state(cfg)
+        state, _, _ = run_rounds(state, cfg, AGE_CLAMP + 40, KEY)
+        assert state.age.dtype == jnp.int8
+        assert int(state.age.max()) <= AGE_CLAMP
+        assert int(state.age.min()) >= 0
+
+    def test_rejoin_after_long_run_not_masked_by_stale_lanes(self):
+        """The rebase base must come from gossip-eligible copies only.
+        Frozen hb lanes of expired (UNKNOWN) entries keep crash-time
+        counters; if they anchored the base, a node rejoining once the run
+        is > REBASE_WINDOW rounds old would have its fresh hb=0 entries
+        masked out of the int16 view, age out at every peer, and be
+        false-positive detected forever."""
+        from gossipfs_tpu.config import REBASE_WINDOW
+
+        cfg = SimConfig(n=32, topology="random", fanout=5)
+        state = init_state(cfg)
+        state, _, _ = run_rounds(state, cfg, 5, KEY)
+        # simulate a REBASE_WINDOW+ old cluster (uniform shift is behavior-
+        # preserving, test_column_shift_invariance)
+        state = state._replace(hb=state.hb + REBASE_WINDOW + 1000)
+
+        j = 7
+        ev = schedule(cfg.t_fail + cfg.t_cooldown + 4, cfg.n, crash={0: [j]})
+        state, _, _ = run_rounds(state, cfg, ev.crash.shape[0], KEY, events=ev)
+        # j's entries have expired to UNKNOWN, hb lanes frozen high
+        assert int((state.status[:, j] == MEMBER).sum()) <= 1
+
+        ev = schedule(25, cfg.n, join={0: [j]})
+        state, _, per_round = run_rounds(state, cfg, 25, KEY, events=ev)
+        assert bool(state.alive[j])
+        assert int(per_round.false_positives.sum()) == 0
+        # every live peer carries j as a fresh MEMBER again
+        live = state.alive & (jnp.arange(cfg.n) != j)
+        assert bool(jnp.all(state.status[live, j] == MEMBER))
+        assert int(state.age[live, j].max()) <= cfg.t_fail
