@@ -10,25 +10,22 @@ Run: JAX_PLATFORMS=axon python -m gossipfs_tpu.bench.sweep_merge
 from __future__ import annotations
 
 import itertools
-import time
 
 import jax
 
 from gossipfs_tpu.config import SimConfig
-from gossipfs_tpu.core.rounds import run_rounds
 from gossipfs_tpu.core.state import init_state
+from gossipfs_tpu.utils.profiling import time_rounds
 
-N, ROUNDS = 16_384, 50
+N = 16_384
 
 
 def timed(cfg: SimConfig, key: jax.Array) -> float:
-    state = init_state(cfg)
-    st, _, _ = run_rounds(state, cfg, ROUNDS, key, crash_rate=0.01)
-    jax.block_until_ready(st)
-    t0 = time.perf_counter()
-    st, _, _ = run_rounds(state, cfg, ROUNDS, key, crash_rate=0.01)
-    jax.block_until_ready(st)
-    return ROUNDS / (time.perf_counter() - t0)
+    # slope-based timing (utils/profiling.py) — single-call timings carry the
+    # axon tunnel's per-dispatch offset and aren't comparable to BASELINE.md
+    return time_rounds(init_state(cfg), cfg, key, crash_rate=0.01)[
+        "rounds_per_sec"
+    ]
 
 
 def main() -> None:
@@ -50,6 +47,9 @@ def main() -> None:
             continue
         results.append((rps, br, bc, slots))
         print(f"br={br} bc={bc} slots={slots}: {rps:.1f} rounds/s", flush=True)
+    if not results:
+        print("no configuration succeeded")
+        return
     rps, br, bc, slots = max(results)
     print(f"best: {rps:.1f} rounds/s at br={br} bc={bc} slots={slots}")
 

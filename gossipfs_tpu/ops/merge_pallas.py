@@ -111,9 +111,11 @@ def fanout_max_merge(
 ) -> jax.Array:
     """out[i, :] = max over f of view[edges[i, f], :].
 
-    ``view``: int32 [N, N] (use -1 for "absent" lanes so the max ignores
-    them).  ``edges``: int32 [N, F] in-edge sender ids.  Defaults are the
-    tuned v5e values; blocks shrink automatically for small N.
+    ``view``: [N, N], any fixed-width integer dtype — production passes the
+    int16 rebased view built in core/rounds.py (2 bytes/elem of DMA traffic);
+    int32 works too.  Use -1 for "absent" lanes so the max ignores them.
+    ``edges``: int32 [N, F] in-edge sender ids.  Defaults are the tuned v5e
+    values; blocks shrink automatically for small N.
     """
     n = view.shape[0]
     fanout = edges.shape[1]

@@ -72,23 +72,23 @@ def restore_checkpoint(
     without it, orbax commits everything to one device and mixing the result
     with mesh-sharded arrays in a jitted call is an error.
     """
+    from gossipfs_tpu.config import AGE_CLAMP
+
     path = pathlib.Path(path).resolve()
     abstract = _abstract_like(config, mesh)
+    # Restore age as int32 regardless of the saved dtype: orbax silently
+    # casts to the target, so an int32 target is lossless for both the new
+    # int8 lane and legacy (pre-int8, unclamped) checkpoints — whereas an
+    # int8 target would wrap legacy ages > 127 into negatives with no error.
+    # Clamp + narrow afterwards; beyond AGE_CLAMP all ages behave identically
+    # (config.py), so the clamp is a no-op for new-format checkpoints.
+    new_age = abstract["state"]["age"]
+    abstract["state"]["age"] = jax.ShapeDtypeStruct(
+        new_age.shape, jnp.int32, sharding=new_age.sharding
+    )
     with ocp.StandardCheckpointer() as ckptr:
-        try:
-            restored = ckptr.restore(path, abstract)
-        except (ValueError, TypeError):
-            # legacy checkpoints (pre int8 age lane) stored age as int32 and
-            # unclamped; restore with the old spec, then apply the saturation
-            # clamp — beyond it, all ages behave identically (config.py)
-            from gossipfs_tpu.config import AGE_CLAMP
-
-            old = abstract["state"]["age"]
-            abstract["state"]["age"] = jax.ShapeDtypeStruct(
-                old.shape, jnp.int32, sharding=old.sharding
-            )
-            restored = ckptr.restore(path, abstract)
-            restored["state"]["age"] = jnp.clip(
-                restored["state"]["age"], 0, AGE_CLAMP
-            ).astype(jnp.int8)
+        restored = ckptr.restore(path, abstract)
+    restored["state"]["age"] = jnp.clip(
+        restored["state"]["age"], 0, AGE_CLAMP
+    ).astype(jnp.int8)
     return SimState(**restored["state"]), restored["key"]

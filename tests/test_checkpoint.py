@@ -58,3 +58,32 @@ def test_restore_onto_mesh_resumes_sharded_run(tmp_path):
     # mesh-aware restore: the key came back committed to one device)
     cont, _, _ = run_rounds(restored, cfg, 3, rkey, crash_rate=0.05)
     assert int(cont.round) == 8
+
+
+def test_legacy_int32_age_checkpoint_restores_clamped(tmp_path):
+    """Pre-int8-lane checkpoints stored age as unclamped int32.
+
+    Orbax silently casts to the abstract target's dtype on restore, so a
+    naive int8 target would wrap a legacy age of 200 to -56 (evading the
+    ``age > t_fail`` detector for ~60 extra rounds).  restore_checkpoint
+    must instead clamp legacy ages into the int8 saturation regime.
+    """
+    import orbax.checkpoint as ocp
+
+    from gossipfs_tpu.config import AGE_CLAMP
+
+    cfg = SimConfig(
+        n=16, topology="random", fanout=3, remove_broadcast=False,
+        fresh_cooldown=True,
+    )
+    key = jax.random.PRNGKey(3)
+    state = init_state(cfg)
+    legacy = state._asdict()
+    legacy["age"] = jnp.full((cfg.n, cfg.n), 200, jnp.int32)
+    path = (tmp_path / "legacy").resolve()
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, {"state": legacy, "key": key}, force=True)
+
+    restored, _ = restore_checkpoint(path, cfg)
+    assert restored.age.dtype == jnp.int8
+    assert jnp.all(restored.age == AGE_CLAMP)
