@@ -69,6 +69,10 @@ def main() -> None:
         # the pallas DMA merge kernel (ops/merge_pallas.py) runs the hot op
         # at the HBM ceiling (~4x XLA's gather); CPU keeps the XLA path
         merge_kernel="pallas" if use_tpu else "xla",
+        # int8 rebased view + full-row DMA blocks: 16.3 -> 9.0 ms/round on
+        # the merge at N=16k (see BASELINE.md)
+        view_dtype="int8",
+        merge_block_c=16_384,
     )
     key = jax.random.PRNGKey(0)
     state = init_state(cfg)

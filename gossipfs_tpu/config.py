@@ -27,12 +27,18 @@ Topology = Literal["ring", "random"]
 # overflow before the clamp is applied.
 AGE_CLAMP = 100
 
-# Per-subject heartbeat rebasing window for the gossip view (core/rounds.py
+# Per-subject heartbeat rebasing windows for the gossip view (core/rounds.py
 # ``_merge``).  Gossipable entries lag the freshest copy of a subject's
-# counter by O(t_fail) rounds per hop; 16384 is orders of magnitude beyond any
-# reachable lag, and keeps the rebased view well inside int16 — which halves
-# the HBM traffic of the fanout max-merge, the round's dominant cost.
+# counter by O(t_fail) rounds per hop, so the reachable lag is
+# ~t_fail * graph diameter: a handful of rounds for random fanout=log N
+# (diameter ~4), up to ~N/2 rounds for the 3-neighbor parity ring.  The
+# window bounds the rebased values, which picks the view dtype — and view
+# bytes are the round's dominant HBM traffic (the F-way row gather):
+#   int16 (window 16384): covers every topology up to ring N~32k; 2 B/elem
+#   int8  (window 126):   random-fanout topologies only; 1 B/elem — halves
+#                         the merge's DMA traffic again (bench headline)
 REBASE_WINDOW = 16_384
+INT8_REBASE_WINDOW = 126
 
 
 @dataclasses.dataclass(frozen=True)
@@ -67,7 +73,8 @@ class SimConfig:
     merge_block_r: int = 128         # pallas merge tile: receiver rows per block
     merge_block_c: int = 8192        # pallas merge tile: subject columns per DMA —
                                      # larger units amortize DMA descriptor issue,
-                                     # the kernel's limiter once the view is int16
+                                     # the kernel's limiter once the view is a
+                                     # narrow dtype (view_dtype below)
     merge_slots: int = 4             # pallas merge DMA double-buffer depth
     merge_kernel: str = "xla"        # "xla" | "pallas": implementation of the
                                      # per-round fanout max-merge (the hot op).
@@ -76,6 +83,11 @@ class SimConfig:
                                      # XLA gather's bandwidth); "pallas_interpret"
                                      # runs the same kernel in interpreter mode
                                      # (CPU tests only — slow)
+    view_dtype: str = "int16"        # gossip-view storage: "int16" | "int8".
+                                     # int8 halves the merge's HBM traffic but
+                                     # its 126-round rebase window only covers
+                                     # short-diameter (random) topologies —
+                                     # rejected for the parity ring
 
     def __post_init__(self) -> None:
         if self.n < 2:
@@ -93,6 +105,27 @@ class SimConfig:
             )
         if self.merge_kernel not in ("xla", "pallas", "pallas_interpret"):
             raise ValueError(f"unknown merge_kernel: {self.merge_kernel!r}")
+        if self.view_dtype not in ("int16", "int8"):
+            raise ValueError(f"unknown view_dtype: {self.view_dtype!r}")
+        if self.view_dtype == "int8":
+            if self.topology == "ring":
+                # steady-state ring lag grows with graph distance (~N/2
+                # rounds), which blows through int8's 126-round rebase window
+                # for any non-toy N; the parity path stays on int16
+                raise ValueError("view_dtype='int8' requires topology='random'")
+            # the window invariant is lag ~ t_fail per hop over the gossip
+            # graph's effective diameter (~log_{fanout+1} N for per-round
+            # resampled random fanout); enforce it with a 2x safety factor so
+            # large t_fail or tiny fanout can't silently drop lagging entries
+            # out of the gossip view (core/rounds.py ``gossiped = rel >= 0``)
+            hops = math.ceil(math.log(self.n) / math.log(self.fanout + 1))
+            if self.t_fail * (hops + 1) * 2 > INT8_REBASE_WINDOW:
+                raise ValueError(
+                    f"view_dtype='int8': t_fail={self.t_fail} x estimated "
+                    f"graph diameter ({hops} hops at fanout={self.fanout}, "
+                    f"n={self.n}) exceeds the {INT8_REBASE_WINDOW}-round "
+                    "rebase window (with 2x margin); use int16 or raise fanout"
+                )
         for name, lo in (("merge_block_r", 8), ("merge_block_c", 128)):
             v = getattr(self, name)
             # the kernel shrinks blocks by halving until they tile N, which
@@ -101,6 +134,11 @@ class SimConfig:
                 raise ValueError(f"{name} must be a power of two >= {lo}, got {v}")
         if self.merge_slots < 2:
             raise ValueError(f"merge_slots must be >= 2, got {self.merge_slots}")
+
+    @property
+    def rebase_window(self) -> int:
+        """Rebase window matching ``view_dtype`` (see module constants)."""
+        return INT8_REBASE_WINDOW if self.view_dtype == "int8" else REBASE_WINDOW
 
     @staticmethod
     def log_fanout(n: int) -> int:

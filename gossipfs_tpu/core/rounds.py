@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from gossipfs_tpu.config import AGE_CLAMP, REBASE_WINDOW, SimConfig
+from gossipfs_tpu.config import AGE_CLAMP, SimConfig
 from gossipfs_tpu.core import topology
 from gossipfs_tpu.core.state import FAILED, MEMBER, UNKNOWN, RoundEvents, SimState
 
@@ -217,26 +217,29 @@ def _merge(
 
     # The gossip view: what a sender's datagram contains for each subject
     # (absent entries as -1 — heartbeats are never negative).  Heartbeat
-    # counts are rebased per subject so the view fits int16, halving the HBM
-    # traffic of the F-way gather — the round's dominant cost.  The base is
+    # counts are rebased per subject so the view fits a narrow dtype
+    # (config.view_dtype: int16, or int8 for random topologies), shrinking
+    # the HBM traffic of the F-way gather — the round's dominant cost — by
+    # 2-4x over int32.  The base is
     # derived from *gossip-eligible* copies only: hb lanes of FAILED/UNKNOWN
     # entries and dead nodes' frozen rows keep crash-time counters forever,
     # and anchoring on those would mask a rejoining node's fresh hb=0
-    # entries out of gossip once the run is > REBASE_WINDOW rounds old.
+    # entries out of gossip once the run is > rebase_window rounds old.
     # Gossip-eligible entries (MEMBER, so age <= t_fail at the holder) lag
     # the freshest eligible copy by O(t_fail) per hop, so same-incarnation
-    # copies never fall REBASE_WINDOW behind.  The one reachable clamp: a
+    # copies never fall rebase_window behind.  The one reachable clamp: a
     # rejoin while a zombie MEMBER copy of the old incarnation (counter
-    # > REBASE_WINDOW ahead) survives somewhere — the fresh entries drop out
+    # > rebase_window ahead) survives somewhere — the fresh entries drop out
     # of gossip, but the reference's incarnation-free max-merge dominates
     # those counts anyway (slave.go:419-424); dissemination rides the
     # introducer's join broadcast in both worlds.
     elig = (status == MEMBER) & senders[:, None]
     colmax = jnp.max(jnp.where(elig, hb, 0), axis=0)        # int32 [N]
-    base = jnp.maximum(colmax - REBASE_WINDOW, 0)
+    base = jnp.maximum(colmax - config.rebase_window, 0)
     rel = hb - base[None, :]
     gossiped = elig & (rel >= 0)
-    view = jnp.where(gossiped, rel, -1).astype(jnp.int16)
+    vdtype = jnp.int8 if config.view_dtype == "int8" else jnp.int16
+    view = jnp.where(gossiped, rel, -1).astype(vdtype)
     interpret = config.merge_kernel == "pallas_interpret"
     use_pallas = (
         config.merge_kernel != "xla"

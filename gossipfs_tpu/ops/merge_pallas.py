@@ -18,8 +18,8 @@ v5e chip; this kernel sustains ~4-6x that by:
     tile-aligned ``(C/128, 128)`` block (Mosaic rejects single-row slices
     of an ``(8,128)``-tiled HBM buffer); large ``block_c`` keeps the DMA
     count low — descriptor issue, not bytes, is the limiter once the view
-    is int16 (core/rounds.py rebases heartbeats into int16, halving the
-    gather's bytes);
+    is narrow (core/rounds.py rebases heartbeats into ``config.view_dtype``,
+    int16 or int8, cutting the gather's bytes 2-4x vs int32);
   * accumulating the F-way max entirely in VMEM — the output is written
     exactly once, so total traffic is the information floor
     (F reads + 1 write per state element).
@@ -76,10 +76,11 @@ def _kernel(n_fanout: int, r_blk: int, slots: int):
                 issue(r + slots - 1, lax.rem(r + slots - 1, slots))
 
             wait(slot)
-            # v5e Mosaic can't compare/max int16 vectors; widen to int32 for
-            # the VPU max and narrow on the way out.  The DMAs above and the
-            # output store still move the narrow dtype — the HBM traffic,
-            # which is what this kernel is bound by, stays at 2 bytes/elem.
+            # v5e Mosaic can't compare/max narrow int vectors; widen to int32
+            # for the VPU max and narrow on the way out.  The DMAs above and
+            # the output store still move the narrow dtype — the HBM traffic,
+            # which is what this kernel is bound by, stays at the view's
+            # 1-2 bytes/elem.
             dtype = out_ref.dtype
             acc = scratch[slot, 0].astype(jnp.int32)
             for f in range(1, n_fanout):
@@ -112,8 +113,9 @@ def fanout_max_merge(
     """out[i, :] = max over f of view[edges[i, f], :].
 
     ``view``: [N, N], any fixed-width integer dtype — production passes the
-    int16 rebased view built in core/rounds.py (2 bytes/elem of DMA traffic);
-    int32 works too.  Use -1 for "absent" lanes so the max ignores them.
+    rebased view built in core/rounds.py (``config.view_dtype``: int16 or
+    int8, so 1-2 bytes/elem of DMA traffic); int32 works too.  Use -1 for
+    "absent" lanes so the max ignores them.
     ``edges``: int32 [N, F] in-edge sender ids.  Defaults are the tuned v5e
     values; blocks shrink automatically for small N.
     """

@@ -24,13 +24,13 @@ from gossipfs_tpu.ops.merge_pallas import (
 )
 
 
-@pytest.mark.parametrize("dtype", [jnp.int32, jnp.int16])
+@pytest.mark.parametrize("dtype", [jnp.int32, jnp.int16, jnp.int8])
 @pytest.mark.parametrize("n,fanout", [(128, 3), (256, 8), (384, 17)])
 def test_kernel_matches_oracle(n, fanout, dtype):
     key = jax.random.PRNGKey(n + fanout)
     k1, k2 = jax.random.split(key)
-    # int16 is the production view dtype (core/rounds.py rebases heartbeats
-    # into it); int32 keeps the kernel dtype-generic
+    # int16/int8 are the production view dtypes (core/rounds.py rebases
+    # heartbeats into config.view_dtype); int32 keeps the kernel dtype-generic
     view = jax.random.randint(k1, (n, n), -1, 100, dtype=jnp.int32).astype(dtype)
     edges = jax.random.randint(k2, (n, fanout), 0, n, dtype=jnp.int32)
     got = fanout_max_merge(view, edges, interpret=True)

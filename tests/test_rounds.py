@@ -238,6 +238,54 @@ class TestHeartbeatRebasing:
         assert int(state.age.max()) <= AGE_CLAMP
         assert int(state.age.min()) >= 0
 
+    def test_int8_view_matches_int16(self):
+        """view_dtype='int8' (the bench headline) must be semantically
+        identical to int16 whenever gossip lag stays inside the 126-round
+        int8 window — which is every random-fanout steady state.  The hb
+        shift pushes colmax past 126 so the int8 run actively rebases
+        (base > 0) while the int16 run does not: equality here proves the
+        narrow view changes bytes, not protocol behavior."""
+        import dataclasses
+
+        cfg16 = SimConfig(n=64, topology="random", fanout=6, view_dtype="int16")
+        cfg8 = dataclasses.replace(cfg16, view_dtype="int8")
+        state = init_state(cfg16)
+        state, _, _ = run_rounds(state, cfg16, 10, KEY)
+        state = state._replace(hb=state.hb + 200)
+
+        ev = schedule(
+            40, cfg16.n, crash={3: [7], 20: [40]}, leave={5: [2]}, join={25: [7]}
+        )
+        out_a, mc_a, pr_a = run_rounds(state, cfg16, 40, KEY, events=ev)
+        out_b, mc_b, pr_b = run_rounds(state, cfg8, 40, KEY, events=ev)
+        assert jnp.array_equal(out_b.hb, out_a.hb)
+        assert jnp.array_equal(out_b.age, out_a.age)
+        assert jnp.array_equal(out_b.status, out_a.status)
+        assert jnp.array_equal(mc_b.first_detect, mc_a.first_detect)
+        assert jnp.array_equal(mc_b.converged, mc_a.converged)
+        assert jnp.array_equal(pr_b.true_detections, pr_a.true_detections)
+        assert jnp.array_equal(pr_b.false_positives, pr_a.false_positives)
+
+    def test_int8_view_rejected_for_ring(self):
+        with pytest.raises(ValueError, match="int8"):
+            SimConfig(n=64, topology="ring", fanout=3, view_dtype="int8")
+
+    def test_int8_view_rejected_when_lag_bound_exceeds_window(self):
+        """t_fail x diameter must fit the 126-round window: tiny fanout on a
+        large graph (many hops) or a huge t_fail both blow it."""
+        with pytest.raises(ValueError, match="rebase window"):
+            SimConfig(n=4096, topology="random", fanout=1, view_dtype="int8")
+        with pytest.raises(ValueError, match="rebase window"):
+            SimConfig(
+                n=1024, topology="random", fanout=10, t_fail=40,
+                view_dtype="int8",
+            )
+        # the bench headline config must remain admissible
+        SimConfig(
+            n=16_384, topology="random", fanout=SimConfig.log_fanout(16_384),
+            view_dtype="int8",
+        )
+
     def test_rejoin_after_long_run_not_masked_by_stale_lanes(self):
         """The rebase base must come from gossip-eligible copies only.
         Frozen hb lanes of expired (UNKNOWN) entries keep crash-time
