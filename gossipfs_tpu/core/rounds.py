@@ -39,6 +39,83 @@ from gossipfs_tpu.config import AGE_CLAMP, SimConfig
 from gossipfs_tpu.core import topology
 from gossipfs_tpu.core.state import FAILED, MEMBER, UNKNOWN, RoundEvents, SimState
 
+# ---------------------------------------------------------------------------
+# Blocked layout.
+#
+# TPU arrays are physically tiled, so the [N, N] -> [N, N/C, C/128, 128]
+# reshape the pallas merge kernel needs is a real relayout pass (~1-3 ms per
+# lane at N=16k — it was ~35% of round time when done per round).  The scan
+# therefore keeps the whole state in the kernel's blocked layout and
+# reshapes once at entry/exit.  Every round function below is shape-generic:
+# axis 0 is always the receiver; all remaining axes together index the
+# subject.  The helpers express the two broadcasts and the identity mask.
+# ---------------------------------------------------------------------------
+
+
+def _rx(v: jax.Array, ndim: int) -> jax.Array:
+    """Broadcast a per-receiver [N] vector over the subject axes."""
+    return v.reshape(v.shape[:1] + (1,) * (ndim - 1))
+
+
+def _sj(v: jax.Array, shape: tuple[int, ...]) -> jax.Array:
+    """Broadcast a per-subject [N] vector over the receiver axis."""
+    return v.reshape(shape[1:])[None]
+
+
+def _eye(n: int, shape: tuple[int, ...]) -> jax.Array:
+    """bool mask of the diagonal (receiver == subject), shape-generic."""
+    idx = jnp.arange(n, dtype=jnp.int32)
+    return _rx(idx, len(shape)) == _sj(idx, shape)
+
+
+def _subj_axes(a: jax.Array) -> tuple[int, ...]:
+    return tuple(range(1, a.ndim))
+
+
+def _flat(v: jax.Array, n: int) -> jax.Array:
+    """Collapse a per-subject result (subject-shaped) back to [N]."""
+    return v.reshape(n)
+
+
+def _use_pallas(config: SimConfig, fanout: int, n: int) -> bool:
+    """Whether this run executes the pallas merge kernel."""
+    from gossipfs_tpu.ops import merge_pallas
+
+    if config.merge_kernel == "xla" or not merge_pallas.supported(n, fanout):
+        return False
+    return config.merge_kernel == "pallas_interpret" or jax.default_backend() == "tpu"
+
+
+def _use_blocked(config: SimConfig, fanout: int, n: int) -> bool:
+    """Whether the scan keeps state in the kernel's blocked layout.
+
+    Ring mode re-derives edges from the 2-D membership tables every round,
+    which would re-pay the relayout the blocked layout exists to avoid —
+    ring (the parity mode, never the perf mode) stays 2-D and reaches the
+    pallas kernel through the reshaping wrapper instead.
+    """
+    return _use_pallas(config, fanout, n) and config.topology != "ring"
+
+
+def _to_blocked(state: SimState, config: SimConfig) -> SimState:
+    from gossipfs_tpu.ops import merge_pallas
+
+    shp = merge_pallas.blocked_shape(state.n, config.merge_block_c)
+    return state._replace(
+        hb=state.hb.reshape(shp),
+        age=state.age.reshape(shp),
+        status=state.status.reshape(shp),
+    )
+
+
+def _from_blocked(state: SimState) -> SimState:
+    n = state.n
+    return state._replace(
+        hb=state.hb.reshape(n, n),
+        age=state.age.reshape(n, n),
+        status=state.status.reshape(n, n),
+    )
+
 
 class RoundMetrics(NamedTuple):
     """Per-round scalar observables (cheap enough to stack over any horizon)."""
@@ -76,13 +153,14 @@ def _apply_events(state: SimState, events: RoundEvents, config: SimConfig) -> Si
     pay here.
     """
     hb, age, status, alive = state.hb, state.age, state.status, state.alive
+    n, nd, shp = state.n, hb.ndim, hb.shape
 
     # -- leave: broadcast LEAVE, receivers remove + fail-list (slave.go:310-336).
     # The entry moves onto the fail list keeping its *existing* timestamp
     # (removeMember appends the live Member struct, slave.go:276-286), so age
     # keeps running — cooldown is measured from the last gossip refresh.
     leave = events.leave & alive
-    mark = alive[:, None] & (status == MEMBER) & leave[None, :]
+    mark = _rx(alive, nd) & (status == MEMBER) & _sj(leave, shp)
     status = jnp.where(mark, FAILED, status)
     if config.fresh_cooldown:
         age = jnp.where(mark, 0, age)
@@ -99,14 +177,14 @@ def _apply_events(state: SimState, events: RoundEvents, config: SimConfig) -> Si
     eff = join & intro_alive  # joins are lost if the introducer is down (SPOF kept)
 
     # introducer's own row: unconditional append at hb=0
-    intro_row_add = eff & (jnp.arange(state.n) != intro)
-    intro_sel = (jnp.arange(state.n) == intro)[:, None] & intro_row_add[None, :]
+    intro_row_add = eff & (jnp.arange(n) != intro)
+    intro_sel = _rx(jnp.arange(n) == intro, nd) & _sj(intro_row_add, shp)
     status = jnp.where(intro_sel, MEMBER, status)
     hb = jnp.where(intro_sel, 0, hb)
     age = jnp.where(intro_sel, 0, age)
 
     # everyone else merges the introducer's pushed list: add joiner if UNKNOWN
-    recv_add = alive[:, None] & (status == UNKNOWN) & eff[None, :]
+    recv_add = _rx(alive, nd) & (status == UNKNOWN) & _sj(eff, shp)
     status = jnp.where(recv_add, MEMBER, status)
     hb = jnp.where(recv_add, 0, hb)
     age = jnp.where(recv_add, 0, age)
@@ -115,12 +193,12 @@ def _apply_events(state: SimState, events: RoundEvents, config: SimConfig) -> Si
     # the same full-list push); a fresh process has an empty fail list.
     joiner_status = jnp.where(status[intro] == MEMBER, MEMBER, UNKNOWN)
     joiner_hb = jnp.where(status[intro] == MEMBER, hb[intro], 0)
-    new_row = eff[:, None]
-    status = jnp.where(new_row, joiner_status[None, :], status)
-    hb = jnp.where(new_row, joiner_hb[None, :], hb)
+    new_row = _rx(eff, nd)
+    status = jnp.where(new_row, joiner_status[None], status)
+    hb = jnp.where(new_row, joiner_hb[None], hb)
     age = jnp.where(new_row, 0, age)
     # self entry always present (InitMembership, slave.go:161-167)
-    self_sel = new_row & (jnp.arange(state.n)[None, :] == jnp.arange(state.n)[:, None])
+    self_sel = new_row & _eye(n, shp)
     status = jnp.where(self_sel, MEMBER, status)
     hb = jnp.where(self_sel, 0, hb)
 
@@ -137,21 +215,22 @@ def _tick(
     """
     n = state.n
     hb, age, status, alive = state.hb, state.age, state.status, state.alive
-    eye = jnp.eye(n, dtype=bool)
+    nd, shp = hb.ndim, hb.shape
+    eye = _eye(n, shp)
 
-    counts = jnp.sum((status == MEMBER).astype(jnp.int32), axis=1)
+    counts = jnp.sum((status == MEMBER).astype(jnp.int32), axis=_subj_axes(status))
     small = counts < config.min_group
     active = alive & ~small
     refresher = alive & small
 
     # small groups only refresh timestamps (slave.go:504-509)
-    refresh_all = refresher[:, None] & (status == MEMBER)
+    refresh_all = _rx(refresher, nd) & (status == MEMBER)
     age = jnp.where(refresh_all, 0, age)
 
     # bump own heartbeat + stamp — only while the self entry is still in the
     # list (updateMemberList matches by address, slave.go:443-448; a node that
     # processed a REMOVE about itself stops bumping)
-    bump = eye & active[:, None] & (status == MEMBER)
+    bump = eye & _rx(active, nd) & (status == MEMBER)
     hb = hb + bump.astype(jnp.int32)
     age = jnp.where(bump, 0, age)
 
@@ -159,7 +238,7 @@ def _tick(
     # grace, and silent for more than t_fail rounds.  Removed entries keep
     # their stale timestamp on the fail list (slave.go:276-286): age runs on.
     fail = (
-        active[:, None]
+        _rx(active, nd)
         & (status == MEMBER)
         & ~eye
         & (hb > config.hb_grace)
@@ -174,7 +253,7 @@ def _tick(
     # gossip omission instead.
     if config.remove_broadcast:
         removed = jnp.any(fail, axis=0)
-        mark = alive[:, None] & (status == MEMBER) & removed[None, :]
+        mark = _rx(alive, nd) & (status == MEMBER) & removed[None]
         status = jnp.where(mark, FAILED, status)
         if config.fresh_cooldown:
             age = jnp.where(mark, 0, age)
@@ -233,47 +312,86 @@ def _merge(
     # of gossip, but the reference's incarnation-free max-merge dominates
     # those counts anyway (slave.go:419-424); dissemination rides the
     # introducer's join broadcast in both worlds.
-    elig = (status == MEMBER) & senders[:, None]
-    colmax = jnp.max(jnp.where(elig, hb, 0), axis=0)        # int32 [N]
+    nd = hb.ndim
+    elig = (status == MEMBER) & _rx(senders, nd)
+    colmax = jnp.max(jnp.where(elig, hb, 0), axis=0)        # int32, subject-shaped
     base = jnp.maximum(colmax - config.rebase_window, 0)
-    rel = hb - base[None, :]
+    rel = hb - base[None]
     gossiped = elig & (rel >= 0)
     vdtype = jnp.int8 if config.view_dtype == "int8" else jnp.int16
     view = jnp.where(gossiped, rel, -1).astype(vdtype)
-    interpret = config.merge_kernel == "pallas_interpret"
-    use_pallas = (
-        config.merge_kernel != "xla"
-        and merge_pallas.supported(state.n, edges.shape[1])
-        # the compiled kernel is Mosaic/TPU-only; "pallas" on a CPU/GPU
-        # backend (preset smoke-runs) falls back rather than failing to
-        # lower ("pallas_interpret" runs anywhere, for tests)
-        and (interpret or jax.default_backend() == "tpu")
-    )
-    if use_pallas:
-        best_rel = merge_pallas.fanout_max_merge(
-            view,
-            edges,
+    # Both paths include the post-merge global age advance (everything not
+    # refreshed this round ages by one, saturating at AGE_CLAMP) so the
+    # fused kernel can write each [N, N] lane exactly once.
+    if _use_pallas(config, edges.shape[1], state.n):
+        kernel_kwargs = dict(
+            member=int(MEMBER),
+            unknown=int(UNKNOWN),
+            age_clamp=AGE_CLAMP,
             block_r=config.merge_block_r,
-            block_c=config.merge_block_c,
             slots=config.merge_slots,
-            interpret=interpret,
+            interpret=config.merge_kernel == "pallas_interpret",
         )
+        alive32 = alive.astype(jnp.int32)
+        if hb.ndim == 4:
+            # blocked layout (see module header): view/hb/age/status arrive
+            # in the kernel-native 4-D shape, so the fused kernel runs with
+            # no relayout at all
+            hb, age, status = merge_pallas.fused_merge_update_blocked(
+                view, edges, hb, age, status, base, alive32, **kernel_kwargs
+            )
+        else:
+            # ring mode stays 2-D (see _use_blocked) and pays the wrapper's
+            # per-round reshapes — acceptable for the parity mode
+            hb, age, status = merge_pallas.fused_merge_update(
+                view, edges, hb, age, status, base, alive32,
+                block_c=config.merge_block_c, **kernel_kwargs
+            )
     else:
         # XLA gather path: also the fallback for unsupported shapes/backends
         best_rel = merge_pallas.fanout_max_merge_xla(view, edges)
-    any_member = best_rel >= 0
-    # un-rebase; keep absent entries at -1 (base can exceed any real hb)
-    best_hb = jnp.where(
-        any_member, best_rel.astype(jnp.int32) + base[None, :], -1
-    )
+        any_member = best_rel >= 0
+        # un-rebase; keep absent entries at -1 (base can exceed any real hb)
+        best_hb = jnp.where(
+            any_member, best_rel.astype(jnp.int32) + base[None], -1
+        )
 
-    recv = alive[:, None]
-    advance = recv & (status == MEMBER) & (best_hb > hb)       # max-merge + stamp
-    add = recv & (status == UNKNOWN) & any_member              # learn new member
-    hb = jnp.where(advance | add, best_hb, hb)
-    age = jnp.where(advance | add, 0, age)
-    status = jnp.where(add, MEMBER, status)
+        recv = _rx(alive, nd)
+        advance = recv & (status == MEMBER) & (best_hb > hb)   # max-merge + stamp
+        add = recv & (status == UNKNOWN) & any_member          # learn new member
+        hb = jnp.where(advance | add, best_hb, hb)
+        age = jnp.where(advance | add, 0, age)
+        status = jnp.where(add, MEMBER, status)
+        age = jnp.minimum(age + 1, AGE_CLAMP).astype(jnp.int8)
     return SimState(hb=hb, age=age, status=status, alive=alive, round=state.round)
+
+
+def _round_core(
+    state: SimState,
+    events: RoundEvents,
+    edges: jax.Array | None,
+    config: SimConfig,
+) -> tuple[SimState, RoundMetrics, jax.Array]:
+    """One round, layout-generic (state may be 2-D or blocked)."""
+    n = state.n
+    state = _apply_events(state, events, config)
+    state, fail, active = _tick(state, config)
+    if config.topology == "ring":
+        edges = topology.ring_edges_from_status(state.status.reshape(n, n))
+    assert edges is not None
+    # _merge also advances age for every entry not refreshed this round
+    # (refreshes wrote 0, then everything ages by one, saturating at
+    # AGE_CLAMP — beyond every protocol threshold, config.py)
+    state = _merge(state, edges, active, config)
+    state = state._replace(round=state.round + 1)
+
+    dead = ~state.alive
+    metrics = RoundMetrics(
+        true_detections=jnp.sum(fail & _sj(dead, fail.shape), dtype=jnp.int32),
+        false_positives=jnp.sum(fail & _sj(state.alive, fail.shape), dtype=jnp.int32),
+        n_alive=jnp.sum(state.alive, dtype=jnp.int32),
+    )
+    return state, metrics, fail
 
 
 @partial(jax.jit, static_argnames=("config",))
@@ -289,28 +407,18 @@ def gossip_round(
     where edges are derived from the post-tick membership tables (the
     reference computes push targets after updateMemberList, slave.go:510-524).
     Returns (next_state, per-round metrics, fail_events [N,N]).
+
+    Single-round calls pay the blocked-layout relayout on the pallas path;
+    the scan in :func:`run_rounds` converts once for the whole horizon.
     """
-    state = _apply_events(state, events, config)
-    state, fail, active = _tick(state, config)
-    if config.topology == "ring":
-        edges = topology.ring_edges_from_status(state.status)
-    assert edges is not None
-    state = _merge(state, edges, active, config)
-
-    # age advances for every entry not refreshed this round (refreshes wrote
-    # 0); saturates at AGE_CLAMP, beyond every protocol threshold (config.py)
-    state = state._replace(
-        age=jnp.minimum(state.age + 1, AGE_CLAMP).astype(jnp.int8),
-        round=state.round + 1,
-    )
-
-    dead = ~state.alive
-    metrics = RoundMetrics(
-        true_detections=jnp.sum(fail & dead[None, :], dtype=jnp.int32),
-        false_positives=jnp.sum(fail & state.alive[None, :], dtype=jnp.int32),
-        n_alive=jnp.sum(state.alive, dtype=jnp.int32),
-    )
-    return state, metrics, fail
+    n = state.n
+    blocked = _use_blocked(config, config.fanout, n)
+    if blocked:
+        state = _to_blocked(state, config)
+    state, metrics, fail = _round_core(state, events, edges, config)
+    if blocked:
+        state = _from_blocked(state)
+    return state, metrics, fail.reshape(n, n)
 
 
 def _update_carry(
@@ -321,17 +429,17 @@ def _update_carry(
     round_idx: jax.Array,
 ) -> MetricsCarry:
     n = state.n
+    nd, shp = state.status.ndim, state.status.shape
     first_detect, converged = carry
     # rejoined = joins that actually took effect: new incarnation, new clock
     first_detect = jnp.where(rejoined, -1, first_detect)
     converged = jnp.where(rejoined, -1, converged)
 
-    any_fail = jnp.any(fail, axis=0)
+    any_fail = _flat(jnp.any(fail, axis=0), n)
     first_detect = jnp.where((first_detect < 0) & any_fail, round_idx, first_detect)
 
-    eye = jnp.eye(n, dtype=bool)
-    dropped = ~state.alive[:, None] | eye | (state.status != MEMBER)
-    all_dropped = jnp.all(dropped, axis=0) & ~state.alive
+    dropped = ~_rx(state.alive, nd) | _eye(n, shp) | (state.status != MEMBER)
+    all_dropped = _flat(jnp.all(dropped, axis=0), n) & ~state.alive
     converged = jnp.where((converged < 0) & all_dropped, round_idx, converged)
     return MetricsCarry(first_detect=first_detect, converged=converged)
 
@@ -366,6 +474,11 @@ def run_rounds(
         zeros = jnp.zeros((num_rounds, n), dtype=bool)
         events = RoundEvents(crash=zeros, leave=zeros, join=zeros)
 
+    blocked = _use_blocked(config, config.fanout, n)
+    if blocked:
+        # one relayout for the whole horizon (see module header)
+        state = _to_blocked(state, config)
+
     def step(carry, ev: RoundEvents):
         st, mc = carry
         k = jax.random.fold_in(key, st.round)
@@ -382,11 +495,13 @@ def run_rounds(
         )
         round_idx = st.round
         alive_before = st.alive
-        st, metrics, fail = gossip_round(st, ev, edges, config)
+        st, metrics, fail = _round_core(st, ev, edges, config)
         # joins lost to a dead introducer don't reset metrics (slave.go:22 SPOF)
         rejoined = ev.join & ~alive_before & st.alive
         mc = _update_carry(mc, st, rejoined, fail, round_idx)
         return (st, mc), metrics
 
     (state, mcarry), per_round = lax.scan(step, (state, MetricsCarry.init(n)), events)
+    if blocked:
+        state = _from_blocked(state)
     return state, mcarry, per_round

@@ -42,53 +42,62 @@ from jax.experimental.pallas import tpu as pltpu
 LANE = 128
 
 
+def _gather_max_rows(edges_ref, view_ref, scratch, sems, n_fanout, r_blk, slots, sink):
+    """The slotted gather pipeline shared by both kernels.
+
+    For each receiver row r in the block: async-DMA the ``F`` sender view
+    rows (``slots``-deep double-buffered so the VPU max never waits on
+    memory), widen to int32 for the F-way max (v5e Mosaic has no narrow-int
+    vector compare/max — the DMAs still move the narrow dtype, which is
+    what the kernel is bound by), and hand the per-row maximum to ``sink``.
+    """
+    j = pl.program_id(1)
+
+    def issue(r, slot):
+        for f in range(n_fanout):
+            pltpu.make_async_copy(
+                view_ref.at[edges_ref[r, f], j],
+                scratch.at[slot, f],
+                sems.at[slot, f],
+            ).start()
+
+    def wait(slot):
+        for f in range(n_fanout):
+            # src is irrelevant for wait(); shapes must match the start
+            pltpu.make_async_copy(
+                view_ref.at[0, j], scratch.at[slot, f], sems.at[slot, f]
+            ).wait()
+
+    for s in range(slots - 1):
+        issue(s, s)
+
+    def body(r, _):
+        slot = lax.rem(r, slots)
+
+        @pl.when(r + slots - 1 < r_blk)
+        def _():
+            issue(r + slots - 1, lax.rem(r + slots - 1, slots))
+
+        wait(slot)
+        acc = scratch[slot, 0].astype(jnp.int32)
+        for f in range(1, n_fanout):
+            acc = jnp.maximum(acc, scratch[slot, f].astype(jnp.int32))
+        sink(r, acc)
+        return 0
+
+    lax.fori_loop(0, r_blk, body, 0, unroll=False)
+
+
 def _kernel(n_fanout: int, r_blk: int, slots: int):
     def kernel(edges_ref, view_ref, out_ref, scratch, sems):
         # edges_ref: [r_blk, F] int32 in SMEM (this row-block's in-edges)
         # view_ref:  [N, N/C, C/128, 128] in HBM (never copied wholesale)
         # out_ref:   [r_blk, 1, C/128, 128] in VMEM
         # scratch:   [slots, F, C/128, 128] VMEM; sems: [slots, F]
-        j = pl.program_id(1)
+        def sink(r, acc):
+            out_ref[r, 0] = acc.astype(out_ref.dtype)
 
-        def issue(r, slot):
-            for f in range(n_fanout):
-                pltpu.make_async_copy(
-                    view_ref.at[edges_ref[r, f], j],
-                    scratch.at[slot, f],
-                    sems.at[slot, f],
-                ).start()
-
-        def wait(slot):
-            for f in range(n_fanout):
-                # src is irrelevant for wait(); shapes must match the start
-                pltpu.make_async_copy(
-                    view_ref.at[0, j], scratch.at[slot, f], sems.at[slot, f]
-                ).wait()
-
-        for s in range(slots - 1):
-            issue(s, s)
-
-        def body(r, _):
-            slot = lax.rem(r, slots)
-
-            @pl.when(r + slots - 1 < r_blk)
-            def _():
-                issue(r + slots - 1, lax.rem(r + slots - 1, slots))
-
-            wait(slot)
-            # v5e Mosaic can't compare/max narrow int vectors; widen to int32
-            # for the VPU max and narrow on the way out.  The DMAs above and
-            # the output store still move the narrow dtype — the HBM traffic,
-            # which is what this kernel is bound by, stays at the view's
-            # 1-2 bytes/elem.
-            dtype = out_ref.dtype
-            acc = scratch[slot, 0].astype(jnp.int32)
-            for f in range(1, n_fanout):
-                acc = jnp.maximum(acc, scratch[slot, f].astype(jnp.int32))
-            out_ref[r, 0] = acc.astype(dtype)
-            return 0
-
-        lax.fori_loop(0, r_blk, body, 0, unroll=False)
+        _gather_max_rows(edges_ref, view_ref, scratch, sems, n_fanout, r_blk, slots, sink)
 
     return kernel
 
@@ -162,6 +171,249 @@ def fanout_max_merge(
         interpret=interpret,
     )(edges, view4)
     return out4.reshape(n, n)
+
+
+def _fused_kernel(n_fanout: int, r_blk: int, slots: int, member: int, unknown: int, age_clamp: int):
+    def kernel(
+        edges_ref, view_ref, hb_hbm, age_hbm, status_hbm, base_ref,
+        hb_out, age_out, status_out,
+        best_scratch, hb_vmem, age_vmem, status_vmem, scratch, sems, row_sems,
+    ):
+        # edges_ref: [r_blk, F] int32 SMEM — dead receivers' edges are
+        #            remapped to self by the wrapper (their own view row is
+        #            all -1, making the merge a no-op for them while the
+        #            age advance still applies — the alive gate with no
+        #            per-row vector operand)
+        # view_ref / hb/age/status_hbm: [N/R or N, ..., C/128, 128] in HBM.
+        #            The receiver-row lanes are copied block-at-a-time with
+        #            explicit DMAs that overlap the gather loop — VMEM-block
+        #            inputs measured 5x slower here (Mosaic serialized their
+        #            per-grid-step copies against the manual gather DMAs).
+        # outs:      [r_blk, 1, C/128, 128] VMEM blocks (auto-pipelined,
+        #            same as fanout_max_merge's single output — cheap).
+        i = pl.program_id(0)
+        j = pl.program_id(1)
+
+        # block-input DMAs for the receiver lanes: issued before the gather
+        # loop, awaited after it — their ~3 MB fully hides under the
+        # gather's F x r_blk row copies
+        row_copies = [
+            pltpu.make_async_copy(hb_hbm.at[i, :, j], hb_vmem, row_sems.at[0]),
+            pltpu.make_async_copy(age_hbm.at[i, :, j], age_vmem, row_sems.at[1]),
+            pltpu.make_async_copy(status_hbm.at[i, :, j], status_vmem, row_sems.at[2]),
+        ]
+        for c in row_copies:
+            c.start()
+
+        # Phase 1 — row loop: gather + F-way max into best_scratch.  The
+        # loop body stays minimal so the DMA waits dominate it; everything
+        # else runs once per block, vectorized (a per-row epilogue measured
+        # 2x slower than the whole unfused pipeline — tiny (cs, 128) tiles
+        # serialize the VPU work against the gather waits).
+        def sink(r, acc):
+            best_scratch[r] = acc
+
+        _gather_max_rows(edges_ref, view_ref, scratch, sems, n_fanout, r_blk, slots, sink)
+        for c in row_copies:
+            c.wait()
+
+        # Phase 2 — block-wide epilogue on [r_blk, cs, 128] operands.
+        # MergeMemberList semantics (core/rounds.py _merge): shared members
+        # take the max count + a fresh local stamp; UNKNOWN subjects present
+        # in some peer's message are added; FAILED (fail-list) entries
+        # ignore gossip entirely.
+        best_rel = best_scratch[...]
+        any_member = best_rel >= 0
+        best_hb = best_rel + base_ref[0][None]
+        hb = hb_vmem[...]
+        st = status_vmem[...].astype(jnp.int32)
+        age = age_vmem[...].astype(jnp.int32)
+        advance = any_member & (st == member) & (best_hb > hb)
+        add = any_member & (st == unknown)
+        upd = advance | add
+        hb_out[:, 0] = jnp.where(upd, best_hb, hb)
+        # the post-merge global age advance (everything not refreshed this
+        # round ages by one, saturating) folds in here
+        new_age = jnp.minimum(jnp.where(upd, 0, age) + 1, age_clamp)
+        age_out[:, 0] = new_age.astype(age_out.dtype)
+        status_out[:, 0] = jnp.where(add, member, st).astype(status_out.dtype)
+
+    return kernel
+
+
+# Default receiver rows per fused-kernel block (config.merge_block_r
+# overrides via the block_r argument).  128 rows x 16384 cols puts the
+# in/out hb (int32) + age/status (int8) blocks + epilogue temporaries well
+# past Mosaic's 16 MB default scoped-VMEM budget — the pallas_call below
+# raises the limit, and 128 measured ~7% faster than 32 (fewer block
+# boundaries) at N=16k.  The floor is 32: the int8 block tile is (32, 128).
+_FUSED_BLOCK_R = 128
+_FUSED_BLOCK_R_MIN = 32
+
+
+def blocked_shape(n: int, block_c: int) -> tuple[int, int, int, int]:
+    """The kernel-native [N, N/C, C/128, 128] shape for an [N, N] lane.
+
+    TPU arrays are physically tiled; reshaping [N, N] into this 4-D form
+    (needed so a DMA can fetch one sender row as a tile-aligned block) is a
+    real relayout pass, ~1-3 ms per lane at N=16k.  core/rounds.py therefore
+    keeps the whole state in this blocked layout across the scan and
+    reshapes once at entry/exit instead of every round.
+    """
+    c_blk = min(block_c, n)
+    while n % c_blk:
+        c_blk //= 2
+    return (n, n // c_blk, c_blk // LANE, LANE)
+
+
+def fused_merge_update(
+    view: jax.Array,
+    edges: jax.Array,
+    hb: jax.Array,
+    age: jax.Array,
+    status: jax.Array,
+    base: jax.Array,
+    alive: jax.Array,
+    *,
+    member: int,
+    unknown: int,
+    age_clamp: int,
+    block_r: int = _FUSED_BLOCK_R,
+    block_c: int = 8192,  # match SimConfig.merge_block_c's default
+    slots: int = 4,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """2-D convenience wrapper around :func:`fused_merge_update_blocked`.
+
+    Takes/returns [N, N] lanes; each call pays the blocked-layout reshapes,
+    so the scan hot path uses the blocked variant directly.  Used by
+    core/rounds.py for ring topology, where per-round edge derivation needs
+    the 2-D layout anyway.
+    """
+    n = view.shape[0]
+    shp = blocked_shape(n, block_c)
+    h4, a4, s4 = fused_merge_update_blocked(
+        view.reshape(shp),
+        edges,
+        hb.reshape(shp),
+        age.reshape(shp),
+        status.reshape(shp),
+        base.reshape(shp[1:]),
+        alive,
+        member=member,
+        unknown=unknown,
+        age_clamp=age_clamp,
+        block_r=block_r,
+        slots=slots,
+        interpret=interpret,
+    )
+    return h4.reshape(n, n), a4.reshape(n, n), s4.reshape(n, n)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "member", "unknown", "age_clamp", "block_r", "slots", "interpret"
+    ),
+)
+def fused_merge_update_blocked(
+    view: jax.Array,
+    edges: jax.Array,
+    hb: jax.Array,
+    age: jax.Array,
+    status: jax.Array,
+    base: jax.Array,
+    alive: jax.Array,
+    *,
+    member: int,
+    unknown: int,
+    age_clamp: int,
+    block_r: int = _FUSED_BLOCK_R,
+    slots: int = 4,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Gossip merge + membership update + age advance in one pass.
+
+    Fuses the tail of core/rounds.py ``_merge`` (un-rebase, max-merge
+    advance, UNKNOWN add, fresh-stamp) and the post-merge ``age + 1`` clamp
+    into the gather kernel's epilogue, so the [N, N] hb/age/status lanes
+    are read and written exactly once per round instead of once by the
+    kernel plus once by a separate XLA pass (~25% of round time at N=16k).
+
+    All [N, N] lanes arrive in the :func:`blocked_shape` 4-D layout (the
+    scan keeps state blocked so no per-round relayout happens); ``base`` is
+    the per-subject rebase origin in the blocked [N/C, C/128, 128] form;
+    ``edges`` int32 [N, F]; ``alive`` int32 [N] (receiver liveness).
+    Returns the updated (hb, age, status), blocked.
+    """
+    n, nc, cs, _ = view.shape
+    fanout = edges.shape[1]
+    if not supported(n, fanout):
+        raise ValueError(
+            f"fused merge needs N % {LANE} == 0 and fanout >= 1 "
+            f"(N={n}, fanout={fanout}); use the XLA path"
+        )
+    c_blk = cs * LANE
+    r_blk = max(min(block_r, n), _FUSED_BLOCK_R_MIN)
+    while n % r_blk:
+        r_blk //= 2
+    n_slots = max(2, min(slots, r_blk))
+
+    # the alive gate, without a per-row vector operand: a dead receiver's
+    # edges all point at itself — a dead node is never a sender, so its own
+    # view row is all -1 and its merge is a no-op (only the age advance
+    # applies), exactly the reference semantics for a crashed process
+    self_idx = jnp.arange(n, dtype=edges.dtype)[:, None]
+    edges = jnp.where((alive != 0)[:, None], edges, self_idx)
+
+    row_spec = lambda i, j: (i, j, 0, 0)  # noqa: E731
+    lane_blk = lambda dt: pl.BlockSpec(  # noqa: E731
+        (r_blk, 1, cs, LANE), row_spec, memory_space=pltpu.VMEM
+    )
+    view4 = view
+    # receiver lanes indexed [row_block, row_in_block, col_block, ...] so a
+    # single DMA moves one (r_blk, cs, LANE) block; splitting the leading
+    # (untiled) axis is layout-free, unlike the [N, N] -> blocked reshape
+    hb5 = hb.reshape(n // r_blk, r_blk, nc, cs, LANE)
+    age5 = age.reshape(n // r_blk, r_blk, nc, cs, LANE)
+    status5 = status.reshape(n // r_blk, r_blk, nc, cs, LANE)
+    base3 = base
+    out = pl.pallas_call(
+        _fused_kernel(fanout, r_blk, n_slots, member, unknown, age_clamp),
+        grid=(n // r_blk, nc),
+        in_specs=[
+            pl.BlockSpec(
+                (r_blk, fanout), lambda i, j: (i, 0), memory_space=pltpu.SMEM
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((1, cs, LANE), lambda i, j: (j, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[lane_blk(hb.dtype), lane_blk(age.dtype), lane_blk(status.dtype)],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, nc, cs, LANE), hb.dtype),
+            jax.ShapeDtypeStruct((n, nc, cs, LANE), age.dtype),
+            jax.ShapeDtypeStruct((n, nc, cs, LANE), status.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((r_blk, cs, LANE), jnp.int32),
+            pltpu.VMEM((r_blk, cs, LANE), hb.dtype),
+            pltpu.VMEM((r_blk, cs, LANE), age.dtype),
+            pltpu.VMEM((r_blk, cs, LANE), status.dtype),
+            pltpu.VMEM((n_slots, fanout, cs, LANE), view.dtype),
+            pltpu.SemaphoreType.DMA((n_slots, fanout)),
+            pltpu.SemaphoreType.DMA((3,)),
+        ],
+        # 128-row blocks + the block-wide epilogue's widened int32
+        # temporaries put peak scoped-VMEM at ~85 MB with 16k-wide blocks —
+        # far above Mosaic's 16 MB default but inside the v5e's 128 MB
+        # physical VMEM
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=interpret,
+    )(edges, view4, hb5, age5, status5, base3)
+    return tuple(out)
 
 
 def fanout_max_merge_xla(view: jax.Array, edges: jax.Array) -> jax.Array:
