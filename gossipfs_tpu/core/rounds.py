@@ -52,41 +52,72 @@ from gossipfs_tpu.core.state import FAILED, MEMBER, UNKNOWN, RoundEvents, SimSta
 # ---------------------------------------------------------------------------
 
 
+class ShardCtx(NamedTuple):
+    """Where this program sits in a subject-axis shard_map, if any.
+
+    The single-device run uses the module default (no axis, offset 0).
+    Under ``parallel.mesh.run_rounds_sharded`` each shard holds all N
+    receiver rows for a contiguous slice of subjects: ``axis`` names the
+    mesh axis for the few cross-shard reductions (member counts, metric
+    sums), ``offset`` is the shard's first global subject index (so the
+    diagonal mask and subject-vector slices line up).
+    """
+
+    axis: str | None
+    offset: jax.Array | int
+
+    def slice_cols(self, v: jax.Array, nloc: int) -> jax.Array:
+        """This shard's slice of a replicated per-subject [N] vector."""
+        if self.axis is None:
+            return v
+        return lax.dynamic_slice_in_dim(v, self.offset, nloc)
+
+    def psum(self, x: jax.Array) -> jax.Array:
+        """Combine a subject-axis partial reduction across shards."""
+        return x if self.axis is None else lax.psum(x, self.axis)
+
+
+LOCAL_CTX = ShardCtx(axis=None, offset=0)
+
+
+def _nsubj(shape: tuple[int, ...]) -> int:
+    out = 1
+    for s in shape[1:]:
+        out *= s
+    return out
+
+
 def _rx(v: jax.Array, ndim: int) -> jax.Array:
     """Broadcast a per-receiver [N] vector over the subject axes."""
     return v.reshape(v.shape[:1] + (1,) * (ndim - 1))
 
 
-def _sj(v: jax.Array, shape: tuple[int, ...]) -> jax.Array:
-    """Broadcast a per-subject [N] vector over the receiver axis."""
-    return v.reshape(shape[1:])[None]
+def _sj(v: jax.Array, shape: tuple[int, ...], ctx: ShardCtx = LOCAL_CTX) -> jax.Array:
+    """Broadcast a (global) per-subject [N] vector over the receiver axis."""
+    return ctx.slice_cols(v, _nsubj(shape)).reshape(shape[1:])[None]
 
 
-def _eye(n: int, shape: tuple[int, ...]) -> jax.Array:
-    """bool mask of the diagonal (receiver == subject), shape-generic."""
-    idx = jnp.arange(n, dtype=jnp.int32)
-    return _rx(idx, len(shape)) == _sj(idx, shape)
+def _eye(n: int, shape: tuple[int, ...], ctx: ShardCtx = LOCAL_CTX) -> jax.Array:
+    """bool mask of the diagonal (receiver == subject), shape/shard-generic."""
+    rows = jnp.arange(n, dtype=jnp.int32)
+    cols = ctx.offset + jnp.arange(_nsubj(shape), dtype=jnp.int32)
+    return _rx(rows, len(shape)) == cols.reshape(shape[1:])[None]
 
 
 def _subj_axes(a: jax.Array) -> tuple[int, ...]:
     return tuple(range(1, a.ndim))
 
 
-def _flat(v: jax.Array, n: int) -> jax.Array:
-    """Collapse a per-subject result (subject-shaped) back to [N]."""
-    return v.reshape(n)
-
-
-def _use_pallas(config: SimConfig, fanout: int, n: int) -> bool:
+def _use_pallas(config: SimConfig, fanout: int, n: int, n_cols: int | None = None) -> bool:
     """Whether this run executes the pallas merge kernel."""
     from gossipfs_tpu.ops import merge_pallas
 
-    if config.merge_kernel == "xla" or not merge_pallas.supported(n, fanout):
+    if config.merge_kernel == "xla" or not merge_pallas.supported(n, fanout, n_cols):
         return False
     return config.merge_kernel == "pallas_interpret" or jax.default_backend() == "tpu"
 
 
-def _use_blocked(config: SimConfig, fanout: int, n: int) -> bool:
+def _use_blocked(config: SimConfig, fanout: int, n: int, n_cols: int | None = None) -> bool:
     """Whether the scan keeps state in the kernel's blocked layout.
 
     Ring mode re-derives edges from the 2-D membership tables every round,
@@ -94,13 +125,14 @@ def _use_blocked(config: SimConfig, fanout: int, n: int) -> bool:
     ring (the parity mode, never the perf mode) stays 2-D and reaches the
     pallas kernel through the reshaping wrapper instead.
     """
-    return _use_pallas(config, fanout, n) and config.topology != "ring"
+    return _use_pallas(config, fanout, n, n_cols) and config.topology != "ring"
 
 
 def _to_blocked(state: SimState, config: SimConfig) -> SimState:
     from gossipfs_tpu.ops import merge_pallas
 
-    shp = merge_pallas.blocked_shape(state.n, config.merge_block_c)
+    rows, cols = state.hb.shape  # cols < rows under subject-axis sharding
+    shp = (rows,) + merge_pallas.blocked_cols(cols, config.merge_block_c)
     return state._replace(
         hb=state.hb.reshape(shp),
         age=state.age.reshape(shp),
@@ -109,11 +141,12 @@ def _to_blocked(state: SimState, config: SimConfig) -> SimState:
 
 
 def _from_blocked(state: SimState) -> SimState:
-    n = state.n
+    rows = state.n
+    cols = _nsubj(state.hb.shape)
     return state._replace(
-        hb=state.hb.reshape(n, n),
-        age=state.age.reshape(n, n),
-        status=state.status.reshape(n, n),
+        hb=state.hb.reshape(rows, cols),
+        age=state.age.reshape(rows, cols),
+        status=state.status.reshape(rows, cols),
     )
 
 
@@ -143,7 +176,9 @@ class MetricsCarry(NamedTuple):
         return MetricsCarry(first_detect=neg, converged=neg)
 
 
-def _apply_events(state: SimState, events: RoundEvents, config: SimConfig) -> SimState:
+def _apply_events(
+    state: SimState, events: RoundEvents, config: SimConfig, ctx: ShardCtx = LOCAL_CTX
+) -> SimState:
     """Crash / leave / join, before the heartbeat tick (see module docstring).
 
     All-false event masks flow through as plain masked passes: XLA fuses
@@ -160,7 +195,7 @@ def _apply_events(state: SimState, events: RoundEvents, config: SimConfig) -> Si
     # (removeMember appends the live Member struct, slave.go:276-286), so age
     # keeps running — cooldown is measured from the last gossip refresh.
     leave = events.leave & alive
-    mark = _rx(alive, nd) & (status == MEMBER) & _sj(leave, shp)
+    mark = _rx(alive, nd) & (status == MEMBER) & _sj(leave, shp, ctx)
     status = jnp.where(mark, FAILED, status)
     if config.fresh_cooldown:
         age = jnp.where(mark, 0, age)
@@ -178,13 +213,13 @@ def _apply_events(state: SimState, events: RoundEvents, config: SimConfig) -> Si
 
     # introducer's own row: unconditional append at hb=0
     intro_row_add = eff & (jnp.arange(n) != intro)
-    intro_sel = _rx(jnp.arange(n) == intro, nd) & _sj(intro_row_add, shp)
+    intro_sel = _rx(jnp.arange(n) == intro, nd) & _sj(intro_row_add, shp, ctx)
     status = jnp.where(intro_sel, MEMBER, status)
     hb = jnp.where(intro_sel, 0, hb)
     age = jnp.where(intro_sel, 0, age)
 
     # everyone else merges the introducer's pushed list: add joiner if UNKNOWN
-    recv_add = _rx(alive, nd) & (status == UNKNOWN) & _sj(eff, shp)
+    recv_add = _rx(alive, nd) & (status == UNKNOWN) & _sj(eff, shp, ctx)
     status = jnp.where(recv_add, MEMBER, status)
     hb = jnp.where(recv_add, 0, hb)
     age = jnp.where(recv_add, 0, age)
@@ -198,7 +233,7 @@ def _apply_events(state: SimState, events: RoundEvents, config: SimConfig) -> Si
     hb = jnp.where(new_row, joiner_hb[None], hb)
     age = jnp.where(new_row, 0, age)
     # self entry always present (InitMembership, slave.go:161-167)
-    self_sel = new_row & _eye(n, shp)
+    self_sel = new_row & _eye(n, shp, ctx)
     status = jnp.where(self_sel, MEMBER, status)
     hb = jnp.where(self_sel, 0, hb)
 
@@ -207,7 +242,7 @@ def _apply_events(state: SimState, events: RoundEvents, config: SimConfig) -> Si
 
 
 def _tick(
-    state: SimState, config: SimConfig
+    state: SimState, config: SimConfig, ctx: ShardCtx = LOCAL_CTX
 ) -> tuple[SimState, jax.Array, jax.Array]:
     """Per-node heartbeat pass: refresh/bump/detect/remove-broadcast/cooldown.
 
@@ -216,9 +251,12 @@ def _tick(
     n = state.n
     hb, age, status, alive = state.hb, state.age, state.status, state.alive
     nd, shp = hb.ndim, hb.shape
-    eye = _eye(n, shp)
+    eye = _eye(n, shp, ctx)
 
-    counts = jnp.sum((status == MEMBER).astype(jnp.int32), axis=_subj_axes(status))
+    # cross-shard under run_rounds_sharded: each shard holds a column slice
+    counts = ctx.psum(
+        jnp.sum((status == MEMBER).astype(jnp.int32), axis=_subj_axes(status))
+    )
     small = counts < config.min_group
     active = alive & ~small
     refresher = alive & small
@@ -323,7 +361,7 @@ def _merge(
     # Both paths include the post-merge global age advance (everything not
     # refreshed this round ages by one, saturating at AGE_CLAMP) so the
     # fused kernel can write each [N, N] lane exactly once.
-    if _use_pallas(config, edges.shape[1], state.n):
+    if _use_pallas(config, edges.shape[1], state.n, _nsubj(hb.shape)):
         kernel_kwargs = dict(
             member=int(MEMBER),
             unknown=int(UNKNOWN),
@@ -371,11 +409,13 @@ def _round_core(
     events: RoundEvents,
     edges: jax.Array | None,
     config: SimConfig,
+    ctx: ShardCtx = LOCAL_CTX,
 ) -> tuple[SimState, RoundMetrics, jax.Array]:
-    """One round, layout-generic (state may be 2-D or blocked)."""
+    """One round, layout- and shard-generic (state may be 2-D or blocked,
+    square or a subject-axis shard)."""
     n = state.n
-    state = _apply_events(state, events, config)
-    state, fail, active = _tick(state, config)
+    state = _apply_events(state, events, config, ctx)
+    state, fail, active = _tick(state, config, ctx)
     if config.topology == "ring":
         edges = topology.ring_edges_from_status(state.status.reshape(n, n))
     assert edges is not None
@@ -387,8 +427,12 @@ def _round_core(
 
     dead = ~state.alive
     metrics = RoundMetrics(
-        true_detections=jnp.sum(fail & _sj(dead, fail.shape), dtype=jnp.int32),
-        false_positives=jnp.sum(fail & _sj(state.alive, fail.shape), dtype=jnp.int32),
+        true_detections=ctx.psum(
+            jnp.sum(fail & _sj(dead, fail.shape, ctx), dtype=jnp.int32)
+        ),
+        false_positives=ctx.psum(
+            jnp.sum(fail & _sj(state.alive, fail.shape, ctx), dtype=jnp.int32)
+        ),
         n_alive=jnp.sum(state.alive, dtype=jnp.int32),
     )
     return state, metrics, fail
@@ -427,21 +471,70 @@ def _update_carry(
     rejoined: jax.Array,
     fail: jax.Array,
     round_idx: jax.Array,
+    ctx: ShardCtx = LOCAL_CTX,
 ) -> MetricsCarry:
     n = state.n
     nd, shp = state.status.ndim, state.status.shape
-    first_detect, converged = carry
+    nloc = _nsubj(shp)
+    first_detect, converged = carry  # [nloc] — this shard's subject slice
     # rejoined = joins that actually took effect: new incarnation, new clock
-    first_detect = jnp.where(rejoined, -1, first_detect)
-    converged = jnp.where(rejoined, -1, converged)
+    rejoined_l = ctx.slice_cols(rejoined, nloc)
+    first_detect = jnp.where(rejoined_l, -1, first_detect)
+    converged = jnp.where(rejoined_l, -1, converged)
 
-    any_fail = _flat(jnp.any(fail, axis=0), n)
+    any_fail = jnp.any(fail, axis=0).reshape(nloc)
     first_detect = jnp.where((first_detect < 0) & any_fail, round_idx, first_detect)
 
-    dropped = ~_rx(state.alive, nd) | _eye(n, shp) | (state.status != MEMBER)
-    all_dropped = _flat(jnp.all(dropped, axis=0), n) & ~state.alive
+    dropped = ~_rx(state.alive, nd) | _eye(n, shp, ctx) | (state.status != MEMBER)
+    alive_l = ctx.slice_cols(state.alive, nloc)
+    all_dropped = jnp.all(dropped, axis=0).reshape(nloc) & ~alive_l
     converged = jnp.where((converged < 0) & all_dropped, round_idx, converged)
     return MetricsCarry(first_detect=first_detect, converged=converged)
+
+
+def _scan_rounds(
+    state: SimState,
+    config: SimConfig,
+    key: jax.Array,
+    events: RoundEvents,
+    crash_rate: float,
+    rejoin_rate: float,
+    churn_ok: jax.Array | None,
+    ctx: ShardCtx,
+) -> tuple[SimState, MetricsCarry, RoundMetrics]:
+    """The shared scan over rounds (state in its final layout already).
+
+    Called by :func:`run_rounds` (single program, possibly GSPMD-sharded on
+    the XLA path) and by ``parallel.mesh.run_rounds_sharded`` (explicit
+    shard_map, per-shard state).  Churn masks and edges derive from
+    replicated inputs (alive, key), so every shard computes identical
+    events — no cross-shard communication beyond ``ctx.psum``.
+    """
+    def step(carry, ev: RoundEvents):
+        st, mc = carry
+        k = jax.random.fold_in(key, st.round)
+        k_edge, k_churn = jax.random.split(k)
+        if crash_rate > 0.0 or rejoin_rate > 0.0:
+            crash, join = topology.churn_masks(k_churn, st.alive, crash_rate, rejoin_rate)
+            if churn_ok is not None:
+                crash, join = crash & churn_ok, join & churn_ok
+            ev = RoundEvents(crash=ev.crash | crash, leave=ev.leave, join=ev.join | join)
+        edges = (
+            None
+            if config.topology == "ring"
+            else topology.random_in_edges(k_edge, config.n, config.fanout)
+        )
+        round_idx = st.round
+        alive_before = st.alive
+        st, metrics, fail = _round_core(st, ev, edges, config, ctx)
+        # joins lost to a dead introducer don't reset metrics (slave.go:22 SPOF)
+        rejoined = ev.join & ~alive_before & st.alive
+        mc = _update_carry(mc, st, rejoined, fail, round_idx, ctx)
+        return (st, mc), metrics
+
+    init_carry = (state, MetricsCarry.init(_nsubj(state.hb.shape)))
+    (state, mcarry), per_round = lax.scan(step, init_carry, events)
+    return state, mcarry, per_round
 
 
 @partial(
@@ -468,6 +561,11 @@ def run_rounds(
     can't reset the tracked detection/convergence rounds mid-measurement.
     Returns final state, per-subject detection/convergence rounds, and
     per-round metrics stacked over the horizon.
+
+    For multi-device runs on the pallas path use
+    ``parallel.mesh.run_rounds_sharded`` — under plain GSPMD the pallas
+    custom call has no partitioning rule and XLA all-gathers the full state
+    around it; the XLA merge path partitions cleanly either way.
     """
     n = config.n
     if events is None:
@@ -478,30 +576,9 @@ def run_rounds(
     if blocked:
         # one relayout for the whole horizon (see module header)
         state = _to_blocked(state, config)
-
-    def step(carry, ev: RoundEvents):
-        st, mc = carry
-        k = jax.random.fold_in(key, st.round)
-        k_edge, k_churn = jax.random.split(k)
-        if crash_rate > 0.0 or rejoin_rate > 0.0:
-            crash, join = topology.churn_masks(k_churn, st.alive, crash_rate, rejoin_rate)
-            if churn_ok is not None:
-                crash, join = crash & churn_ok, join & churn_ok
-            ev = RoundEvents(crash=ev.crash | crash, leave=ev.leave, join=ev.join | join)
-        edges = (
-            None
-            if config.topology == "ring"
-            else topology.random_in_edges(k_edge, config.n, config.fanout)
-        )
-        round_idx = st.round
-        alive_before = st.alive
-        st, metrics, fail = _round_core(st, ev, edges, config)
-        # joins lost to a dead introducer don't reset metrics (slave.go:22 SPOF)
-        rejoined = ev.join & ~alive_before & st.alive
-        mc = _update_carry(mc, st, rejoined, fail, round_idx)
-        return (st, mc), metrics
-
-    (state, mcarry), per_round = lax.scan(step, (state, MetricsCarry.init(n)), events)
+    state, mcarry, per_round = _scan_rounds(
+        state, config, key, events, crash_rate, rejoin_rate, churn_ok, LOCAL_CTX
+    )
     if blocked:
         state = _from_blocked(state)
     return state, mcarry, per_round

@@ -102,9 +102,19 @@ def _kernel(n_fanout: int, r_blk: int, slots: int):
     return kernel
 
 
-def supported(n: int, fanout: int) -> bool:
-    """Whether the kernel's tiling constraints admit this problem size."""
-    return n % LANE == 0 and n >= LANE and fanout >= 1
+def supported(n: int, fanout: int, n_cols: int | None = None) -> bool:
+    """Whether the kernel's tiling constraints admit this problem size.
+
+    ``n_cols`` (default: square) is the local subject count — smaller than
+    ``n`` under subject-axis sharding, where each shard must still be
+    lane-aligned.
+    """
+    if n_cols is None:
+        n_cols = n
+    return (
+        n % LANE == 0 and n >= LANE and n_cols % LANE == 0 and n_cols >= LANE
+        and fanout >= 1
+    )
 
 
 @functools.partial(
@@ -251,6 +261,18 @@ _FUSED_BLOCK_R = 128
 _FUSED_BLOCK_R_MIN = 32
 
 
+def blocked_cols(n_cols: int, block_c: int) -> tuple[int, int, int]:
+    """The kernel-native column blocking [C_total/C, C/128, 128].
+
+    Columns may be fewer than rows: under subject-axis sharding each shard
+    blocks its local column slice independently.
+    """
+    c_blk = min(block_c, n_cols)
+    while n_cols % c_blk:
+        c_blk //= 2
+    return (n_cols // c_blk, c_blk // LANE, LANE)
+
+
 def blocked_shape(n: int, block_c: int) -> tuple[int, int, int, int]:
     """The kernel-native [N, N/C, C/128, 128] shape for an [N, N] lane.
 
@@ -260,10 +282,7 @@ def blocked_shape(n: int, block_c: int) -> tuple[int, int, int, int]:
     keeps the whole state in this blocked layout across the scan and
     reshapes once at entry/exit instead of every round.
     """
-    c_blk = min(block_c, n)
-    while n % c_blk:
-        c_blk //= 2
-    return (n, n // c_blk, c_blk // LANE, LANE)
+    return (n,) + blocked_cols(n, block_c)
 
 
 def fused_merge_update(

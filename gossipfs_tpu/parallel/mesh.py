@@ -21,6 +21,8 @@ Everything goes through GSPMD: we annotate inputs with NamedSharding and let
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -55,3 +57,103 @@ def shard_state(state: SimState, mesh: Mesh) -> SimState:
     """Place an (unsharded) SimState onto the mesh with column sharding."""
     sh = state_shardings(mesh)
     return jax.tree.map(jax.device_put, state, sh)
+
+
+@functools.lru_cache(maxsize=32)
+def _sharded_runner(mesh, config, crash_rate, rejoin_rate, has_churn_ok):
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from gossipfs_tpu.core import rounds
+    from gossipfs_tpu.core.state import RoundEvents, SimState as SS
+
+    n = config.n
+    d = mesh.devices.size
+    nloc = n // d
+    mat = P(None, AXIS)
+    rep = P()
+
+    def local_run(hb, age, status, alive, rnd, ev_crash, ev_leave, ev_join,
+                  key, churn_ok):
+        ctx = rounds.ShardCtx(axis=AXIS, offset=lax.axis_index(AXIS) * nloc)
+        st = SS(hb=hb, age=age, status=status, alive=alive, round=rnd)
+        blocked = rounds._use_blocked(config, config.fanout, n, nloc)
+        if blocked:
+            st = rounds._to_blocked(st, config)
+        ev = RoundEvents(crash=ev_crash, leave=ev_leave, join=ev_join)
+        st, mc, pr = rounds._scan_rounds(
+            st, config, key, ev, crash_rate, rejoin_rate,
+            churn_ok if has_churn_ok else None, ctx,
+        )
+        if blocked:
+            st = rounds._from_blocked(st)
+        return st.hb, st.age, st.status, st.alive, st.round, mc, pr
+
+    fn = jax.shard_map(
+        local_run,
+        mesh=mesh,
+        in_specs=(mat, mat, mat, rep, rep, rep, rep, rep, rep, rep),
+        out_specs=(mat, mat, mat, rep, rep,
+                   rounds.MetricsCarry(P(AXIS), P(AXIS)),
+                   rounds.RoundMetrics(rep, rep, rep)),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def run_rounds_sharded(
+    state: SimState,
+    config,
+    num_rounds: int,
+    key: jax.Array,
+    mesh: Mesh,
+    events=None,
+    crash_rate: float = 0.0,
+    rejoin_rate: float = 0.0,
+    churn_ok: jax.Array | None = None,
+):
+    """``core.rounds.run_rounds`` over an explicit subject-axis shard_map.
+
+    Under plain GSPMD the pallas merge kernel is an opaque custom call —
+    XLA has no partitioning rule for it and inserts full-matrix all-gathers
+    around each round.  shard_map instead runs the identical round program
+    per shard on its local [N, N/D] column slice: the row gather is 100%
+    shard-local by construction, and only the [N]-vector reductions
+    (member counts, metric sums) cross shards via ``psum`` over ICI/DCN.
+    This is the v5e-8 path for the BASELINE 100k-member configs.
+
+    Requires n % n_devices == 0 and (for the pallas path) a lane-aligned
+    local column count — e.g. the 100k-class config runs N=131072 on 8
+    chips (16384 columns each).  Ring (parity) topology needs the full
+    2-D tables per round and is not supported here; use ``run_rounds``.
+    """
+    import jax.numpy as jnp
+
+    from gossipfs_tpu.core.state import RoundEvents
+
+    n = config.n
+    d = mesh.devices.size
+    if config.topology == "ring":
+        raise ValueError("ring topology derives edges from the full table; "
+                         "use run_rounds (GSPMD) instead")
+    if n % d:
+        raise ValueError(f"n={n} must divide over {d} devices")
+    if events is None:
+        zeros = jnp.zeros((num_rounds, n), dtype=bool)
+        events = RoundEvents(crash=zeros, leave=zeros, join=zeros)
+    if churn_ok is None:
+        churn_ok_arr = jnp.ones((n,), dtype=bool)  # placeholder, unused
+    else:
+        churn_ok_arr = churn_ok
+
+    fn = _sharded_runner(mesh, config, crash_rate, rejoin_rate,
+                         churn_ok is not None)
+    hb, age, status, alive, rnd, mc, pr = fn(
+        state.hb, state.age, state.status, state.alive, state.round,
+        events.crash, events.leave, events.join, key, churn_ok_arr,
+    )
+    return (
+        SimState(hb=hb, age=age, status=status, alive=alive, round=rnd),
+        mc,
+        pr,
+    )

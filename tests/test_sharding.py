@@ -61,6 +61,76 @@ class TestShardedEquivalence:
         jax.tree.map(lambda *_: None, st, sh)  # same structure or raises
 
 
+class TestShardMapRunner:
+    """run_rounds_sharded: the explicit shard_map path the pallas kernel
+    needs on a real multi-chip mesh (GSPMD would all-gather around the
+    custom call).  Must be bit-identical to the single-device run."""
+
+    @pytest.mark.parametrize("kernel", ["xla", "pallas_interpret"])
+    def test_matches_single_device(self, kernel):
+        from gossipfs_tpu.core.state import RoundEvents
+        from gossipfs_tpu.parallel.mesh import run_rounds_sharded
+
+        cfg = SimConfig(n=1024, topology="random", fanout=8, merge_kernel=kernel)
+        crash = np.zeros((30, cfg.n), dtype=bool)
+        crash[5, [7, 300]] = True
+        join = np.zeros((30, cfg.n), dtype=bool)
+        join[20, 7] = True
+        z = jnp.zeros((30, cfg.n), dtype=bool)
+        ev = RoundEvents(crash=jnp.asarray(crash), leave=z, join=jnp.asarray(join))
+
+        base = run_rounds(init_state(cfg), cfg, 30, KEY, events=ev, crash_rate=0.01)
+        mesh = make_mesh()
+        st = shard_state(init_state(cfg), mesh)
+        got = run_rounds_sharded(st, cfg, 30, KEY, mesh, events=ev, crash_rate=0.01)
+        for a, b in zip(jax.tree.leaves(base), jax.tree.leaves(got)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert tuple(got[0].hb.sharding.spec) == (None, AXIS)
+
+    def test_no_matrix_allgathers_on_pallas_path(self):
+        """The whole point: the row gather must be shard-local, with only
+        [N]-vector reductions crossing shards."""
+        from gossipfs_tpu.parallel import mesh as pm
+
+        cfg = SimConfig(n=1024, topology="random", fanout=8,
+                        merge_kernel="pallas_interpret")
+        m = make_mesh()
+        st = shard_state(init_state(cfg), m)
+        z = jnp.zeros((5, cfg.n), dtype=bool)
+        from gossipfs_tpu.core.state import RoundEvents
+
+        ev = RoundEvents(crash=z, leave=z, join=z)
+        fn = pm._sharded_runner(m, cfg, 0.0, 0.0, False)
+        hlo = fn.lower(
+            st.hb, st.age, st.status, st.alive, st.round,
+            ev.crash, ev.leave, ev.join, KEY, jnp.ones((cfg.n,), bool),
+        ).compile().as_text()
+        assert "all-gather" not in hlo
+
+    def test_non_lane_aligned_shard_falls_back_to_xla(self):
+        """nloc=64 < the 128-lane tile: the pallas gate must see the local
+        column count and fall back to the XLA path rather than crash."""
+        from gossipfs_tpu.parallel.mesh import run_rounds_sharded
+
+        cfg = SimConfig(n=512, topology="random", fanout=6,
+                        merge_kernel="pallas_interpret")
+        base = run_rounds(init_state(cfg), cfg, 10, KEY, crash_rate=0.02)
+        mesh = make_mesh()
+        st = shard_state(init_state(cfg), mesh)
+        got = run_rounds_sharded(st, cfg, 10, KEY, mesh, crash_rate=0.02)
+        for a, b in zip(jax.tree.leaves(base), jax.tree.leaves(got)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_ring_rejected(self):
+        from gossipfs_tpu.parallel.mesh import run_rounds_sharded
+
+        cfg = SimConfig(n=64, topology="ring", fanout=3)
+        mesh = make_mesh()
+        st = shard_state(init_state(cfg), mesh)
+        with pytest.raises(ValueError, match="ring"):
+            run_rounds_sharded(st, cfg, 5, KEY, mesh)
+
+
 class TestPlacementBatch:
     def test_distinct_live_replicas(self):
         alive = jnp.ones((32,), dtype=bool).at[jnp.array([3, 4, 5])].set(False)
