@@ -70,6 +70,22 @@ def tracked_crash_events(
     return events, {node: at for node in nodes}, jnp.asarray(churn_ok)
 
 
+def _runner(cfg: SimConfig, mesh):
+    """run_rounds, or the shard_map variant on a real multi-device mesh.
+
+    The pallas merge kernel has no GSPMD partitioning rule (plain jit
+    would all-gather the full state around it every round), so sharded
+    random-topology runs go through parallel.mesh.run_rounds_sharded.
+    """
+    if mesh is None or mesh.devices.size <= 1 or cfg.topology == "ring":
+        return run_rounds
+    from gossipfs_tpu.parallel.mesh import run_rounds_sharded
+
+    return lambda state, cfg, rounds, key, **kw: run_rounds_sharded(
+        state, cfg, rounds, key, mesh, **kw
+    )
+
+
 def _timed_run(
     state: SimState,
     cfg: SimConfig,
@@ -78,9 +94,11 @@ def _timed_run(
     events: RoundEvents,
     sc: presets.Scenario,
     churn_ok: jax.Array | None = None,
+    mesh=None,
 ) -> tuple[SimState, MetricsCarry, RoundMetrics, float]:
     """Compile (warmup) then time one full scan; returns outputs + seconds."""
-    run = lambda: run_rounds(
+    runner = _runner(cfg, mesh)
+    run = lambda: runner(
         state,
         cfg,
         rounds,
@@ -131,15 +149,16 @@ def run_cosim(
     elections = 0
     done = 0
     alive: list[int] = []
+    runner = _runner(cfg, mesh)
     # warm up the chunk kernel so compile time stays out of the timed region
     jax.block_until_ready(
-        run_rounds(
+        runner(
             state, cfg, chunk, key, crash_rate=sc.crash_rate, rejoin_rate=sc.rejoin_rate
         )[0]
     )
     t0 = time.perf_counter()
     for _ in range(n_chunks):
-        state, _, _ = run_rounds(
+        state, _, _ = runner(
             state, cfg, chunk, key, crash_rate=sc.crash_rate, rejoin_rate=sc.rejoin_rate
         )
         done += chunk
@@ -210,7 +229,7 @@ def run_scenario(
         state = shard_state(state, mesh)
     key = jax.random.PRNGKey(seed)
     final, carry, per_round, elapsed = _timed_run(
-        state, cfg, rounds, key, events, sc, churn_ok
+        state, cfg, rounds, key, events, sc, churn_ok, mesh=mesh
     )
     report = summarize(carry, per_round, crash_rounds)
 
