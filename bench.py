@@ -73,6 +73,9 @@ def main() -> None:
         # the merge at N=16k (see BASELINE.md)
         view_dtype="int8",
         merge_block_c=16_384,
+        # int16 hb storage (counters relative to hb_base, renormalized by the
+        # merge write) halves the fattest lane's HBM traffic
+        hb_dtype="int16",
     )
     key = jax.random.PRNGKey(0)
     state = init_state(cfg)

@@ -88,6 +88,14 @@ class SimConfig:
                                      # its 126-round rebase window only covers
                                      # short-diameter (random) topologies —
                                      # rejected for the parity ring
+    hb_dtype: str = "int32"          # heartbeat-lane storage: "int32" (exact
+                                     # counters, reference parity) | "int16"
+                                     # (counters stored relative to the
+                                     # per-subject ``hb_base``, renormalized
+                                     # every round by the merge — halves the
+                                     # fattest lane's HBM traffic and memory;
+                                     # random topologies only, same lag
+                                     # argument as the view rebase)
 
     def __post_init__(self) -> None:
         if self.n < 2:
@@ -107,6 +115,12 @@ class SimConfig:
             raise ValueError(f"unknown merge_kernel: {self.merge_kernel!r}")
         if self.view_dtype not in ("int16", "int8"):
             raise ValueError(f"unknown view_dtype: {self.view_dtype!r}")
+        if self.hb_dtype not in ("int32", "int16"):
+            raise ValueError(f"unknown hb_dtype: {self.hb_dtype!r}")
+        if self.hb_dtype == "int16" and self.topology == "ring":
+            # stored counters sit within REBASE_WINDOW of the per-subject
+            # maximum; ring lag grows ~N/2 and can cross that window
+            raise ValueError("hb_dtype='int16' requires topology='random'")
         if self.view_dtype == "int8":
             if self.topology == "ring":
                 # steady-state ring lag grows with graph distance (~N/2

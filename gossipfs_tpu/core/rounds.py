@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from gossipfs_tpu.config import AGE_CLAMP, SimConfig
+from gossipfs_tpu.config import AGE_CLAMP, REBASE_WINDOW, SimConfig
 from gossipfs_tpu.core import topology
 from gossipfs_tpu.core.state import FAILED, MEMBER, UNKNOWN, RoundEvents, SimState
 
@@ -189,6 +189,10 @@ def _apply_events(
     """
     hb, age, status, alive = state.hb, state.age, state.status, state.alive
     n, nd, shp = state.n, hb.ndim, hb.shape
+    # the stored encoding of "true heartbeat 0" (see SimState.hb_base):
+    # 0 - base per subject, saturating; identically 0 in int32 mode
+    basec = state.hb_base.reshape(shp[1:])[None]
+    hz = jnp.clip(-basec, jnp.iinfo(hb.dtype).min, 0).astype(hb.dtype)
 
     # -- leave: broadcast LEAVE, receivers remove + fail-list (slave.go:310-336).
     # The entry moves onto the fail list keeping its *existing* timestamp
@@ -215,19 +219,19 @@ def _apply_events(
     intro_row_add = eff & (jnp.arange(n) != intro)
     intro_sel = _rx(jnp.arange(n) == intro, nd) & _sj(intro_row_add, shp, ctx)
     status = jnp.where(intro_sel, MEMBER, status)
-    hb = jnp.where(intro_sel, 0, hb)
+    hb = jnp.where(intro_sel, hz, hb)
     age = jnp.where(intro_sel, 0, age)
 
     # everyone else merges the introducer's pushed list: add joiner if UNKNOWN
     recv_add = _rx(alive, nd) & (status == UNKNOWN) & _sj(eff, shp, ctx)
     status = jnp.where(recv_add, MEMBER, status)
-    hb = jnp.where(recv_add, 0, hb)
+    hb = jnp.where(recv_add, hz, hb)
     age = jnp.where(recv_add, 0, age)
 
     # the joiner's fresh table = the introducer's post-append row (it receives
     # the same full-list push); a fresh process has an empty fail list.
     joiner_status = jnp.where(status[intro] == MEMBER, MEMBER, UNKNOWN)
-    joiner_hb = jnp.where(status[intro] == MEMBER, hb[intro], 0)
+    joiner_hb = jnp.where(status[intro] == MEMBER, hb[intro], hz[0])
     new_row = _rx(eff, nd)
     status = jnp.where(new_row, joiner_status[None], status)
     hb = jnp.where(new_row, joiner_hb[None], hb)
@@ -235,10 +239,10 @@ def _apply_events(
     # self entry always present (InitMembership, slave.go:161-167)
     self_sel = new_row & _eye(n, shp, ctx)
     status = jnp.where(self_sel, MEMBER, status)
-    hb = jnp.where(self_sel, 0, hb)
+    hb = jnp.where(self_sel, hz, hb)
 
     alive = alive | eff
-    return SimState(hb=hb, age=age, status=status, alive=alive, round=state.round)
+    return state._replace(hb=hb, age=age, status=status, alive=alive)
 
 
 def _tick(
@@ -269,17 +273,25 @@ def _tick(
     # list (updateMemberList matches by address, slave.go:443-448; a node that
     # processed a REMOVE about itself stops bumping)
     bump = eye & _rx(active, nd) & (status == MEMBER)
-    hb = hb + bump.astype(jnp.int32)
+    hb = hb + bump.astype(hb.dtype)
     age = jnp.where(bump, 0, age)
 
     # failure detection (slave.go:460-476): member, not self, past the hb
     # grace, and silent for more than t_fail rounds.  Removed entries keep
     # their stale timestamp on the fail list (slave.go:276-286): age runs on.
+    # in int16 mode the grace compare shifts by the per-subject base
+    # (true hb = stored + base); entries saturated at the storage floor
+    # have unknown true counters and are excluded (the zombie-rejoin
+    # corner, same class as the view-rebase clamp in _merge)
+    basec = state.hb_base.reshape(shp[1:])[None]
+    past_grace = hb.astype(jnp.int32) > (config.hb_grace - basec)
+    if hb.dtype == jnp.int16:
+        past_grace &= hb != jnp.iinfo(jnp.int16).min
     fail = (
         _rx(active, nd)
         & (status == MEMBER)
         & ~eye
-        & (hb > config.hb_grace)
+        & past_grace
         & (age > config.t_fail)
     )
     status = jnp.where(fail, FAILED, status)
@@ -304,7 +316,7 @@ def _tick(
     status = jnp.where(expire, UNKNOWN, status)
 
     return (
-        SimState(hb=hb, age=age, status=status, alive=alive, round=state.round),
+        state._replace(hb=hb, age=age, status=status, alive=alive),
         fail,
         active,
     )
@@ -351,10 +363,32 @@ def _merge(
     # those counts anyway (slave.go:419-424); dissemination rides the
     # introducer's join broadcast in both worlds.
     nd = hb.ndim
+    hb16 = hb.dtype == jnp.int16
+    basec = state.hb_base.reshape(hb.shape[1:])  # subject-shaped, all-zero in int32 mode
     elig = (status == MEMBER) & _rx(senders, nd)
-    colmax = jnp.max(jnp.where(elig, hb, 0), axis=0)        # int32, subject-shaped
-    base = jnp.maximum(colmax - config.rebase_window, 0)
-    rel = hb - base[None]
+    # true colmax: stored values are relative to basec (identity in int32
+    # mode); the filler encodes true hb 0 so the implicit floor matches
+    hb32 = hb.astype(jnp.int32)
+    colmax = jnp.max(jnp.where(elig, hb32, -basec[None]), axis=0) + basec
+    view_base = jnp.maximum(colmax - config.rebase_window, 0)
+    # A: shift from stored to view encoding (== view_base in int32 mode).
+    # B: shift from the old stored base to the new one — the merge write
+    # renormalizes every stored value to this round's base, which is what
+    # keeps int16 storage in range with no separate renormalization pass.
+    if hb16:
+        # monotone per subject: colmax can collapse when a subject loses all
+        # gossip-eligible copies (crash, sub-min_group cluster), and a base
+        # decrease would shift stored values UP — un-saturating the int16
+        # floor sentinel and clipping live counters at +32767.  A
+        # never-decreasing base keeps every live lane within
+        # [base, base + REBASE_WINDOW] by construction, so the narrow store
+        # can only saturate on don't-care lanes (below base).
+        store_base = jnp.maximum(jnp.maximum(colmax - REBASE_WINDOW, 0), basec)
+    else:
+        store_base = jnp.zeros_like(basec)
+    shift_a = view_base - basec
+    shift_b = store_base - basec
+    rel = hb32 - shift_a[None]
     gossiped = elig & (rel >= 0)
     vdtype = jnp.int8 if config.view_dtype == "int8" else jnp.int16
     view = jnp.where(gossiped, rel, -1).astype(vdtype)
@@ -376,32 +410,41 @@ def _merge(
             # in the kernel-native 4-D shape, so the fused kernel runs with
             # no relayout at all
             hb, age, status = merge_pallas.fused_merge_update_blocked(
-                view, edges, hb, age, status, base, alive32, **kernel_kwargs
+                view, edges, hb, age, status, shift_a, shift_b, alive32,
+                **kernel_kwargs
             )
         else:
             # ring mode stays 2-D (see _use_blocked) and pays the wrapper's
             # per-round reshapes — acceptable for the parity mode
             hb, age, status = merge_pallas.fused_merge_update(
-                view, edges, hb, age, status, base, alive32,
+                view, edges, hb, age, status, shift_a, shift_b, alive32,
                 block_c=config.merge_block_c, **kernel_kwargs
             )
     else:
         # XLA gather path: also the fallback for unsupported shapes/backends
         best_rel = merge_pallas.fanout_max_merge_xla(view, edges)
         any_member = best_rel >= 0
-        # un-rebase; keep absent entries at -1 (base can exceed any real hb)
-        best_hb = jnp.where(
-            any_member, best_rel.astype(jnp.int32) + base[None], -1
-        )
+        best32 = best_rel.astype(jnp.int32)
 
         recv = _rx(alive, nd)
-        advance = recv & (status == MEMBER) & (best_hb > hb)   # max-merge + stamp
+        # max-merge + stamp: best_true > hb_true, both sides shifted into
+        # the stored encoding (int32 mode: best32 + view_base > hb, as ever)
+        advance = (
+            recv & (status == MEMBER) & any_member
+            & (best32 > hb32 - shift_a[None])
+        )
         add = recv & (status == UNKNOWN) & any_member          # learn new member
-        hb = jnp.where(advance | add, best_hb, hb)
-        age = jnp.where(advance | add, 0, age)
+        upd = advance | add
+        new32 = jnp.where(upd, best32 + (shift_a - shift_b)[None], hb32 - shift_b[None])
+        info = jnp.iinfo(hb.dtype)
+        hb = jnp.clip(new32, info.min, info.max).astype(hb.dtype)
+        age = jnp.where(upd, 0, age)
         status = jnp.where(add, MEMBER, status)
         age = jnp.minimum(age + 1, AGE_CLAMP).astype(jnp.int8)
-    return SimState(hb=hb, age=age, status=status, alive=alive, round=state.round)
+    return state._replace(
+        hb=hb, age=age, status=status, alive=alive,
+        hb_base=store_base.reshape(-1),
+    )
 
 
 def _round_core(

@@ -45,15 +45,26 @@ FAILED = jnp.int8(2)    # j removed by i, still on the RecentFailList cooldown
 class SimState(NamedTuple):
     """Pytree of the full simulation state (see module docstring)."""
 
-    hb: jax.Array       # int32 [N, N]
+    hb: jax.Array       # int32 [N, N] — or int16 when config.hb_dtype="int16":
+                        # then the true counter is ``hb + hb_base[subject]``
+                        # (core/rounds.py renormalizes the stored values to
+                        # each round's base inside the merge write)
     age: jax.Array      # int8  [N, N], saturates at config.AGE_CLAMP
     status: jax.Array   # int8  [N, N]
     alive: jax.Array    # bool  [N]
     round: jax.Array    # int32 scalar
+    hb_base: jax.Array  # int32 [N] per-subject heartbeat origin; all-zero
+                        # (and never updated) in int32 mode.  Sharded over
+                        # the subject axis like the matrix columns.
 
     @property
     def n(self) -> int:
         return self.hb.shape[0]
+
+    def hb_true(self) -> jax.Array:
+        """Absolute heartbeat counters, whatever the storage dtype."""
+        base = self.hb_base.reshape(self.hb.shape[1:])[None]
+        return self.hb.astype(jnp.int32) + base
 
 
 class RoundEvents(NamedTuple):
@@ -85,14 +96,16 @@ def init_state(config: SimConfig, member_mask: jax.Array | None = None) -> SimSt
     if member_mask is None:
         member_mask = jnp.ones((n,), dtype=bool)
     member_mask = member_mask.astype(bool)
+    hb_dtype = jnp.int16 if config.hb_dtype == "int16" else jnp.int32
     # i knows j iff both are initial members
     know = member_mask[:, None] & member_mask[None, :]
     return SimState(
-        hb=jnp.zeros((n, n), dtype=jnp.int32),
+        hb=jnp.zeros((n, n), dtype=hb_dtype),
         age=jnp.zeros((n, n), dtype=jnp.int8),
         status=jnp.where(know, MEMBER, UNKNOWN).astype(jnp.int8),
         alive=member_mask,
         round=jnp.int32(0),
+        hb_base=jnp.zeros((n,), dtype=jnp.int32),
     )
 
 

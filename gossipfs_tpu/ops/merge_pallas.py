@@ -185,7 +185,7 @@ def fanout_max_merge(
 
 def _fused_kernel(n_fanout: int, r_blk: int, slots: int, member: int, unknown: int, age_clamp: int):
     def kernel(
-        edges_ref, view_ref, hb_hbm, age_hbm, status_hbm, base_ref,
+        edges_ref, view_ref, hb_hbm, age_hbm, status_hbm, sa_ref, sb_ref,
         hb_out, age_out, status_out,
         best_scratch, hb_vmem, age_vmem, status_vmem, scratch, sems, row_sems,
     ):
@@ -234,14 +234,23 @@ def _fused_kernel(n_fanout: int, r_blk: int, slots: int, member: int, unknown: i
         # ignore gossip entirely.
         best_rel = best_scratch[...]
         any_member = best_rel >= 0
-        best_hb = best_rel + base_ref[0][None]
-        hb = hb_vmem[...]
+        hb = hb_vmem[...].astype(jnp.int32)
         st = status_vmem[...].astype(jnp.int32)
         age = age_vmem[...].astype(jnp.int32)
-        advance = any_member & (st == member) & (best_hb > hb)
+        # sa: stored -> view-encoding shift; sb: old -> new stored-base
+        # shift (every write renormalizes to this round's base — how int16
+        # storage stays in range; both reduce to the old "+ base" in int32
+        # mode, where sb == 0).  See core/rounds.py _merge.
+        sa = sa_ref[0][None]
+        sb = sb_ref[0][None]
+        advance = any_member & (st == member) & (best_rel > hb - sa)
         add = any_member & (st == unknown)
         upd = advance | add
-        hb_out[:, 0] = jnp.where(upd, best_hb, hb)
+        new_hb = jnp.where(upd, best_rel + (sa - sb), hb - sb)
+        if hb_out.dtype != jnp.int32:
+            info = jnp.iinfo(hb_out.dtype)
+            new_hb = jnp.clip(new_hb, info.min, info.max)
+        hb_out[:, 0] = new_hb.astype(hb_out.dtype)
         # the post-merge global age advance (everything not refreshed this
         # round ages by one, saturating) folds in here
         new_age = jnp.minimum(jnp.where(upd, 0, age) + 1, age_clamp)
@@ -291,7 +300,8 @@ def fused_merge_update(
     hb: jax.Array,
     age: jax.Array,
     status: jax.Array,
-    base: jax.Array,
+    shift_a: jax.Array,
+    shift_b: jax.Array,
     alive: jax.Array,
     *,
     member: int,
@@ -317,7 +327,8 @@ def fused_merge_update(
         hb.reshape(shp),
         age.reshape(shp),
         status.reshape(shp),
-        base.reshape(shp[1:]),
+        shift_a.reshape(shp[1:]),
+        shift_b.reshape(shp[1:]),
         alive,
         member=member,
         unknown=unknown,
@@ -341,7 +352,8 @@ def fused_merge_update_blocked(
     hb: jax.Array,
     age: jax.Array,
     status: jax.Array,
-    base: jax.Array,
+    shift_a: jax.Array,
+    shift_b: jax.Array,
     alive: jax.Array,
     *,
     member: int,
@@ -360,10 +372,13 @@ def fused_merge_update_blocked(
     kernel plus once by a separate XLA pass (~25% of round time at N=16k).
 
     All [N, N] lanes arrive in the :func:`blocked_shape` 4-D layout (the
-    scan keeps state blocked so no per-round relayout happens); ``base`` is
-    the per-subject rebase origin in the blocked [N/C, C/128, 128] form;
-    ``edges`` int32 [N, F]; ``alive`` int32 [N] (receiver liveness).
-    Returns the updated (hb, age, status), blocked.
+    scan keeps state blocked so no per-round relayout happens).
+    ``shift_a``/``shift_b`` are per-subject int32 vectors in the blocked
+    [N/C, C/128, 128] form: stored->view-encoding shift and old->new
+    stored-base shift (core/rounds.py ``_merge`` derives both; in int32
+    mode shift_a is the view rebase base and shift_b is zero).  ``edges``
+    int32 [N, F]; ``alive`` int32 [N] (receiver liveness).  Returns the
+    updated (hb, age, status), blocked.
     """
     n, nc, cs, _ = view.shape
     fanout = edges.shape[1]
@@ -396,7 +411,6 @@ def fused_merge_update_blocked(
     hb5 = hb.reshape(n // r_blk, r_blk, nc, cs, LANE)
     age5 = age.reshape(n // r_blk, r_blk, nc, cs, LANE)
     status5 = status.reshape(n // r_blk, r_blk, nc, cs, LANE)
-    base3 = base
     out = pl.pallas_call(
         _fused_kernel(fanout, r_blk, n_slots, member, unknown, age_clamp),
         grid=(n // r_blk, nc),
@@ -408,6 +422,7 @@ def fused_merge_update_blocked(
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((1, cs, LANE), lambda i, j: (j, 0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, cs, LANE), lambda i, j: (j, 0, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=[lane_blk(hb.dtype), lane_blk(age.dtype), lane_blk(status.dtype)],
@@ -431,7 +446,7 @@ def fused_merge_update_blocked(
         # physical VMEM
         compiler_params=pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
-    )(edges, view4, hb5, age5, status5, base3)
+    )(edges, view4, hb5, age5, status5, shift_a, shift_b)
     return tuple(out)
 
 

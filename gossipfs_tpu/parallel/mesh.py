@@ -50,7 +50,8 @@ def state_shardings(mesh: Mesh) -> SimState:
     """
     mat = NamedSharding(mesh, P(None, AXIS))
     rep = NamedSharding(mesh, P())
-    return SimState(hb=mat, age=mat, status=mat, alive=rep, round=rep)
+    col = NamedSharding(mesh, P(AXIS))  # per-subject vector, column-aligned
+    return SimState(hb=mat, age=mat, status=mat, alive=rep, round=rep, hb_base=col)
 
 
 def shard_state(state: SimState, mesh: Mesh) -> SimState:
@@ -73,10 +74,11 @@ def _sharded_runner(mesh, config, crash_rate, rejoin_rate, has_churn_ok):
     mat = P(None, AXIS)
     rep = P()
 
-    def local_run(hb, age, status, alive, rnd, ev_crash, ev_leave, ev_join,
-                  key, churn_ok):
+    def local_run(hb, age, status, alive, rnd, hb_base, ev_crash, ev_leave,
+                  ev_join, key, churn_ok):
         ctx = rounds.ShardCtx(axis=AXIS, offset=lax.axis_index(AXIS) * nloc)
-        st = SS(hb=hb, age=age, status=status, alive=alive, round=rnd)
+        st = SS(hb=hb, age=age, status=status, alive=alive, round=rnd,
+                hb_base=hb_base)
         blocked = rounds._use_blocked(config, config.fanout, n, nloc)
         if blocked:
             st = rounds._to_blocked(st, config)
@@ -87,13 +89,13 @@ def _sharded_runner(mesh, config, crash_rate, rejoin_rate, has_churn_ok):
         )
         if blocked:
             st = rounds._from_blocked(st)
-        return st.hb, st.age, st.status, st.alive, st.round, mc, pr
+        return st.hb, st.age, st.status, st.alive, st.round, st.hb_base, mc, pr
 
     fn = jax.shard_map(
         local_run,
         mesh=mesh,
-        in_specs=(mat, mat, mat, rep, rep, rep, rep, rep, rep, rep),
-        out_specs=(mat, mat, mat, rep, rep,
+        in_specs=(mat, mat, mat, rep, rep, P(AXIS), rep, rep, rep, rep, rep),
+        out_specs=(mat, mat, mat, rep, rep, P(AXIS),
                    rounds.MetricsCarry(P(AXIS), P(AXIS)),
                    rounds.RoundMetrics(rep, rep, rep)),
         check_vma=False,
@@ -148,12 +150,14 @@ def run_rounds_sharded(
 
     fn = _sharded_runner(mesh, config, crash_rate, rejoin_rate,
                          churn_ok is not None)
-    hb, age, status, alive, rnd, mc, pr = fn(
+    hb, age, status, alive, rnd, hb_base, mc, pr = fn(
         state.hb, state.age, state.status, state.alive, state.round,
-        events.crash, events.leave, events.join, key, churn_ok_arr,
+        state.hb_base, events.crash, events.leave, events.join, key,
+        churn_ok_arr,
     )
     return (
-        SimState(hb=hb, age=age, status=status, alive=alive, round=rnd),
+        SimState(hb=hb, age=age, status=status, alive=alive, round=rnd,
+                 hb_base=hb_base),
         mc,
         pr,
     )

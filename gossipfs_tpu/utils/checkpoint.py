@@ -47,13 +47,17 @@ def _abstract_like(config: SimConfig, mesh: Mesh | None) -> dict:
     def spec(shape, dtype, sh):
         return jax.ShapeDtypeStruct(shape, dtype, sharding=sh)
 
-    sh = shardings or SimState(hb=None, age=None, status=None, alive=None, round=None)
+    sh = shardings or SimState(
+        hb=None, age=None, status=None, alive=None, round=None, hb_base=None
+    )
+    hb_dtype = jnp.int16 if config.hb_dtype == "int16" else jnp.int32
     state = SimState(
-        hb=spec((n, n), jnp.int32, sh.hb),
+        hb=spec((n, n), hb_dtype, sh.hb),
         age=spec((n, n), jnp.int8, sh.age),
         status=spec((n, n), jnp.int8, sh.status),
         alive=spec((n,), jnp.bool_, sh.alive),
         round=spec((), jnp.int32, sh.round),
+        hb_base=spec((n,), jnp.int32, sh.hb_base),
     )
     return {
         "state": state._asdict(),
@@ -72,23 +76,68 @@ def restore_checkpoint(
     without it, orbax commits everything to one device and mixing the result
     with mesh-sharded arrays in a jitted call is an error.
     """
-    from gossipfs_tpu.config import AGE_CLAMP
+    from gossipfs_tpu.config import AGE_CLAMP, REBASE_WINDOW
 
     path = pathlib.Path(path).resolve()
     abstract = _abstract_like(config, mesh)
-    # Restore age as int32 regardless of the saved dtype: orbax silently
-    # casts to the target, so an int32 target is lossless for both the new
-    # int8 lane and legacy (pre-int8, unclamped) checkpoints — whereas an
-    # int8 target would wrap legacy ages > 127 into negatives with no error.
-    # Clamp + narrow afterwards; beyond AGE_CLAMP all ages behave identically
-    # (config.py), so the clamp is a no-op for new-format checkpoints.
-    new_age = abstract["state"]["age"]
-    abstract["state"]["age"] = jax.ShapeDtypeStruct(
-        new_age.shape, jnp.int32, sharding=new_age.sharding
-    )
+    # Restore age and hb as int32 regardless of the saved dtype: orbax
+    # silently casts to the target, so an int32 target is lossless for every
+    # era (int8/int16 narrow lanes and legacy wide ones) — whereas a narrow
+    # target would wrap out-of-range legacy values with no error.  Clamp /
+    # renormalize + narrow afterwards.
+    for lane in ("age", "hb"):
+        spec = abstract["state"][lane]
+        abstract["state"][lane] = jax.ShapeDtypeStruct(
+            spec.shape, jnp.int32, sharding=spec.sharding
+        )
     with ocp.StandardCheckpointer() as ckptr:
-        restored = ckptr.restore(path, abstract)
+        def restore_legacy():
+            # checkpoint predates the hb_base lane (int32-only era)
+            legacy = {
+                "state": {k: v for k, v in abstract["state"].items() if k != "hb_base"},
+                "key": abstract["key"],
+            }
+            out = ckptr.restore(path, legacy)
+            zeros = jnp.zeros((config.n,), dtype=jnp.int32)
+            hb_sh = abstract["state"]["hb_base"].sharding
+            if hb_sh is not None:
+                zeros = jax.device_put(zeros, hb_sh)
+            out["state"]["hb_base"] = zeros
+            return out
+
+        legacy_no_base = False
+        try:
+            meta = ckptr.metadata(path)
+            tree = meta.item_metadata if hasattr(meta, "item_metadata") else meta
+            legacy_no_base = "hb_base" not in getattr(tree, "tree", tree)["state"]
+        except Exception:
+            pass  # metadata probe is best-effort; fall through to restore
+        if legacy_no_base:
+            restored = restore_legacy()
+        else:
+            try:
+                restored = ckptr.restore(path, abstract)
+            except Exception:
+                # probe said (or failed to say) the base lane exists but the
+                # structured restore disagreed — one legacy retry, so a real
+                # corruption error surfaces from a consistent code path
+                restored = restore_legacy()
     restored["state"]["age"] = jnp.clip(
         restored["state"]["age"], 0, AGE_CLAMP
     ).astype(jnp.int8)
+    # hb migration between storage modes: whatever era the checkpoint is
+    # from, the true counter is stored_hb + hb_base[subject] (all-zero base
+    # for absolute int32 storage), so reconstruct and re-encode for the
+    # requested mode.  Counters above int16 range renormalize against a
+    # fresh base instead of silently wrapping.
+    true_hb = restored["state"]["hb"] + restored["state"]["hb_base"][None, :]
+    if config.hb_dtype == "int16":
+        new_base = jnp.maximum(jnp.max(true_hb, axis=0) - REBASE_WINDOW, 0)
+        restored["state"]["hb"] = jnp.clip(
+            true_hb - new_base[None, :], -32768, 32767
+        ).astype(jnp.int16)
+        restored["state"]["hb_base"] = new_base
+    else:
+        restored["state"]["hb"] = true_hb
+        restored["state"]["hb_base"] = jnp.zeros_like(restored["state"]["hb_base"])
     return SimState(**restored["state"]), restored["key"]

@@ -80,6 +80,8 @@ def test_legacy_int32_age_checkpoint_restores_clamped(tmp_path):
     state = init_state(cfg)
     legacy = state._asdict()
     legacy["age"] = jnp.full((cfg.n, cfg.n), 200, jnp.int32)
+    # pre-hb_base-era checkpoints lack the per-subject base lane entirely
+    del legacy["hb_base"]
     path = (tmp_path / "legacy").resolve()
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(path, {"state": legacy, "key": key}, force=True)
@@ -87,3 +89,55 @@ def test_legacy_int32_age_checkpoint_restores_clamped(tmp_path):
     restored, _ = restore_checkpoint(path, cfg)
     assert restored.age.dtype == jnp.int8
     assert jnp.all(restored.age == AGE_CLAMP)
+    assert jnp.array_equal(restored.hb_base, jnp.zeros((cfg.n,), jnp.int32))
+
+
+def test_int32_checkpoint_migrates_to_int16_without_wrapping(tmp_path):
+    """Resuming an absolute-int32-era checkpoint under hb_dtype='int16'
+    must renormalize counters above the int16 range against a fresh base,
+    not silently wrap them (the same hazard the age lane guards against)."""
+    import dataclasses
+
+    from gossipfs_tpu.utils.checkpoint import save_checkpoint
+
+    cfg32 = SimConfig(n=128, topology="random", fanout=6, hb_dtype="int32")
+    key = jax.random.PRNGKey(9)
+    state = init_state(cfg32)
+    # simulate a >32k-round run: counters far past the int16 range
+    state = state._replace(hb=state.hb + 100_000)
+    path = (tmp_path / "wide").resolve()
+    save_checkpoint(path, state, key)
+
+    cfg16 = dataclasses.replace(cfg32, hb_dtype="int16")
+    restored, _ = restore_checkpoint(path, cfg16)
+    assert restored.hb.dtype == jnp.int16
+    # true counters survive exactly (100_000 would have wrapped to -31072)
+    assert jnp.array_equal(restored.hb_true(), state.hb)
+
+    # and the reverse migration recovers the absolute encoding
+    path2 = (tmp_path / "narrow").resolve()
+    save_checkpoint(path2, restored, key)
+    back, _ = restore_checkpoint(path2, cfg32)
+    assert back.hb.dtype == jnp.int32
+    assert jnp.array_equal(back.hb, state.hb)
+    assert jnp.all(back.hb_base == 0)
+
+
+def test_int16_hb_checkpoint_roundtrip(tmp_path):
+    """hb_dtype='int16' states (relative counters + hb_base) survive
+    save/restore and continue identically to an uninterrupted run."""
+    from gossipfs_tpu.core.rounds import run_rounds
+    from gossipfs_tpu.utils.checkpoint import save_checkpoint
+
+    cfg = SimConfig(n=128, topology="random", fanout=6, hb_dtype="int16")
+    key = jax.random.PRNGKey(7)
+    state = init_state(cfg)
+    state, _, _ = run_rounds(state, cfg, 6, key, crash_rate=0.05)
+    path = (tmp_path / "ck16").resolve()
+    save_checkpoint(path, state, key)
+    restored, rkey = restore_checkpoint(path, cfg)
+    assert restored.hb.dtype == jnp.int16
+    cont_a, _, _ = run_rounds(state, cfg, 5, key)
+    cont_b, _, _ = run_rounds(restored, cfg, 5, rkey)
+    for a, b in zip(jax.tree.leaves(cont_a), jax.tree.leaves(cont_b)):
+        assert jnp.array_equal(a, b)

@@ -270,6 +270,56 @@ class TestHeartbeatRebasing:
         with pytest.raises(ValueError, match="int8"):
             SimConfig(n=64, topology="ring", fanout=3, view_dtype="int8")
 
+    @pytest.mark.parametrize("kernel", ["xla", "pallas_interpret"])
+    def test_int16_hb_mode_matches_int32(self, kernel):
+        """hb_dtype='int16' stores counters relative to hb_base, renormalized
+        every round by the merge write.  Protocol behavior (status, age,
+        detection/convergence metrics) and the reconstructed true counters
+        on live MEMBER lanes must match the int32 mode exactly; dead rows
+        and FAILED/UNKNOWN lanes are don't-care storage.  The run is long
+        enough (and hb-shifted) that store_base > 0, so the relative
+        encoding is actually exercised."""
+        import dataclasses
+
+        n = 256 if kernel == "pallas_interpret" else 64
+        fo = 8 if kernel == "pallas_interpret" else 6
+        cfg32 = SimConfig(
+            n=n, topology="random", fanout=fo, merge_kernel=kernel,
+            view_dtype="int8", hb_dtype="int32",
+        )
+        cfg16 = dataclasses.replace(cfg32, hb_dtype="int16")
+
+        def run(cfg):
+            state = init_state(cfg)
+            state, _, _ = run_rounds(state, cfg, 10, KEY)
+            # push counters past the int8 view window so rebasing is active
+            state = state._replace(hb=(state.hb + 300).astype(state.hb.dtype))
+            ev = schedule(
+                50, cfg.n, crash={3: [7], 20: [40]}, leave={5: [2]},
+                join={25: [7]},
+            )
+            return run_rounds(state, cfg, 50, KEY, events=ev)
+
+        out_a, mc_a, pr_a = run(cfg32)
+        out_b, mc_b, pr_b = run(cfg16)
+        assert out_b.hb.dtype == jnp.int16
+        assert jnp.array_equal(out_b.status, out_a.status)
+        assert jnp.array_equal(out_b.age, out_a.age)
+        assert jnp.array_equal(out_b.alive, out_a.alive)
+        assert jnp.array_equal(mc_b.first_detect, mc_a.first_detect)
+        assert jnp.array_equal(mc_b.converged, mc_a.converged)
+        assert jnp.array_equal(pr_b.true_detections, pr_a.true_detections)
+        assert jnp.array_equal(pr_b.false_positives, pr_a.false_positives)
+        # true counters agree wherever they are semantically live
+        live_member = out_a.alive[:, None] & (out_a.status == MEMBER)
+        ha = jnp.where(live_member, out_a.hb_true(), -1)
+        hbb = jnp.where(live_member, out_b.hb_true(), -1)
+        assert jnp.array_equal(ha, hbb)
+
+    def test_int16_hb_rejected_for_ring(self):
+        with pytest.raises(ValueError, match="int16"):
+            SimConfig(n=64, topology="ring", fanout=3, hb_dtype="int16")
+
     def test_int8_view_rejected_when_lag_bound_exceeds_window(self):
         """t_fail x diameter must fit the 126-round window: tiny fanout on a
         large graph (many hops) or a huge t_fail both blow it."""

@@ -102,7 +102,7 @@ class TestShardMapRunner:
         ev = RoundEvents(crash=z, leave=z, join=z)
         fn = pm._sharded_runner(m, cfg, 0.0, 0.0, False)
         hlo = fn.lower(
-            st.hb, st.age, st.status, st.alive, st.round,
+            st.hb, st.age, st.status, st.alive, st.round, st.hb_base,
             ev.crash, ev.leave, ev.join, KEY, jnp.ones((cfg.n,), bool),
         ).compile().as_text()
         assert "all-gather" not in hlo
