@@ -580,11 +580,7 @@ def _scan_rounds(
     return state, mcarry, per_round
 
 
-@partial(
-    jax.jit,
-    static_argnames=("config", "num_rounds", "crash_rate", "rejoin_rate"),
-)
-def run_rounds(
+def _run_rounds_impl(
     state: SimState,
     config: SimConfig,
     num_rounds: int,
@@ -625,3 +621,13 @@ def run_rounds(
     if blocked:
         state = _from_blocked(state)
     return state, mcarry, per_round
+
+
+_RUN_ROUNDS_STATIC = ("config", "num_rounds", "crash_rate", "rejoin_rate")
+run_rounds = partial(jax.jit, static_argnames=_RUN_ROUNDS_STATIC)(_run_rounds_impl)
+# in-place variant: XLA reuses the input state's HBM for the output (the
+# caller's ``state`` is consumed).  At N=32k the scan needs ~13 GiB without
+# aliasing — past a v5e chip's headroom — and ~9 GiB with it.
+run_rounds_donate = partial(
+    jax.jit, static_argnames=_RUN_ROUNDS_STATIC, donate_argnums=(0,)
+)(_run_rounds_impl)

@@ -320,6 +320,18 @@ class TestHeartbeatRebasing:
         with pytest.raises(ValueError, match="int16"):
             SimConfig(n=64, topology="ring", fanout=3, hb_dtype="int16")
 
+    def test_run_rounds_donate_matches(self):
+        """The buffer-donating variant (used for memory-bound large-N runs)
+        is the same program; only the input state's buffers are consumed."""
+        from gossipfs_tpu.core.rounds import run_rounds_donate
+
+        cfg = SimConfig(n=64, topology="random", fanout=6)
+        ev = schedule(20, cfg.n, crash={3: [7]})
+        base = run_rounds(init_state(cfg), cfg, 20, KEY, events=ev)
+        got = run_rounds_donate(init_state(cfg), cfg, 20, KEY, events=ev)
+        for a, b in zip(jax.tree.leaves(base), jax.tree.leaves(got)):
+            assert jnp.array_equal(a, b)
+
     def test_int8_view_rejected_when_lag_bound_exceeds_window(self):
         """t_fail x diameter must fit the 126-round window: tiny fanout on a
         large graph (many hops) or a huge t_fail both blow it."""
