@@ -1,0 +1,101 @@
+"""Property tests for the gossip merge — the join-semilattice obligations.
+
+SURVEY.md §4: the reference's max-merge (MergeMemberList, reference:
+slave/slave.go:414-440) is a join-semilattice — idempotent, commutative,
+associative — which is exactly what makes anti-entropy gossip converge.
+The tensorized merge must inherit those laws; here they appear as
+invariances of one `gossip_round` under edge-list transformations:
+
+  commutative+associative  <=>  permuting each receiver's in-edge list
+                                cannot change anything
+  idempotent               <=>  merging the same sender's view twice
+                                (duplicate edge) cannot change anything
+  self-merge neutral       <=>  receiving your own datagram is a no-op
+  monotone                 <=>  a merge can only advance heartbeat counts
+
+Run on a mid-run state (after churn) so tables disagree and the merge has
+real work to do.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gossipfs_tpu.config import SimConfig
+from gossipfs_tpu.core.rounds import gossip_round, run_rounds
+from gossipfs_tpu.core.state import MEMBER, RoundEvents, init_state
+from gossipfs_tpu.core.topology import random_in_edges
+
+KEY = jax.random.PRNGKey(11)
+
+
+def _mid_run_state(cfg, rounds=12, crash_rate=0.05):
+    state = init_state(cfg)
+    state, _, _ = run_rounds(state, cfg, rounds, KEY, crash_rate=crash_rate)
+    return state
+
+
+def _round(state, cfg, edges):
+    return gossip_round(state, RoundEvents.none(cfg.n), edges, cfg)
+
+
+@pytest.fixture(params=["xla", "pallas_interpret"])
+def cfg(request):
+    n = 128 if request.param == "pallas_interpret" else 48
+    return SimConfig(n=n, topology="random", fanout=5, merge_kernel=request.param)
+
+
+def _assert_states_equal(a, b):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+class TestMergeSemilattice:
+    def test_edge_order_invariance(self, cfg):
+        """Commutativity + associativity: each receiver folds its F sender
+        views with max, so the order the datagrams arrive in is invisible."""
+        state = _mid_run_state(cfg)
+        edges = random_in_edges(KEY, cfg.n, cfg.fanout)
+        perm = jax.random.permutation(KEY, cfg.fanout)
+        base = _round(state, cfg, edges)
+        got = _round(state, cfg, edges[:, perm])
+        _assert_states_equal(base[0], got[0])
+        _assert_states_equal(base[1], got[1])
+
+    def test_duplicate_edge_idempotent(self, cfg):
+        """Idempotence: merging the same membership list twice is merging
+        it once (max(x, x) = x per entry)."""
+        state = _mid_run_state(cfg)
+        edges = random_in_edges(KEY, cfg.n, cfg.fanout)
+        dup = jnp.concatenate([edges, edges[:, :1]], axis=1)
+        base = _round(state, cfg, edges)
+        got = _round(state, cfg, dup)
+        _assert_states_equal(base[0], got[0])
+
+    def test_self_edge_neutral(self, cfg):
+        """Receiving your own datagram merges your own table into itself —
+        a no-op (the reference never self-sends, but a duplicate network
+        would be harmless; max-merge makes that a theorem, not luck)."""
+        state = _mid_run_state(cfg)
+        edges = random_in_edges(KEY, cfg.n, cfg.fanout)
+        self_col = jnp.arange(cfg.n, dtype=jnp.int32)[:, None]
+        base = _round(state, cfg, edges)
+        got = _round(state, cfg, jnp.concatenate([edges, self_col], axis=1))
+        _assert_states_equal(base[0], got[0])
+
+    def test_merge_monotone(self, cfg):
+        """Heartbeat counts never regress for entries that stay MEMBER at a
+        live receiver (max-merge only raises; stamps only refresh)."""
+        state = _mid_run_state(cfg)
+        edges = random_in_edges(KEY, cfg.n, cfg.fanout)
+        out, _, _ = _round(state, cfg, edges)
+        stays = (
+            state.alive[:, None]
+            & out.alive[:, None]
+            & (state.status == MEMBER)
+            & (out.status == MEMBER)
+        )
+        before = jnp.where(stays, state.hb_true(), 0)
+        after = jnp.where(stays, out.hb_true(), 0)
+        assert bool(jnp.all(after >= before))
