@@ -43,8 +43,12 @@ def sim_10k_crash() -> Scenario:
     at 10k the ring's freshness diameter dwarfs t_fail, so ring mode would be
     one continuous false-positive storm (see
     tests/test_rounds.py::test_emergent_false_positives_beyond_reference_scale).
+
+    N is 10,240 ("10k-class"): lane-aligned (N % 128 == 0) so the pallas
+    merge kernel runs instead of silently falling back to the XLA gather
+    path at a fraction of the bandwidth.
     """
-    n = 10_000
+    n = 10_240
     return Scenario(
         name="sim-10k-crash",
         config=SimConfig(
@@ -54,6 +58,12 @@ def sim_10k_crash() -> Scenario:
             remove_broadcast=False,
             fresh_cooldown=True,
             t_cooldown=12,
+            # the TPU fast path (falls back to XLA off-TPU): fused pallas
+            # merge, int8 gossip view, int16 relative heartbeat storage
+            merge_kernel="pallas",
+            view_dtype="int8",
+            hb_dtype="int16",
+            merge_block_c=16_384,
         ),
         rounds=120,
         crash_rate=0.01,
@@ -63,12 +73,12 @@ def sim_10k_crash() -> Scenario:
 def sim_100k() -> Scenario:
     """Config 4: 100k nodes, fanout log N, 5% churn + preemption (v5e-8).
 
-    N is 102,400 — the first ">= 100k" count whose tiling (multiples of
-    4096) lets the pallas merge kernel (ops/merge_pallas.py) run at full
-    block sizes; a non-lane-aligned N would silently fall back to the XLA
-    gather path at a quarter of the bandwidth.
+    N is 131,072 (2^17, "100k-class"): lane-aligned for the pallas merge
+    kernel at full block sizes, and it divides an 8-chip v5e mesh into
+    16,384-column shards — each chip then runs exactly the single-chip
+    headline shape under parallel.mesh.run_rounds_sharded.
     """
-    n = 102_400
+    n = 131_072
     return Scenario(
         name="sim-100k",
         config=SimConfig(
@@ -79,6 +89,9 @@ def sim_100k() -> Scenario:
             fresh_cooldown=True,
             t_cooldown=12,
             merge_kernel="pallas",
+            view_dtype="int8",
+            hb_dtype="int16",
+            merge_block_c=16_384,
         ),
         rounds=60,
         crash_rate=0.05,

@@ -270,15 +270,27 @@ _FUSED_BLOCK_R = 128
 _FUSED_BLOCK_R_MIN = 32
 
 
+# widest single column block the VMEM budget allows (gather scratch +
+# receiver-lane blocks at _FUSED_BLOCK_R rows; see the pallas_call's
+# vmem_limit note)
+_FULL_ROW_MAX = 16_384
+
+
 def blocked_cols(n_cols: int, block_c: int) -> tuple[int, int, int]:
     """The kernel-native column blocking [C_total/C, C/128, 128].
 
     Columns may be fewer than rows: under subject-axis sharding each shard
-    blocks its local column slice independently.
+    blocks its local column slice independently.  Blocks must tile n_cols
+    exactly; for a non-power-of-two count (e.g. 10,240) the power-of-two
+    halving would shatter into tiny blocks and multiply the gather's DMA
+    descriptor count, so lane-aligned widths take one full-width block
+    instead whenever it fits VMEM.
     """
     c_blk = min(block_c, n_cols)
     while n_cols % c_blk:
         c_blk //= 2
+    if c_blk < min(block_c, n_cols) and n_cols <= _FULL_ROW_MAX:
+        c_blk = n_cols
     return (n_cols // c_blk, c_blk // LANE, LANE)
 
 
