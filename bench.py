@@ -84,10 +84,14 @@ def main() -> None:
     st, mc, pr = run_rounds(state, cfg, ROUNDS, key, crash_rate=CRASH_RATE)
     jax.block_until_ready(st)
 
-    t0 = time.perf_counter()
-    st, mc, pr = run_rounds(state, cfg, ROUNDS, key, crash_rate=CRASH_RATE)
-    jax.block_until_ready(st)
-    elapsed = time.perf_counter() - t0
+    # best of 3: the axon tunnel adds variable per-call latency; the minimum
+    # is the least-perturbed measurement of the device's actual rate
+    elapsed = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        st, mc, pr = run_rounds(state, cfg, ROUNDS, key, crash_rate=CRASH_RATE)
+        jax.block_until_ready(st)
+        elapsed = min(elapsed, time.perf_counter() - t0)
 
     rounds_per_sec = ROUNDS / elapsed
     platform = jax.devices()[0].platform

@@ -1,0 +1,131 @@
+"""SDFS operation-latency benchmark — the reference report's perf section.
+
+The reference's published performance results (report.pdf "Performance" /
+"Analysis"; BASELINE.md "Published claims") are insert/update/read latency
+curves over file size at 4 and 8 machines, with three qualitative claims:
+
+  1. insert ~ update, read slightly less — a write pushes R=4 replicas
+     (quorum-acked), a read pulls one copy;
+  2. latency grows with file size;
+  3. latency is governed by the replica count, not the cluster size
+     ("no significant difference between 4 machines and 8 machines").
+
+This runner reproduces those curves on the TPU build's SDFS plane
+(sdfs/cluster.py — same placement/quorum/versioning logic, in-process byte
+stores standing in for the reference's sshpass/scp hop) and checks the three
+claims mechanically:
+
+  python -m gossipfs_tpu.bench.sdfs_ops
+  python -m gossipfs_tpu.bench.sdfs_ops --sizes 65536 1048576 4194304
+
+The workload mirrors the reference repo's checked-in Wikipedia-dump shards
+(file1..10.txt, ~3-4 MB each) with deterministic pseudo-random payloads of
+the same magnitudes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import time
+
+from gossipfs_tpu.sdfs.cluster import SDFSCluster
+
+DEFAULT_SIZES = (65_536, 1_048_576, 4_194_304)  # 64 KB, 1 MB, 4 MB
+CLUSTERS = (4, 8)                               # the report's two settings
+REPS = 7
+
+
+def _payload(size: int, seed: int) -> bytes:
+    # cheap deterministic bytes; avoids numpy/jax so the measured time is
+    # purely the SDFS data plane
+    chunk = (seed.to_bytes(4, "little") * (4096 // 4 + 1))[:4096]
+    return (chunk * (size // 4096 + 1))[:size]
+
+
+def _time(fn) -> float:
+    t0 = time.perf_counter()
+    ok = fn()
+    dt = time.perf_counter() - t0
+    assert ok is not False and ok is not None, "operation failed"
+    return dt
+
+
+def run(sizes=DEFAULT_SIZES, clusters=CLUSTERS, reps=REPS) -> dict:
+    rows = []
+    for n_nodes in clusters:
+        cluster = SDFSCluster(n_nodes, seed=7)
+        for size in sizes:
+            inserts, updates, reads = [], [], []
+            for r in range(reps):
+                name = f"file-{size}-{r}.txt"
+                data = _payload(size, r)
+                now = 1000 * (r + 1) * (size % 977 + 1)
+                inserts.append(_time(lambda: cluster.put(name, data, now=now)))
+                updates.append(
+                    _time(
+                        lambda: cluster.put(
+                            name, data, now=now + 1, confirm=lambda: True
+                        )
+                    )
+                )
+                reads.append(_time(lambda: cluster.get(name)))
+            rows.append(
+                {
+                    "nodes": n_nodes,
+                    "size_bytes": size,
+                    "insert_ms": round(statistics.median(inserts) * 1e3, 4),
+                    "update_ms": round(statistics.median(updates) * 1e3, 4),
+                    "read_ms": round(statistics.median(reads) * 1e3, 4),
+                }
+            )
+
+    def med(metric, pred):
+        vals = [r[metric] for r in rows if pred(r)]
+        return statistics.median(vals)
+
+    big = max(sizes)
+    small = min(sizes)
+    claims = {
+        # 1: writes (R-replica push) cost more than reads (single pull)
+        "write_exceeds_read_at_large_files": (
+            med("insert_ms", lambda r: r["size_bytes"] == big)
+            > med("read_ms", lambda r: r["size_bytes"] == big)
+        ),
+        # 2: latency grows with file size
+        "latency_grows_with_size": (
+            med("insert_ms", lambda r: r["size_bytes"] == big)
+            > med("insert_ms", lambda r: r["size_bytes"] == small)
+        ),
+        # 3: replica count, not cluster size, governs latency (<= 2x gap
+        # between 4- and 8-node clusters at the largest size)
+        "cluster_size_insignificant": (
+            0.5
+            < (
+                med("insert_ms", lambda r: r["nodes"] == 4 and r["size_bytes"] == big)
+                / max(
+                    med(
+                        "insert_ms",
+                        lambda r: r["nodes"] == 8 and r["size_bytes"] == big,
+                    ),
+                    1e-9,
+                )
+            )
+            < 2.0
+        ),
+    }
+    return {"rows": rows, "reference_claims_reproduced": claims}
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--sizes", type=int, nargs="+", default=list(DEFAULT_SIZES))
+    p.add_argument("--reps", type=int, default=REPS)
+    args = p.parse_args(argv)
+    print(json.dumps(run(sizes=tuple(args.sizes), reps=args.reps)))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -138,7 +138,9 @@ class SDFSCluster:
         for node, v in reports.items():
             if v < version and blob is not None:
                 self.stores[node].put(name, blob, version)
-        return blob
+        # the client's copy of the pulled bytes (the reference scp-pulls one
+        # replica, slave.go:857-878) — reads move one copy, writes move R
+        return None if blob is None else bytes(memoryview(blob))
 
     def delete(self, name: str) -> bool:
         """Master drops metadata, replicas drop data (slave.go:1057-1091)."""

@@ -38,7 +38,11 @@ class LocalStore:
         if self.root is not None:
             (self.root / name).write_bytes(data)
         else:
-            self._blobs[name] = data
+            # defensive byte copy: each replica owns its content, like the
+            # reference's per-replica scp (and a caller-held bytearray can't
+            # mutate the store later); also what makes bench/sdfs_ops.py's
+            # latency-vs-size curves measure an actual per-replica transfer
+            self._blobs[name] = bytes(memoryview(data))
         self.versions[name] = version
 
     def get(self, name: str) -> bytes | None:

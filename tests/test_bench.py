@@ -82,3 +82,12 @@ def test_cli_main_prints_json(capsys, tmp_path):
     doc = json.loads(capsys.readouterr().out)
     assert doc["scenario"] == "parity-10"
     assert json.loads(out.read_text())["scenario"] == "parity-10"
+
+
+def test_sdfs_ops_reproduces_reference_claims():
+    """The report's three qualitative perf claims (BASELINE.md "Published
+    claims") must hold on the TPU build's SDFS plane."""
+    from gossipfs_tpu.bench.sdfs_ops import run
+
+    out = run(sizes=(16_384, 524_288), reps=3)
+    assert all(out["reference_claims_reproduced"].values()), out
