@@ -83,11 +83,21 @@ class CoSim:
                 self._recover_at.append(now + RECOVERY_DELAY)
             observer = self._observer()
             if observer is not None:
+                old_master = self.cluster.master_node
                 self.cluster.update_membership(
                     self.detector.membership(observer),
                     reachable=self.detector.alive_nodes(),
                     now=now,
                 )
+                if self.cluster.master_node != old_master:
+                    # the reference logs the vote outcome (revote_master /
+                    # Receive_vote, slave.go:930-984)
+                    self.log.write(
+                        f"Elected new master {self.cluster.master_node} "
+                        f"(was {old_master})",
+                        round=now,
+                        kind="election",
+                    )
             due = [r for r in self._recover_at if r <= now]
             if due:
                 self._recover_at = [r for r in self._recover_at if r > now]
