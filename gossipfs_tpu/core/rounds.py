@@ -544,6 +544,7 @@ def _scan_rounds(
     rejoin_rate: float,
     churn_ok: jax.Array | None,
     ctx: ShardCtx,
+    snapshot=None,
 ) -> tuple[SimState, MetricsCarry, RoundMetrics]:
     """The shared scan over rounds (state in its final layout already).
 
@@ -573,6 +574,31 @@ def _scan_rounds(
         # joins lost to a dead introducer don't reset metrics (slave.go:22 SPOF)
         rejoined = ev.join & ~alive_before & st.alive
         mc = _update_carry(mc, st, rejoined, fail, round_idx, ctx)
+        if snapshot is not None:
+            # async membership snapshot (utils/snapshot.py): stream the
+            # post-round view to the host every ``every`` rounds without
+            # interrupting the scan — the reader never touches in-flight
+            # device futures.  Host callbacks cannot cross this dev image's
+            # remote-PJRT tunnel (the callable lives on the wrong side); a
+            # directly-attached TPU runs them fine.
+            import os
+
+            if os.environ.get("JAX_PLATFORMS", "") == "axon":
+                raise RuntimeError(
+                    "snapshot streaming needs host callbacks, which hang "
+                    "over the axon TPU tunnel; run snapshots on CPU or on "
+                    "a directly-attached TPU"
+                )
+            buffer, every = snapshot
+            from jax.experimental import io_callback
+
+            def _emit(s=st):
+                io_callback(
+                    buffer.push, None, s.round, s.alive, s.status, ordered=True
+                )
+                return jnp.int32(0)
+
+            lax.cond(st.round % every == 0, _emit, lambda: jnp.int32(0))
         return (st, mc), metrics
 
     init_carry = (state, MetricsCarry.init(_nsubj(state.hb.shape)))
@@ -589,6 +615,7 @@ def _run_rounds_impl(
     crash_rate: float = 0.0,
     rejoin_rate: float = 0.0,
     churn_ok: jax.Array | None = None,
+    snapshot=None,
 ) -> tuple[SimState, MetricsCarry, RoundMetrics]:
     """Scan ``num_rounds`` gossip rounds.
 
@@ -598,6 +625,10 @@ def _run_rounds_impl(
     ``churn_ok``: optional bool [N] mask of nodes eligible for *random* churn
     — benchmark runs exclude their tracked crash victims so a random rejoin
     can't reset the tracked detection/convergence rounds mid-measurement.
+    ``snapshot``: optional ``(utils.snapshot.SnapshotBuffer, every)`` pair —
+    an in-scan host callback pushes the membership view to the buffer every
+    ``every`` rounds so other threads can read it while the device scans
+    (SURVEY §7.4's async boundary).
     Returns final state, per-subject detection/convergence rounds, and
     per-round metrics stacked over the horizon.
 
@@ -616,14 +647,15 @@ def _run_rounds_impl(
         # one relayout for the whole horizon (see module header)
         state = _to_blocked(state, config)
     state, mcarry, per_round = _scan_rounds(
-        state, config, key, events, crash_rate, rejoin_rate, churn_ok, LOCAL_CTX
+        state, config, key, events, crash_rate, rejoin_rate, churn_ok, LOCAL_CTX,
+        snapshot=snapshot,
     )
     if blocked:
         state = _from_blocked(state)
     return state, mcarry, per_round
 
 
-_RUN_ROUNDS_STATIC = ("config", "num_rounds", "crash_rate", "rejoin_rate")
+_RUN_ROUNDS_STATIC = ("config", "num_rounds", "crash_rate", "rejoin_rate", "snapshot")
 run_rounds = partial(jax.jit, static_argnames=_RUN_ROUNDS_STATIC)(_run_rounds_impl)
 # in-place variant: XLA reuses the input state's HBM for the output (the
 # caller's ``state`` is consumed).  At N=32k the scan needs ~13 GiB without

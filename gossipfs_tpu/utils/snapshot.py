@@ -1,0 +1,72 @@
+"""Async membership snapshots: read the sim while the scan keeps running.
+
+SURVEY.md §7.4 ("async boundary"): the gRPC shim must be able to serve the
+membership view without stalling a long device-resident scan.  Mechanism:
+``run_rounds(..., snapshot=(buffer, every))`` plants a ``jax.experimental.
+io_callback`` inside the scan that pushes (round, alive, status) to this
+host-side buffer every ``every`` rounds.  Because jax dispatch is
+asynchronous, the Python caller gets control back while the device scans;
+any thread (e.g. the gRPC server) reads ``buffer.latest()`` for the
+freshest view — no blocking ``device_get`` against in-flight futures.
+
+The reference has no analog (every read walks the live Go structures, racy
+by design — SURVEY §2.4); this is the simulator's equivalent of reading
+`slave.MemberList` mid-run, made race-free by construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Snapshot:
+    """One consistent point-in-time view of the whole cluster."""
+
+    round: int
+    alive: np.ndarray    # bool [N]
+    status: np.ndarray   # int8 [N, N] — row i is node i's membership table
+
+    def membership(self, node: int) -> list[int]:
+        from gossipfs_tpu.core.state import MEMBER
+
+        return np.nonzero(self.status[node] == int(MEMBER))[0].tolist()
+
+
+class SnapshotBuffer:
+    """Latest-wins buffer written by the in-scan callback, read by any thread."""
+
+    def __init__(self, keep_history: bool = False):
+        self._lock = threading.Lock()
+        self._latest: Snapshot | None = None
+        self._history: list[Snapshot] | None = [] if keep_history else None
+
+    def push(self, round_, alive, status) -> None:
+        """io_callback target — converts device payloads to host arrays.
+
+        ``status`` may arrive in the scan's blocked 4-D layout; on the host
+        it is plain C-order, so the [N, N] reshape is free.
+        """
+        alive = np.asarray(alive)
+        n = alive.shape[0]
+        snap = Snapshot(
+            round=int(np.asarray(round_)),
+            alive=alive,
+            status=np.asarray(status).reshape(n, n),
+        )
+        with self._lock:
+            self._latest = snap
+            if self._history is not None:
+                self._history.append(snap)
+
+    def latest(self) -> Snapshot | None:
+        with self._lock:
+            return self._latest
+
+    @property
+    def history(self) -> list[Snapshot]:
+        with self._lock:
+            return list(self._history or [])
