@@ -90,6 +90,43 @@ class SimDetector:
             m[list(nodes)] = True
         return jnp.asarray(m)
 
+    def advance_bulk(self, rounds: int, snapshot_every: int | None = None):
+        """Advance many rounds as one compiled scan (no per-round host sync).
+
+        With ``snapshot_every``, returns a ``utils.snapshot.SnapshotBuffer``
+        that an in-scan host callback feeds every k rounds: because jax
+        dispatch is asynchronous this call returns while the device is
+        still scanning, and other threads (the gRPC shim) read
+        ``buffer.latest()`` for a consistent mid-run membership view
+        (SURVEY §7.4's async boundary).  Pending crash/leave/join verbs are
+        applied on the first round.
+        """
+        from gossipfs_tpu.core.rounds import run_rounds
+        from gossipfs_tpu.core.state import RoundEvents as RE
+
+        n = self.config.n
+        first = np.zeros((rounds, n), dtype=bool)
+        events = RE(
+            crash=jnp.asarray(first).at[0].set(self._mask(self._pending_crash)),
+            leave=jnp.asarray(first).at[0].set(self._mask(self._pending_leave)),
+            join=jnp.asarray(first).at[0].set(self._mask(self._pending_join)),
+        )
+        self._pending_crash.clear()
+        self._pending_leave.clear()
+        self._pending_join.clear()
+        buffer = None
+        snapshot = None
+        if snapshot_every is not None:
+            from gossipfs_tpu.utils.snapshot import SnapshotBuffer
+
+            buffer = SnapshotBuffer()
+            snapshot = (buffer, snapshot_every)
+        self.state, _, _ = run_rounds(
+            self.state, self.config, rounds, self._key, events=events,
+            snapshot=snapshot,
+        )
+        return buffer
+
     # -- views -------------------------------------------------------------
     def membership(self, observer: int) -> list[int]:
         row = np.asarray(self.state.status[observer])

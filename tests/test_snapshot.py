@@ -33,6 +33,32 @@ def test_snapshots_stream_at_cadence_and_match_final():
     np.testing.assert_array_equal(last.alive, np.asarray(final.alive))
 
 
+def test_detector_advance_bulk_with_snapshots():
+    """SimDetector.advance_bulk: one compiled scan, pending verbs applied
+    on the first round, snapshots streaming at cadence."""
+    from gossipfs_tpu.detector.sim import SimDetector
+
+    cfg = SimConfig(n=64, topology="random", fanout=6)
+    det = SimDetector(cfg)
+    det.advance(3)  # let counters pass the hb grace before crashing anyone
+    det.crash(7)
+    buf = det.advance_bulk(20, snapshot_every=5)
+    jax.block_until_ready(det.state.status)
+    assert int(det.state.round) == 23
+    snap = buf.latest()
+    assert snap.round == 20
+    assert not snap.alive[7]
+    assert 7 not in snap.membership(0)
+    # bulk path agrees with the per-round path on the final view
+    det2 = SimDetector(cfg)
+    det2.advance(3)
+    det2.crash(7)
+    det2.advance(20)
+    np.testing.assert_array_equal(
+        np.asarray(det.state.status), np.asarray(det2.state.status)
+    )
+
+
 def test_snapshot_membership_view_consistent():
     cfg = SimConfig(n=64, topology="random", fanout=6)
     buf = SnapshotBuffer()
