@@ -62,6 +62,15 @@ class ShimClient:
     def advance(self, rounds: int = 1) -> int:
         return self.call("Advance", rounds=rounds)["round"]
 
+    def advance_bulk(self, rounds: int, snapshot_every: int | None = None) -> int:
+        """One compiled scan; returns the target round immediately while the
+        device runs.  Subsequent ``lsm``/``alive_nodes`` answer from the
+        scan's snapshot stream (reply carries ``as_of_round``)."""
+        req = {"rounds": rounds}
+        if snapshot_every is not None:
+            req["snapshot_every"] = snapshot_every
+        return self.call("AdvanceBulk", **req)["round_target"]
+
     def put(self, file: str, data: bytes, confirm: bool = False) -> bool:
         return self.call(
             "Put", file=file, data_b64=base64.b64encode(data).decode(),

@@ -58,6 +58,9 @@ class ShimServicer:
         # Vote tallies: candidate -> set of voters (Receive_vote state,
         # reference: slave/slave.go:53-57, 968-984)
         self._votes: dict[int, set[int]] = {}
+        # while an AdvanceBulk scan is in flight, membership reads answer
+        # from its snapshot stream instead of blocking on device futures
+        self._snapshots = None
 
     # -- membership verbs (the north-star seam) ----------------------------
     def Join(self, req, ctx):
@@ -77,16 +80,52 @@ class ShimServicer:
 
     def Lsm(self, req, ctx):
         with self._lock:
+            snap = self._snapshots.latest() if self._snapshots else None
+            if snap is not None:
+                obs = int(req["observer"])
+                return {"members": snap.membership(obs), "as_of_round": snap.round}
             return {"members": self.sim.detector.membership(int(req["observer"]))}
 
     def AliveNodes(self, req, ctx):
         with self._lock:
+            snap = self._snapshots.latest() if self._snapshots else None
+            if snap is not None:
+                import numpy as np
+
+                return {
+                    "nodes": np.nonzero(snap.alive)[0].tolist(),
+                    "as_of_round": snap.round,
+                }
             return {"nodes": self.sim.detector.alive_nodes()}
 
     def Advance(self, req, ctx):
         with self._lock:
+            self._snapshots = None  # synchronous path resolves any bulk scan
             self.sim.tick(int(req.get("rounds", 1)))
             return {"round": self.sim.round}
+
+    def AdvanceBulk(self, req, ctx):
+        """Advance many rounds as ONE compiled scan (SURVEY §7.4's async
+        boundary): jax's async dispatch returns before the device finishes,
+        and an in-scan host callback streams membership snapshots, so
+        ``Lsm``/``AliveNodes`` answer from the freshest snapshot (tagged
+        ``as_of_round``) while the scan runs instead of blocking on device
+        futures.  The next synchronous verb joins the scan and drops back
+        to exact reads.
+
+        Bulk advancement trades the per-round SDFS co-sim reactions for
+        throughput (the detector still detects; the control plane reacts at
+        the next ``Advance``) — the same trade ``bench.run.run_cosim``
+        makes between recovery cadences.
+        """
+        rounds = int(req.get("rounds", 1))
+        every = int(req.get("snapshot_every", max(1, rounds // 10)))
+        with self._lock:
+            start = int(self.sim.detector.state.round)  # resolved pre-dispatch
+            self._snapshots = self.sim.detector.advance_bulk(
+                rounds, snapshot_every=every
+            )
+            return {"round_target": start + rounds, "snapshot_every": every}
 
     def Events(self, req, ctx):
         """Detection events from cursor ``since`` (default 0) on; the reply's
@@ -270,7 +309,8 @@ class ShimServicer:
 
     # -- plumbing -----------------------------------------------------------
     METHODS = [
-        "Join", "Leave", "Crash", "Lsm", "AliveNodes", "Advance", "Events",
+        "Join", "Leave", "Crash", "Lsm", "AliveNodes", "Advance",
+        "AdvanceBulk", "Events",
         "Grep", "GetPutInfo", "GetFileData", "GetFileInfo",
         "AskForConfirmation", "GetDeleteInfo", "DeleteFileData", "RemoteReput",
         "Vote", "AssignNewMaster", "UpdateFileVersion", "GetUpdateMeta",
