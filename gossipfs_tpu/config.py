@@ -37,6 +37,10 @@ AGE_CLAMP = 100
 #   int16 (window 16384): covers every topology up to ring N~32k; 2 B/elem
 #   int8  (window 126):   random-fanout topologies only; 1 B/elem — halves
 #                         the merge's DMA traffic again (bench headline)
+# REBASE_WINDOW doubles as the window for hb_dtype="int16" *storage*
+# (counters kept relative to the monotone per-subject ``hb_base``, see
+# core/rounds.py _merge): live lanes stay within [base, base + window], so
+# 16384 leaves half the int16 range as slack below the base.
 REBASE_WINDOW = 16_384
 INT8_REBASE_WINDOW = 126
 
