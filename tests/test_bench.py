@@ -93,3 +93,15 @@ def test_sdfs_ops_reproduces_reference_claims():
     # (sub-ms medians made the 4-vs-8-node comparison flaky)
     out = run(sizes=(65_536, 2_097_152), reps=5)
     assert all(out["reference_claims_reproduced"].values()), out
+
+
+def test_curves_sweep_smoke():
+    """The TTD/FPR curve runner (bench/curves.py) produces a row per N with
+    every tracked crash detected at ~t_fail rounds."""
+    from gossipfs_tpu.bench.curves import sweep
+
+    out = sweep(ns=(256,), rounds=30)
+    (row,) = out["rows"]
+    assert row["detected"] == row["tracked_crashes"]
+    assert row["ttd_first_median"] == 5
+    assert row["false_positive_rate"] < 1e-4
