@@ -66,12 +66,20 @@ class TestShardMapRunner:
     needs on a real multi-chip mesh (GSPMD would all-gather around the
     custom call).  Must be bit-identical to the single-device run."""
 
-    @pytest.mark.parametrize("kernel", ["xla", "pallas_interpret"])
-    def test_matches_single_device(self, kernel):
+    @pytest.mark.parametrize(
+        "kernel,hb_dtype",
+        [("xla", "int32"), ("pallas_interpret", "int32"),
+         ("pallas_interpret", "int16")],
+    )
+    def test_matches_single_device(self, kernel, hb_dtype):
+        """Includes the int16 storage mode: hb_base is a subject-sharded
+        [N] vector, so the per-shard rebase arithmetic must line up with
+        the shard's column offset."""
         from gossipfs_tpu.core.state import RoundEvents
         from gossipfs_tpu.parallel.mesh import run_rounds_sharded
 
-        cfg = SimConfig(n=1024, topology="random", fanout=8, merge_kernel=kernel)
+        cfg = SimConfig(n=1024, topology="random", fanout=8,
+                        merge_kernel=kernel, hb_dtype=hb_dtype)
         crash = np.zeros((30, cfg.n), dtype=bool)
         crash[5, [7, 300]] = True
         join = np.zeros((30, cfg.n), dtype=bool)
