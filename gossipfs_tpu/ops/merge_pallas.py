@@ -400,7 +400,11 @@ def fused_merge_update_blocked(
             f"(N={n}, fanout={fanout}); use the XLA path"
         )
     c_blk = cs * LANE
-    r_blk = max(min(block_r, n), _FUSED_BLOCK_R_MIN)
+    # cap rows x cols at the validated VMEM budget (128 x 16384 compiles at
+    # ~85 MB of scoped VMEM; bigger blocks OOM at runtime) so an oversized
+    # merge_block_r degrades to a smaller block instead of crashing
+    vmem_cap_rows = max(_FUSED_BLOCK_R_MIN, (_FUSED_BLOCK_R * 16_384) // c_blk)
+    r_blk = max(min(block_r, n, vmem_cap_rows), _FUSED_BLOCK_R_MIN)
     while n % r_blk:
         r_blk //= 2
     n_slots = max(2, min(slots, r_blk))
