@@ -163,3 +163,13 @@ class TestNativeEngine:
             assert 2 not in det.membership(0)
             # a voluntary leave is not a failure detection
             assert all(e.subject != 2 for e in det.drain_events())
+
+
+def test_native_rt_bench_smoke():
+    """The native-runtime benchmark runs the real-socket protocol faster
+    than the reference's 1 round/s wall clock and still detects in ~t_fail."""
+    from gossipfs_tpu.bench.native_rt import run
+
+    out = run(n=10, period=0.02, rounds=30)
+    assert out["rounds_per_sec"] > 10       # >> the reference's 1 round/s
+    assert 4 <= out["detection_rounds"] <= 8
