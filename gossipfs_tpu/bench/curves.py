@@ -84,13 +84,60 @@ def sweep(ns=DEFAULT_NS, rounds=ROUNDS, crash_rate=0.01, seed=0) -> dict:
     }
 
 
+def sweep_t_fail(n=4096, t_fails=(3, 5, 8, 12), rounds=ROUNDS, seed=0) -> dict:
+    """The deployment knob: detection latency vs false-positive tradeoff.
+
+    The reference hardcodes t_fail = 5 s (slave.go:24); this sweep shows
+    what that choice buys — each row is (t_fail, TTD, FPR) at fixed N under
+    1% crash churn, the curve an operator would tune against.
+    """
+    rows = []
+    for t_fail in t_fails:
+        cfg = SimConfig(
+            n=n,
+            topology="random",
+            fanout=SimConfig.log_fanout(n),
+            remove_broadcast=False,
+            fresh_cooldown=True,
+            t_fail=t_fail,
+            t_cooldown=max(12, t_fail + 4),
+            merge_kernel="pallas",
+            view_dtype="int8",
+            hb_dtype="int16",
+            merge_block_c=16_384,
+        )
+        events, crash_rounds, churn_ok = tracked_crash_events(
+            cfg, rounds, TRACK, CRASH_AT
+        )
+        final, carry, per_round = run_rounds(
+            init_state(cfg), cfg, rounds, jax.random.PRNGKey(seed),
+            events=events, crash_rate=0.01, churn_ok=churn_ok,
+        )
+        report = summarize(carry, per_round, crash_rounds)
+        ttd_f = [v for v in report.ttd_first.values() if v >= 0]
+        rows.append(
+            {
+                "t_fail": t_fail,
+                "ttd_first_median": statistics.median(ttd_f) if ttd_f else None,
+                "false_positive_rate": report.false_positive_rate,
+            }
+        )
+    return {"metric": "TTD vs FPR over t_fail (the reference's 5 s knob)",
+            "n": n, "rows": rows}
+
+
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--ns", type=int, nargs="+", default=list(DEFAULT_NS))
     p.add_argument("--rounds", type=int, default=ROUNDS)
+    p.add_argument("--t-fail-sweep", action="store_true",
+                   help="sweep t_fail at fixed N instead of N")
     p.add_argument("--out", type=str, default=None)
     args = p.parse_args(argv)
-    doc = json.dumps(sweep(ns=tuple(args.ns), rounds=args.rounds))
+    if args.t_fail_sweep:
+        doc = json.dumps(sweep_t_fail(rounds=args.rounds))
+    else:
+        doc = json.dumps(sweep(ns=tuple(args.ns), rounds=args.rounds))
     print(doc)
     if args.out:
         with open(args.out, "w") as f:
