@@ -357,3 +357,52 @@ class ShimServer:
 
     def stop(self, grace: float = 0.5) -> None:
         self.server.stop(grace).wait()
+
+
+def main(argv=None) -> None:
+    """Standalone shim process — the reference's ``./main`` for the service:
+
+        python -m gossipfs_tpu.shim.service --n 100 --port 9000
+
+    Serves /gossipfs.Shim/* until interrupted; advance the simulated clock
+    via the Advance/AdvanceBulk RPCs (shim/client.py) or --auto-tick.
+    """
+    import argparse
+    import time as _time
+
+    from gossipfs_tpu.config import SimConfig
+
+    p = argparse.ArgumentParser(description=main.__doc__)
+    p.add_argument("--n", type=int, default=10)
+    p.add_argument("--port", type=int, default=9000)
+    p.add_argument("--topology", choices=["ring", "random"], default="ring")
+    p.add_argument("--auto-confirm", action="store_true",
+                   help="answer write-conflict confirmations yes (30 s-timeout default is no)")
+    p.add_argument("--auto-tick", type=float, default=0.0, metavar="SECONDS",
+                   help="advance one round every SECONDS of wall time (the "
+                        "reference's 1 s driver: --auto-tick 1.0); default: "
+                        "clients drive time via Advance")
+    args = p.parse_args(argv)
+
+    fanout = 3 if args.topology == "ring" else SimConfig.log_fanout(args.n)
+    cfg = SimConfig(n=args.n, topology=args.topology, fanout=fanout)
+    sim = CoSim(cfg)
+    server = ShimServer(sim, port=args.port, auto_confirm=args.auto_confirm).start()
+    print(f"gossipfs shim serving {SERVICE} on {server.address} (n={args.n})",
+          flush=True)
+    try:
+        while True:
+            if args.auto_tick > 0:
+                _time.sleep(args.auto_tick)
+                with server.servicer._lock:
+                    sim.tick(1)
+            else:
+                _time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()
