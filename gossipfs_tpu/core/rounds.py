@@ -114,7 +114,18 @@ def _use_pallas(config: SimConfig, fanout: int, n: int, n_cols: int | None = Non
 
     if config.merge_kernel == "xla" or not merge_pallas.supported(n, fanout, n_cols):
         return False
-    return config.merge_kernel == "pallas_interpret" or jax.default_backend() == "tpu"
+    if config.merge_kernel == "pallas_interpret":
+        return True
+    # compiled (Mosaic) path only on TPU, and only when the column blocking
+    # yields int8-tileable DMA units — small N (or narrow shards) would
+    # produce sub-(32, 128) blocks that fail to compile; XLA is the right
+    # path at those sizes anyway
+    if jax.default_backend() != "tpu":
+        return False
+    _, cs, lane = merge_pallas.blocked_cols(
+        n if n_cols is None else n_cols, config.merge_block_c
+    )
+    return cs * lane >= merge_pallas.MIN_COMPILED_BLOCK_C
 
 
 def _use_blocked(config: SimConfig, fanout: int, n: int, n_cols: int | None = None) -> bool:

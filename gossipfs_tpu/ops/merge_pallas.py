@@ -41,6 +41,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 LANE = 128
 
+# Narrowest column block the COMPILED kernel can move: the int8 lanes'
+# native tile is (32, 128), so a DMA unit (C/128, 128) needs C >= 32*128.
+# Below this (small N, narrow shards) the dispatch (core/rounds._use_pallas)
+# stays on the XLA path; interpret mode has no tiling and runs any size.
+MIN_COMPILED_BLOCK_C = 32 * LANE
+
 
 def _gather_max_rows(edges_ref, view_ref, scratch, sems, n_fanout, r_blk, slots, sink):
     """The slotted gather pipeline shared by both kernels.
