@@ -121,10 +121,27 @@ class SimDetector:
 
             buffer = SnapshotBuffer()
             snapshot = (buffer, snapshot_every)
-        self.state, _, _ = run_rounds(
+        start_round = int(self.state.round)
+        self.state, mcarry, _ = run_rounds(
             self.state, self.config, rounds, self._key, events=events,
             snapshot=snapshot,
         )
+        # the per-round path records one DetectionEvent per (observer,
+        # subject) firing; inside a compiled scan the full fail matrix never
+        # reaches the host, so bulk advancement synthesizes one aggregate
+        # event per newly-detected subject from the metrics carry
+        # (observer=-1 marks it cluster-level)
+        first = np.asarray(mcarry.first_detect)
+        alive = np.asarray(self.state.alive)
+        for subj in np.nonzero((first >= start_round) & (first < start_round + rounds))[0]:
+            self._events.append(
+                DetectionEvent(
+                    round=int(first[subj]),
+                    observer=-1,
+                    subject=int(subj),
+                    false_positive=bool(alive[subj]),
+                )
+            )
         return buffer
 
     # -- views -------------------------------------------------------------

@@ -49,6 +49,11 @@ def test_detector_advance_bulk_with_snapshots():
     assert snap.round == 20
     assert not snap.alive[7]
     assert 7 not in snap.membership(0)
+    # bulk advancement synthesizes cluster-level detection events
+    events = [e for e in det.drain_events() if e.subject == 7]
+    assert events and events[0].observer == -1
+    assert 7 <= events[0].round <= 11  # crash ~round 4 + t_fail + spread
+    assert not events[0].false_positive
     # bulk path agrees with the per-round path on the final view
     det2 = SimDetector(cfg)
     det2.advance(3)
