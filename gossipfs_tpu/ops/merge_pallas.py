@@ -158,6 +158,12 @@ def fanout_max_merge(
     c_blk = min(block_c, n)
     while n % c_blk:
         c_blk //= 2
+    if not interpret and c_blk < MIN_COMPILED_BLOCK_C:
+        raise ValueError(
+            f"compiled pallas merge needs >= {MIN_COMPILED_BLOCK_C}-wide "
+            f"column blocks (got {c_blk} at N={n}); Mosaic rejects "
+            "sub-tile DMA units — use interpret mode or the XLA path"
+        )
     r_blk = min(block_r, n)
     while n % r_blk:
         r_blk //= 2
