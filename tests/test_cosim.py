@@ -64,3 +64,29 @@ class TestCoSim:
         sim.tick(10)
         assert 7 not in sim.detector.alive_nodes()
         assert not any(e.subject == 7 for e in sim.events)
+
+
+class TestRecoveryCadence:
+    def test_repair_waits_exactly_recovery_delay(self):
+        """The reference sleeps 8 heartbeats between detection and
+        re-replication (Fail_recover, slave.go:1123): repairs must land in
+        the round scheduled RECOVERY_DELAY after detection, never earlier."""
+        sim = make_sim()
+        sim.tick(3)
+        assert sim.put("file5.txt", b"payload")
+        victim = sim.cluster.ls("file5.txt")[0]
+        if victim == sim.cluster.master_node:
+            victim = sim.cluster.ls("file5.txt")[1]
+        sim.detector.crash(victim)
+        sim.tick(20)
+        detect_round = min(
+            e.round for e in sim.events if e.subject == victim
+        )
+        repair_rounds = [
+            entry["round"] for entry in sim.log.grep("Re-replicated file5.txt")
+        ]
+        assert repair_rounds, "no repair happened"
+        # events are stamped with the round index the heartbeat started
+        # from; the recovery timer counts from the heartbeat that fired
+        # (detect_round + 1), matching Fail_recover's sleep-from-detection
+        assert min(repair_rounds) == detect_round + 1 + RECOVERY_DELAY
