@@ -2,20 +2,25 @@
 
 Wraps the batched round kernel (core/rounds.py) behind the per-node verbs the
 CLI / SDFS shim consume.  Interactive path: one jitted ``gossip_round`` per
-``advance``; bulk experiments should call ``core.rounds.run_rounds`` directly
-(scan, no per-round host sync).
+``advance``; bulk path: ``advance_bulk`` scans the horizon in compiled
+chunks pipelined from a background thread, publishing membership snapshots
+between chunks (SURVEY §7.4's async boundary, tunnel-safe — no host
+callbacks).
 """
 
 from __future__ import annotations
+
+import threading
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from gossipfs_tpu.config import SimConfig
-from gossipfs_tpu.core.rounds import gossip_round
+from gossipfs_tpu.core.rounds import gossip_round, run_rounds
 from gossipfs_tpu.core.state import MEMBER, RoundEvents, SimState, init_state
 from gossipfs_tpu.detector.api import DetectionEvent
+from gossipfs_tpu.utils.snapshot import Snapshot, SnapshotBuffer
 
 
 class SimDetector:
@@ -36,6 +41,17 @@ class SimDetector:
         self._pending_leave: set[int] = set()
         self._pending_join: set[int] = set()
         self._events: list[DetectionEvent] = []
+        # bulk-scan results whose event synthesis is deferred until someone
+        # actually reads events (np.asarray on the carry would otherwise
+        # block the dispatching call until the whole scan finishes)
+        self._pending_bulk: list[tuple[int, int, object, SimState]] = []
+        self._bulk_thread: threading.Thread | None = None
+        self._bulk_error: BaseException | None = None
+        # one buffer reused across advance_bulk calls: a fresh buffer per
+        # call would be a fresh object in any cache key and, more
+        # importantly, readers hold a reference to THE buffer, not to one
+        # call's buffer
+        self._snap_buffer: SnapshotBuffer | None = None
 
     # -- event verbs -------------------------------------------------------
     def _check(self, node: int) -> int:
@@ -53,7 +69,25 @@ class SimDetector:
         self._pending_crash.add(self._check(node))
 
     # -- time --------------------------------------------------------------
+    def _join_bulk(self) -> None:
+        """Wait for an in-flight bulk scan before touching state mutably.
+
+        Re-raises any exception the pipeline thread hit (a silently-failed
+        chunk would otherwise leave the detector frozen at the pre-bulk
+        round while callers believe it advanced).
+        """
+        t = self._bulk_thread
+        if t is not None and t.is_alive():
+            t.join()
+        self._bulk_thread = None
+        err, self._bulk_error = self._bulk_error, None
+        if err is not None:
+            raise RuntimeError("bulk advancement failed mid-scan") from err
+
     def advance(self, rounds: int = 1) -> None:
+        self._join_bulk()
+        # events from any finished bulk scan precede this call's, chronologically
+        self._resolve_pending_bulk()
         n = self.config.n
         for _ in range(rounds):
             ev = RoundEvents(
@@ -73,6 +107,11 @@ class SimDetector:
                 edges = random_in_edges(k, n, self.config.fanout)
             round_idx = int(self.state.round)
             self.state, _, fail = gossip_round(self.state, ev, edges, self.config)
+            if not bool(jnp.any(fail)):
+                # quiet round: one scalar transfer instead of the [N, N]
+                # fail matrix (the O(N^2)-per-round host traffic the round-1
+                # review flagged)
+                continue
             alive = np.asarray(self.state.alive)
             for obs, subj in np.argwhere(np.asarray(fail)):
                 self._events.append(
@@ -90,59 +129,122 @@ class SimDetector:
             m[list(nodes)] = True
         return jnp.asarray(m)
 
-    def advance_bulk(self, rounds: int, snapshot_every: int | None = None):
-        """Advance many rounds as one compiled scan (no per-round host sync).
-
-        With ``snapshot_every``, returns a ``utils.snapshot.SnapshotBuffer``
-        that an in-scan host callback feeds every k rounds: because jax
-        dispatch is asynchronous this call returns while the device is
-        still scanning, and other threads (the gRPC shim) read
-        ``buffer.latest()`` for a consistent mid-run membership view
-        (SURVEY §7.4's async boundary).  Pending crash/leave/join verbs are
-        applied on the first round.
-        """
-        from gossipfs_tpu.core.rounds import run_rounds
-        from gossipfs_tpu.core.state import RoundEvents as RE
-
+    def _first_round_events(self, rounds: int) -> RoundEvents:
         n = self.config.n
-        first = np.zeros((rounds, n), dtype=bool)
-        events = RE(
-            crash=jnp.asarray(first).at[0].set(self._mask(self._pending_crash)),
-            leave=jnp.asarray(first).at[0].set(self._mask(self._pending_leave)),
-            join=jnp.asarray(first).at[0].set(self._mask(self._pending_join)),
+        zeros = np.zeros((rounds, n), dtype=bool)
+        ev = RoundEvents(
+            crash=jnp.asarray(zeros).at[0].set(self._mask(self._pending_crash)),
+            leave=jnp.asarray(zeros).at[0].set(self._mask(self._pending_leave)),
+            join=jnp.asarray(zeros).at[0].set(self._mask(self._pending_join)),
         )
         self._pending_crash.clear()
         self._pending_leave.clear()
         self._pending_join.clear()
-        buffer = None
-        snapshot = None
-        if snapshot_every is not None:
-            from gossipfs_tpu.utils.snapshot import SnapshotBuffer
+        return ev
 
-            buffer = SnapshotBuffer()
-            snapshot = (buffer, snapshot_every)
+    def advance_bulk(self, rounds: int, snapshot_every: int | None = None):
+        """Advance many rounds as compiled scans (no per-round host sync).
+
+        Without ``snapshot_every``: one scan, dispatched asynchronously;
+        event synthesis is deferred to ``drain_events`` so this call
+        returns while the device is still working.
+
+        With ``snapshot_every``: the horizon is split into chunks of that
+        many rounds (bit-identical to one long scan — the metrics carry
+        threads through) and a background thread pipelines them two deep,
+        publishing a ``utils.snapshot.Snapshot`` to the returned buffer as
+        each chunk completes.  Other threads (the gRPC shim) read
+        ``buffer.latest()`` for a consistent mid-run membership view; the
+        detector's ``state`` also advances chunk by chunk, so direct reads
+        see the freshest *completed* state.  Pending crash/leave/join verbs
+        are applied on the first round.  No host callbacks are involved, so
+        this works over a remote-PJRT TPU tunnel.
+        """
+        self._join_bulk()
         start_round = int(self.state.round)
-        self.state, mcarry, _ = run_rounds(
-            self.state, self.config, rounds, self._key, events=events,
-            snapshot=snapshot,
-        )
-        # the per-round path records one DetectionEvent per (observer,
-        # subject) firing; inside a compiled scan the full fail matrix never
-        # reaches the host, so bulk advancement synthesizes one aggregate
-        # event per newly-detected subject from the metrics carry
-        # (observer=-1 marks it cluster-level)
-        first = np.asarray(mcarry.first_detect)
-        alive = np.asarray(self.state.alive)
-        for subj in np.nonzero((first >= start_round) & (first < start_round + rounds))[0]:
-            self._events.append(
-                DetectionEvent(
-                    round=int(first[subj]),
-                    observer=-1,
-                    subject=int(subj),
-                    false_positive=bool(alive[subj]),
-                )
+        events = self._first_round_events(rounds)
+
+        if snapshot_every is None:
+            self.state, mcarry, _ = run_rounds(
+                self.state, self.config, rounds, self._key, events=events
             )
+            self._pending_bulk.append((start_round, rounds, mcarry, self.state))
+            return None
+
+        if self._snap_buffer is None:
+            self._snap_buffer = SnapshotBuffer()
+        buffer = self._snap_buffer
+        buffer.clear()
+
+        every = max(1, int(snapshot_every))
+        chunks: list[tuple[int, int]] = []  # (offset, length)
+        off = 0
+        while off < rounds:
+            ln = min(every, rounds - off)
+            chunks.append((off, ln))
+            off += ln
+
+        def pipeline() -> None:
+            try:
+                st = self.state
+                mcarry = None
+                prev: SimState | None = None
+                for off, ln in chunks:
+                    ev = RoundEvents(
+                        crash=events.crash[off:off + ln],
+                        leave=events.leave[off:off + ln],
+                        join=events.join[off:off + ln],
+                    )
+                    st, mcarry, _ = run_rounds(
+                        st, self.config, ln, self._key, events=ev, mcarry0=mcarry
+                    )
+                    if prev is not None:
+                        # blocks until the previous chunk lands — the current
+                        # chunk is already queued behind it, so the device
+                        # never idles; bounding the pipeline here also bounds
+                        # how many chunk states can be live in HBM (<= 2)
+                        self._publish(prev)
+                    prev = st
+                self._publish(prev)
+                self._pending_bulk.append((start_round, rounds, mcarry, st))
+            except BaseException as e:  # re-raised by the next _join_bulk
+                self._bulk_error = e
+
+        t = threading.Thread(target=pipeline, daemon=True, name="gossipfs-bulk")
+        self._bulk_thread = t
+        t.start()
         return buffer
+
+    def _publish(self, st: SimState) -> None:
+        alive = np.asarray(st.alive)  # waits for the chunk to complete
+        self.state = st
+        self._snap_buffer.push(
+            Snapshot(round=int(st.round), alive=alive, state=st)
+        )
+
+    def _resolve_pending_bulk(self) -> None:
+        """Synthesize detection events from finished bulk scans.
+
+        Inside a compiled scan the full fail matrix never reaches the host;
+        the metrics carry records, per subject, the first detection round
+        and the (lowest-index) observer that fired — so bulk advancement
+        reports the same first event per subject as the per-round path.
+        """
+        pending, self._pending_bulk = self._pending_bulk, []
+        for start, rounds, mcarry, state in pending:
+            first = np.asarray(mcarry.first_detect)
+            observer = np.asarray(mcarry.first_observer)
+            alive = np.asarray(state.alive)
+            in_window = (first >= start) & (first < start + rounds)
+            for subj in np.nonzero(in_window)[0]:
+                self._events.append(
+                    DetectionEvent(
+                        round=int(first[subj]),
+                        observer=int(observer[subj]),
+                        subject=int(subj),
+                        false_positive=bool(alive[subj]),
+                    )
+                )
 
     # -- views -------------------------------------------------------------
     def membership(self, observer: int) -> list[int]:
@@ -153,5 +255,7 @@ class SimDetector:
         return [int(j) for j in np.nonzero(np.asarray(self.state.alive))[0]]
 
     def drain_events(self) -> list[DetectionEvent]:
+        self._join_bulk()
+        self._resolve_pending_bulk()
         out, self._events = self._events, []
         return out

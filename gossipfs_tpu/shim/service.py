@@ -395,6 +395,10 @@ def main(argv=None) -> None:
             if args.auto_tick > 0:
                 _time.sleep(args.auto_tick)
                 with server.servicer._lock:
+                    # like Advance: the synchronous path resolves any bulk
+                    # scan, so Lsm/AliveNodes can't stay pinned to a stale
+                    # bulk snapshot while the auto-ticked state moves on
+                    server.servicer._snapshots = None
                     sim.tick(1)
             else:
                 _time.sleep(3600)

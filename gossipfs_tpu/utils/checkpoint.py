@@ -132,7 +132,27 @@ def restore_checkpoint(
     # fresh base instead of silently wrapping.
     true_hb = restored["state"]["hb"] + restored["state"]["hb_base"][None, :]
     if config.hb_dtype == "int16":
-        new_base = jnp.maximum(jnp.max(true_hb, axis=0) - REBASE_WINDOW, 0)
+        # Mirror _merge's gossip-eligibility filter when anchoring the
+        # restore base: FAILED/UNKNOWN entries and dead nodes' frozen rows
+        # keep crash-time counters forever, and since store_base is monotone
+        # a base inflated by such a zombie lane at restore time would be
+        # permanent — pinning a rejoined subject's fresh entries below base
+        # (saturated, out of gossip).  Subjects with no eligible copy fall
+        # back to the 'true hb 0' filler, exactly like the in-round colmax.
+        from gossipfs_tpu.core.state import MEMBER as _MEMBER
+
+        elig = (restored["state"]["status"] == _MEMBER) & restored["state"][
+            "alive"
+        ][:, None]
+        elig_max = jnp.max(jnp.where(elig, true_hb, 0), axis=0)
+        # never DECREASE below the checkpoint's own base either: a lower
+        # base would re-encode int16 floor-sentinel lanes (unknown-counter
+        # markers) as ordinary values inflated by base - 32768 — the exact
+        # resurrection the sticky-sentinel bump guard in _tick prevents
+        new_base = jnp.maximum(
+            jnp.maximum(elig_max - REBASE_WINDOW, 0),
+            restored["state"]["hb_base"],
+        )
         restored["state"]["hb"] = jnp.clip(
             true_hb - new_base[None, :], -32768, 32767
         ).astype(jnp.int16)

@@ -2,12 +2,18 @@
 
 SURVEY.md §7.4 ("async boundary"): the gRPC shim must be able to serve the
 membership view without stalling a long device-resident scan.  Mechanism:
-``run_rounds(..., snapshot=(buffer, every))`` plants a ``jax.experimental.
-io_callback`` inside the scan that pushes (round, alive, status) to this
-host-side buffer every ``every`` rounds.  Because jax dispatch is
-asynchronous, the Python caller gets control back while the device scans;
-any thread (e.g. the gRPC server) reads ``buffer.latest()`` for the
-freshest view — no blocking ``device_get`` against in-flight futures.
+``SimDetector.advance_bulk(rounds, snapshot_every=k)`` splits the horizon
+into k-round compiled scans and pipelines them from a background thread,
+publishing a :class:`Snapshot` as each chunk completes.  The snapshot holds
+the chunk-boundary device state (a *completed* array — never an in-flight
+future) plus an eagerly-fetched ``alive`` vector; membership rows are read
+lazily one observer at a time, so serving ``lsm`` costs one [N]-row
+transfer, not an [N, N] pull.
+
+Earlier rounds used an in-scan ``io_callback`` instead; host callbacks
+cannot cross a remote-PJRT TPU tunnel (the callable lives on the wrong
+side), so the chunked design replaces them with plain device reads —
+tunnel-safe by construction.
 
 The reference has no analog (every read walks the live Go structures, racy
 by design — SURVEY §2.4); this is the simulator's equivalent of reading
@@ -18,49 +24,60 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+from functools import cached_property
 
 import numpy as np
 
 
 @dataclasses.dataclass(frozen=True)
 class Snapshot:
-    """One consistent point-in-time view of the whole cluster."""
+    """One consistent point-in-time view of the whole cluster.
+
+    ``state`` is the completed chunk-boundary ``SimState`` (device-resident;
+    row reads transfer one [N] slice).  At most one snapshot's state is kept
+    alive by a latest-wins buffer, so holding it does not accumulate HBM.
+    """
 
     round: int
-    alive: np.ndarray    # bool [N]
-    status: np.ndarray   # int8 [N, N] — row i is node i's membership table
+    alive: np.ndarray  # bool [N], fetched eagerly (small)
+    state: "object"    # the completed chunk-boundary SimState
 
     def membership(self, node: int) -> list[int]:
         from gossipfs_tpu.core.state import MEMBER
 
-        return np.nonzero(self.status[node] == int(MEMBER))[0].tolist()
+        row = np.asarray(self.state.status[node])
+        return np.nonzero(row == int(MEMBER))[0].tolist()
+
+    @cached_property
+    def status(self) -> np.ndarray:
+        """Full [N, N] status matrix (one bulk transfer; prefer
+        :meth:`membership` for single-observer reads)."""
+        n = self.alive.shape[0]
+        return np.asarray(self.state.status).reshape(n, n)
 
 
 class SnapshotBuffer:
-    """Latest-wins buffer written by the in-scan callback, read by any thread."""
+    """Latest-wins buffer written by the chunk pipeline, read by any thread."""
 
     def __init__(self, keep_history: bool = False):
         self._lock = threading.Lock()
         self._latest: Snapshot | None = None
         self._history: list[Snapshot] | None = [] if keep_history else None
 
-    def push(self, round_, alive, status) -> None:
-        """io_callback target — converts device payloads to host arrays.
-
-        ``status`` may arrive in the scan's blocked 4-D layout; on the host
-        it is plain C-order, so the [N, N] reshape is free.
-        """
-        alive = np.asarray(alive)
-        n = alive.shape[0]
-        snap = Snapshot(
-            round=int(np.asarray(round_)),
-            alive=alive,
-            status=np.asarray(status).reshape(n, n),
-        )
+    def push(self, snap: Snapshot) -> None:
         with self._lock:
             self._latest = snap
             if self._history is not None:
                 self._history.append(snap)
+
+    def clear(self) -> None:
+        """Drop the latest view (and history) — called when a new bulk scan
+        starts so stale rounds can't serve reads, and so the previous run's
+        chunk states get released."""
+        with self._lock:
+            self._latest = None
+            if self._history is not None:
+                self._history = []
 
     def latest(self) -> Snapshot | None:
         with self._lock:
