@@ -1,0 +1,89 @@
+"""Per-round timing across merge-kernel configurations (TPU tuning aid).
+
+    python -m gossipfs_tpu.bench.roundprof            # default N=16384
+    python -m gossipfs_tpu.bench.roundprof --n 8192 --rounds 50
+
+Prints ms/round and rounds/s for each named configuration so kernel work
+(ops/merge_pallas.py) can be attributed: the XLA-remainder cost is the gap
+between a config's round time and its merge kernel's standalone time.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import time
+
+import jax
+
+from gossipfs_tpu.config import SimConfig
+from gossipfs_tpu.core.rounds import run_rounds
+from gossipfs_tpu.core.state import init_state
+
+
+def base_config(n: int) -> SimConfig:
+    return SimConfig(
+        n=n,
+        topology="random",
+        fanout=SimConfig.log_fanout(n),
+        remove_broadcast=False,
+        fresh_cooldown=True,
+        t_cooldown=12,
+        merge_kernel="xla",
+        view_dtype="int8",
+        merge_block_c=16_384,
+        hb_dtype="int16",
+    )
+
+
+def variants(n: int) -> dict[str, SimConfig]:
+    cfg = base_config(n)
+    out = {
+        "xla": cfg,
+        "pallas_gather": dataclasses.replace(cfg, merge_kernel="pallas"),
+    }
+    from gossipfs_tpu.ops.merge_pallas import STRIPE_BLOCK_C, stripe_supported
+
+    if stripe_supported(n, cfg.fanout):
+        out["pallas_stripe"] = dataclasses.replace(
+            cfg, merge_kernel="pallas_stripe", merge_block_c=STRIPE_BLOCK_C
+        )
+    return out
+
+
+def time_config(cfg: SimConfig, rounds: int, reps: int = 3) -> float:
+    key = jax.random.PRNGKey(0)
+    state = init_state(cfg)
+    st, _, _ = run_rounds(state, cfg, rounds, key, crash_rate=0.01)
+    jax.block_until_ready(st)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        st, _, _ = run_rounds(state, cfg, rounds, key, crash_rate=0.01)
+        jax.block_until_ready(st)
+        best = min(best, time.perf_counter() - t0)
+    return best / rounds
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--n", type=int, default=16_384)
+    p.add_argument("--rounds", type=int, default=100)
+    p.add_argument("--only", nargs="*", default=None)
+    args = p.parse_args(argv)
+
+    rows = {}
+    for name, cfg in variants(args.n).items():
+        if args.only and name not in args.only:
+            continue
+        per_round = time_config(cfg, args.rounds)
+        rows[name] = {
+            "ms_per_round": round(per_round * 1e3, 3),
+            "rounds_per_sec": round(1.0 / per_round, 1),
+        }
+        print(json.dumps({"config": name, "n": args.n, **rows[name]}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -80,13 +80,21 @@ class SimConfig:
                                      # the kernel's limiter once the view is a
                                      # narrow dtype (view_dtype below)
     merge_slots: int = 4             # pallas merge DMA double-buffer depth
-    merge_kernel: str = "xla"        # "xla" | "pallas": implementation of the
-                                     # per-round fanout max-merge (the hot op).
-                                     # "pallas" is the hand-written TPU DMA
-                                     # kernel (ops/merge_pallas.py, ~4x the
-                                     # XLA gather's bandwidth); "pallas_interpret"
-                                     # runs the same kernel in interpreter mode
-                                     # (CPU tests only — slow)
+    merge_kernel: str = "xla"        # "xla" | "pallas" | "pallas_stripe":
+                                     # implementation of the per-round fanout
+                                     # max-merge (the hot op).  "pallas" is
+                                     # the DMA-gather TPU kernel
+                                     # (ops/merge_pallas.py, ~4x the XLA
+                                     # gather's bandwidth); "pallas_stripe"
+                                     # keeps each view column block resident
+                                     # in VMEM so the view moves over HBM
+                                     # once per round instead of F times
+                                     # (needs merge_block_c=4096 and
+                                     # N <= ~16k — see
+                                     # merge_pallas.stripe_supported);
+                                     # "*_interpret" variants run the same
+                                     # kernels in interpreter mode (CPU
+                                     # tests only — slow)
     view_dtype: str = "int16"        # gossip-view storage: "int16" | "int8".
                                      # int8 halves the merge's HBM traffic but
                                      # its 126-round rebase window only covers
@@ -115,8 +123,43 @@ class SimConfig:
                 f"t_fail and t_cooldown must be < AGE_CLAMP ({AGE_CLAMP}); "
                 "the age lane saturates there"
             )
-        if self.merge_kernel not in ("xla", "pallas", "pallas_interpret"):
+        if self.merge_kernel not in (
+            "xla", "pallas", "pallas_interpret",
+            "pallas_stripe", "pallas_stripe_interpret",
+        ):
             raise ValueError(f"unknown merge_kernel: {self.merge_kernel!r}")
+        if self.merge_kernel.startswith("pallas_stripe"):
+            if self.topology == "ring":
+                # ring stays on the 2-D path; the stripe kernel is
+                # blocked-layout only
+                raise ValueError("merge_kernel='pallas_stripe' requires "
+                                 "topology='random'")
+            if self.view_dtype != "int8":
+                # the stripe VMEM budget is counted in bytes at 1 B/elem;
+                # a wider view would double the resident stripe past it
+                raise ValueError("merge_kernel='pallas_stripe' requires "
+                                 "view_dtype='int8'")
+            from gossipfs_tpu.ops.merge_pallas import (
+                STRIPE_BLOCK_C,
+                STRIPE_MAX_BYTES,
+                stripe_supported,
+            )
+
+            if self.merge_block_c != STRIPE_BLOCK_C:
+                raise ValueError(
+                    f"merge_kernel='pallas_stripe' requires "
+                    f"merge_block_c={STRIPE_BLOCK_C} (the VMEM-resident "
+                    f"stripe width), got {self.merge_block_c}"
+                )
+            if not stripe_supported(self.n, self.fanout):
+                # reject eagerly rather than silently running the XLA path:
+                # N must be lane-aligned, a multiple of the stripe width,
+                # and small enough that one stripe fits VMEM
+                raise ValueError(
+                    f"merge_kernel='pallas_stripe' unsupported at n={self.n}"
+                    f" (needs n % {STRIPE_BLOCK_C} == 0 and "
+                    f"n * {STRIPE_BLOCK_C} <= {STRIPE_MAX_BYTES} B of VMEM)"
+                )
         if self.view_dtype not in ("int16", "int8"):
             raise ValueError(f"unknown view_dtype: {self.view_dtype!r}")
         if self.hb_dtype not in ("int32", "int16"):

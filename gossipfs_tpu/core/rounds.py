@@ -109,11 +109,18 @@ def _subj_axes(a: jax.Array) -> tuple[int, ...]:
 
 
 def _use_pallas(config: SimConfig, fanout: int, n: int, n_cols: int | None = None) -> bool:
-    """Whether this run executes the pallas merge kernel."""
+    """Whether this run executes a pallas merge kernel."""
     from gossipfs_tpu.ops import merge_pallas
 
     if config.merge_kernel == "xla" or not merge_pallas.supported(n, fanout, n_cols):
         return False
+    if config.merge_kernel.startswith("pallas_stripe"):
+        if not merge_pallas.stripe_supported(n, fanout, n_cols):
+            return False
+        return (
+            config.merge_kernel == "pallas_stripe_interpret"
+            or jax.default_backend() == "tpu"
+        )
     if config.merge_kernel == "pallas_interpret":
         return True
     # compiled (Mosaic) path only on TPU, and only when the column blocking
@@ -173,18 +180,22 @@ class MetricsCarry(NamedTuple):
     """Per-subject first-detection / convergence rounds, carried across the scan.
 
     ``first_detect[j]``: first round any observer's detector fired on j.
+    ``first_observer[j]``: the (lowest-index) observer whose detector fired
+    on j in that first round — so bulk advancement can report real
+    per-observer detection events instead of an aggregate placeholder.
     ``converged[j]``: first round every live observer had dropped j from its
     list (the cluster-wide detection-complete time the BASELINE curves want).
-    Both are -1 until the event happens; reset to -1 when j rejoins.
+    All are -1 until the event happens; reset to -1 when j rejoins.
     """
 
-    first_detect: jax.Array  # int32 [N]
-    converged: jax.Array     # int32 [N]
+    first_detect: jax.Array    # int32 [N]
+    first_observer: jax.Array  # int32 [N]
+    converged: jax.Array       # int32 [N]
 
     @staticmethod
     def init(n: int) -> "MetricsCarry":
         neg = jnp.full((n,), -1, dtype=jnp.int32)
-        return MetricsCarry(first_detect=neg, converged=neg)
+        return MetricsCarry(first_detect=neg, first_observer=neg, converged=neg)
 
 
 def _apply_events(
@@ -256,25 +267,61 @@ def _apply_events(
     return state._replace(hb=hb, age=age, status=status, alive=alive)
 
 
-def _tick(
+def _pre_tick(
     state: SimState, config: SimConfig, ctx: ShardCtx = LOCAL_CTX
-) -> tuple[SimState, jax.Array, jax.Array]:
-    """Per-node heartbeat pass: refresh/bump/detect/remove-broadcast/cooldown.
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """The round's two reductions over the post-events state, in one pass.
 
-    Returns (state, fail_events [N,N] bool, active [N] bool senders).
+    Returns (active [N], refresher [N], colmax_est [subject-shaped]):
+
+    * ``active``/``refresher``: senders vs small-group timestamp-refreshers,
+      from the per-receiver member counts (slave.go:504-511).  Cross-shard
+      under run_rounds_sharded: each shard holds a column slice, so the
+      row-sum needs a psum.
+    * ``colmax_est``: per-subject upper bound on the freshest gossip-eligible
+      true counter *after* the tick's bump — the anchor for this round's
+      view/storage rebase (see ``_merge``).  Computed pre-tick so the whole
+      tick + view build can stream in a single fused pass: the estimate is
+      the pre-tick eligible max plus one (the bump adds at most 1/round to
+      any subject's freshest copy).  Eligibility here is alive-receiver
+      MEMBER entries — a superset of post-tick sender eligibility, so the
+      estimate can only exceed the true colmax, shrinking the rebase window
+      by the excess (bounded by 1 except for subjects losing their freshest
+      copy this very round); the config validation margins absorb it.
     """
-    n = state.n
-    hb, age, status, alive = state.hb, state.age, state.status, state.alive
+    hb, status, alive = state.hb, state.status, state.alive
     nd, shp = hb.ndim, hb.shape
-    eye = _eye(n, shp, ctx)
-
-    # cross-shard under run_rounds_sharded: each shard holds a column slice
     counts = ctx.psum(
         jnp.sum((status == MEMBER).astype(jnp.int32), axis=_subj_axes(status))
     )
     small = counts < config.min_group
     active = alive & ~small
     refresher = alive & small
+
+    basec = state.hb_base.reshape(shp[1:])  # subject-shaped; zero in int32 mode
+    elig = _rx(alive, nd) & (status == MEMBER)
+    hb32 = hb.astype(jnp.int32)
+    # true colmax over eligible copies ('true hb 0' filler via -basec), +1
+    colmax_est = jnp.max(jnp.where(elig, hb32, -basec[None]), axis=0) + basec + 1
+    return active, refresher, colmax_est
+
+
+def _tick(
+    state: SimState,
+    config: SimConfig,
+    ctx: ShardCtx = LOCAL_CTX,
+    *,
+    active: jax.Array,
+    refresher: jax.Array,
+) -> tuple[SimState, jax.Array]:
+    """Per-node heartbeat pass: refresh/bump/detect/remove-broadcast/cooldown.
+
+    Returns (state, fail_events [N,N] bool).
+    """
+    n = state.n
+    hb, age, status, alive = state.hb, state.age, state.status, state.alive
+    nd, shp = hb.ndim, hb.shape
+    eye = _eye(n, shp, ctx)
 
     # small groups only refresh timestamps (slave.go:504-509)
     refresh_all = _rx(refresher, nd) & (status == MEMBER)
@@ -284,6 +331,13 @@ def _tick(
     # list (updateMemberList matches by address, slave.go:443-448; a node that
     # processed a REMOVE about itself stops bumping)
     bump = eye & _rx(active, nd) & (status == MEMBER)
+    if hb.dtype == jnp.int16:
+        # entries saturated at the storage floor hold unknown true counters
+        # (the zombie-rejoin corner): a bump would move the lane off the
+        # sentinel and resurrect a counter inflated by base - 32768.  Keep
+        # the sentinel sticky — the entry stays excluded from gossip and
+        # detection until the introducer's join push rewrites it.
+        bump &= hb != jnp.iinfo(jnp.int16).min
     hb = hb + bump.astype(hb.dtype)
     age = jnp.where(bump, 0, age)
 
@@ -326,15 +380,15 @@ def _tick(
     expire = (status == FAILED) & (age > config.t_cooldown)
     status = jnp.where(expire, UNKNOWN, status)
 
-    return (
-        state._replace(hb=hb, age=age, status=status, alive=alive),
-        fail,
-        active,
-    )
+    return state._replace(hb=hb, age=age, status=status, alive=alive), fail
 
 
 def _merge(
-    state: SimState, edges: jax.Array, senders: jax.Array, config: SimConfig
+    state: SimState,
+    edges: jax.Array,
+    senders: jax.Array,
+    config: SimConfig,
+    colmax_est: jax.Array,
 ) -> SimState:
     """Gossip exchange: gather sender rows over in-edges, elementwise-max merge.
 
@@ -360,11 +414,12 @@ def _merge(
     # counts are rebased per subject so the view fits a narrow dtype
     # (config.view_dtype: int16, or int8 for random topologies), shrinking
     # the HBM traffic of the F-way gather — the round's dominant cost — by
-    # 2-4x over int32.  The base is
-    # derived from *gossip-eligible* copies only: hb lanes of FAILED/UNKNOWN
-    # entries and dead nodes' frozen rows keep crash-time counters forever,
-    # and anchoring on those would mask a rejoining node's fresh hb=0
-    # entries out of gossip once the run is > rebase_window rounds old.
+    # 2-4x over int32.  The base anchors on ``colmax_est`` (see ``_pre_tick``)
+    # which is derived from *gossip-eligible* copies only: hb lanes of
+    # FAILED/UNKNOWN entries and dead nodes' frozen rows keep crash-time
+    # counters forever, and anchoring on those would mask a rejoining node's
+    # fresh hb=0 entries out of gossip once the run is > rebase_window
+    # rounds old.
     # Gossip-eligible entries (MEMBER, so age <= t_fail at the holder) lag
     # the freshest eligible copy by O(t_fail) per hop, so same-incarnation
     # copies never fall rebase_window behind.  The one reachable clamp: a
@@ -376,11 +431,8 @@ def _merge(
     nd = hb.ndim
     hb16 = hb.dtype == jnp.int16
     basec = state.hb_base.reshape(hb.shape[1:])  # subject-shaped, all-zero in int32 mode
-    elig = (status == MEMBER) & _rx(senders, nd)
-    # true colmax: stored values are relative to basec (identity in int32
-    # mode); the filler encodes true hb 0 so the implicit floor matches
     hb32 = hb.astype(jnp.int32)
-    colmax = jnp.max(jnp.where(elig, hb32, -basec[None]), axis=0) + basec
+    colmax = colmax_est
     view_base = jnp.maximum(colmax - config.rebase_window, 0)
     # A: shift from stored to view encoding (== view_base in int32 mode).
     # B: shift from the old stored base to the new one — the merge write
@@ -399,6 +451,9 @@ def _merge(
         store_base = jnp.zeros_like(basec)
     shift_a = view_base - basec
     shift_b = store_base - basec
+    # what each sender's datagram contains: its MEMBER entries within the
+    # rebase window (post-tick status, actual senders this round)
+    elig = (status == MEMBER) & _rx(senders, nd)
     rel = hb32 - shift_a[None]
     gossiped = elig & (rel >= 0)
     vdtype = jnp.int8 if config.view_dtype == "int8" else jnp.int16
@@ -413,10 +468,19 @@ def _merge(
             age_clamp=AGE_CLAMP,
             block_r=config.merge_block_r,
             slots=config.merge_slots,
-            interpret=config.merge_kernel == "pallas_interpret",
+            interpret=config.merge_kernel.endswith("interpret"),
         )
         alive32 = alive.astype(jnp.int32)
-        if hb.ndim == 4:
+        if hb.ndim == 4 and config.merge_kernel.startswith("pallas_stripe"):
+            # VMEM-resident column stripes: the view crosses HBM once per
+            # round instead of F times (see stripe_merge_update_blocked)
+            stripe_kwargs = dict(kernel_kwargs)
+            del stripe_kwargs["slots"]
+            hb, age, status = merge_pallas.stripe_merge_update_blocked(
+                view, edges, hb, age, status, shift_a, shift_b, alive32,
+                **stripe_kwargs
+            )
+        elif hb.ndim == 4:
             # blocked layout (see module header): view/hb/age/status arrive
             # in the kernel-native 4-D shape, so the fused kernel runs with
             # no relayout at all
@@ -469,14 +533,15 @@ def _round_core(
     square or a subject-axis shard)."""
     n = state.n
     state = _apply_events(state, events, config, ctx)
-    state, fail, active = _tick(state, config, ctx)
+    active, refresher, colmax_est = _pre_tick(state, config, ctx)
+    state, fail = _tick(state, config, ctx, active=active, refresher=refresher)
     if config.topology == "ring":
         edges = topology.ring_edges_from_status(state.status.reshape(n, n))
     assert edges is not None
     # _merge also advances age for every entry not refreshed this round
     # (refreshes wrote 0, then everything ages by one, saturating at
     # AGE_CLAMP — beyond every protocol threshold, config.py)
-    state = _merge(state, edges, active, config)
+    state = _merge(state, edges, active, config, colmax_est)
     state = state._replace(round=state.round + 1)
 
     dead = ~state.alive
@@ -530,20 +595,28 @@ def _update_carry(
     n = state.n
     nd, shp = state.status.ndim, state.status.shape
     nloc = _nsubj(shp)
-    first_detect, converged = carry  # [nloc] — this shard's subject slice
+    first_detect, first_observer, converged = carry  # [nloc] — shard's slice
     # rejoined = joins that actually took effect: new incarnation, new clock
     rejoined_l = ctx.slice_cols(rejoined, nloc)
     first_detect = jnp.where(rejoined_l, -1, first_detect)
+    first_observer = jnp.where(rejoined_l, -1, first_observer)
     converged = jnp.where(rejoined_l, -1, converged)
 
     any_fail = jnp.any(fail, axis=0).reshape(nloc)
-    first_detect = jnp.where((first_detect < 0) & any_fail, round_idx, first_detect)
+    # argmax over the receiver axis = lowest observer index that fired
+    first_obs_now = jnp.argmax(fail, axis=0).astype(jnp.int32).reshape(nloc)
+    fresh = (first_detect < 0) & any_fail
+    first_observer = jnp.where(fresh, first_obs_now, first_observer)
+    first_detect = jnp.where(fresh, round_idx, first_detect)
 
     dropped = ~_rx(state.alive, nd) | _eye(n, shp, ctx) | (state.status != MEMBER)
     alive_l = ctx.slice_cols(state.alive, nloc)
     all_dropped = jnp.all(dropped, axis=0).reshape(nloc) & ~alive_l
     converged = jnp.where((converged < 0) & all_dropped, round_idx, converged)
-    return MetricsCarry(first_detect=first_detect, converged=converged)
+    return MetricsCarry(
+        first_detect=first_detect, first_observer=first_observer,
+        converged=converged,
+    )
 
 
 def _scan_rounds(
@@ -555,7 +628,7 @@ def _scan_rounds(
     rejoin_rate: float,
     churn_ok: jax.Array | None,
     ctx: ShardCtx,
-    snapshot=None,
+    mcarry0: MetricsCarry | None = None,
 ) -> tuple[SimState, MetricsCarry, RoundMetrics]:
     """The shared scan over rounds (state in its final layout already).
 
@@ -564,6 +637,11 @@ def _scan_rounds(
     shard_map, per-shard state).  Churn masks and edges derive from
     replicated inputs (alive, key), so every shard computes identical
     events — no cross-shard communication beyond ``ctx.psum``.
+
+    ``mcarry0`` seeds the metrics carry, so a horizon split into several
+    scans (e.g. the detector's chunked bulk advancement, which reads a
+    small membership view between chunks) accumulates first-detection /
+    convergence rounds exactly as one long scan would.
     """
     def step(carry, ev: RoundEvents):
         st, mc = carry
@@ -585,35 +663,11 @@ def _scan_rounds(
         # joins lost to a dead introducer don't reset metrics (slave.go:22 SPOF)
         rejoined = ev.join & ~alive_before & st.alive
         mc = _update_carry(mc, st, rejoined, fail, round_idx, ctx)
-        if snapshot is not None:
-            # async membership snapshot (utils/snapshot.py): stream the
-            # post-round view to the host every ``every`` rounds without
-            # interrupting the scan — the reader never touches in-flight
-            # device futures.  Host callbacks cannot cross this dev image's
-            # remote-PJRT tunnel (the callable lives on the wrong side); a
-            # directly-attached TPU runs them fine.
-            import os
-
-            if os.environ.get("JAX_PLATFORMS", "") == "axon":
-                raise RuntimeError(
-                    "snapshot streaming needs host callbacks, which hang "
-                    "over the axon TPU tunnel; run snapshots on CPU or on "
-                    "a directly-attached TPU"
-                )
-            buffer, every = snapshot
-            from jax.experimental import io_callback
-
-            def _emit(s=st):
-                io_callback(
-                    buffer.push, None, s.round, s.alive, s.status, ordered=True
-                )
-                return jnp.int32(0)
-
-            lax.cond(st.round % every == 0, _emit, lambda: jnp.int32(0))
         return (st, mc), metrics
 
-    init_carry = (state, MetricsCarry.init(_nsubj(state.hb.shape)))
-    (state, mcarry), per_round = lax.scan(step, init_carry, events)
+    if mcarry0 is None:
+        mcarry0 = MetricsCarry.init(_nsubj(state.hb.shape))
+    (state, mcarry), per_round = lax.scan(step, (state, mcarry0), events)
     return state, mcarry, per_round
 
 
@@ -626,7 +680,7 @@ def _run_rounds_impl(
     crash_rate: float = 0.0,
     rejoin_rate: float = 0.0,
     churn_ok: jax.Array | None = None,
-    snapshot=None,
+    mcarry0: MetricsCarry | None = None,
 ) -> tuple[SimState, MetricsCarry, RoundMetrics]:
     """Scan ``num_rounds`` gossip rounds.
 
@@ -636,10 +690,11 @@ def _run_rounds_impl(
     ``churn_ok``: optional bool [N] mask of nodes eligible for *random* churn
     — benchmark runs exclude their tracked crash victims so a random rejoin
     can't reset the tracked detection/convergence rounds mid-measurement.
-    ``snapshot``: optional ``(utils.snapshot.SnapshotBuffer, every)`` pair —
-    an in-scan host callback pushes the membership view to the buffer every
-    ``every`` rounds so other threads can read it while the device scans
-    (SURVEY §7.4's async boundary).
+    ``mcarry0``: optional carry from a previous scan, making a chunked
+    horizon bit-identical to one long scan (SURVEY §7.4's async boundary
+    is served by reading small views between chunks — see
+    ``detector.sim.SimDetector.advance_bulk`` — instead of in-scan host
+    callbacks, which cannot cross a remote-PJRT TPU tunnel).
     Returns final state, per-subject detection/convergence rounds, and
     per-round metrics stacked over the horizon.
 
@@ -659,14 +714,14 @@ def _run_rounds_impl(
         state = _to_blocked(state, config)
     state, mcarry, per_round = _scan_rounds(
         state, config, key, events, crash_rate, rejoin_rate, churn_ok, LOCAL_CTX,
-        snapshot=snapshot,
+        mcarry0=mcarry0,
     )
     if blocked:
         state = _from_blocked(state)
     return state, mcarry, per_round
 
 
-_RUN_ROUNDS_STATIC = ("config", "num_rounds", "crash_rate", "rejoin_rate", "snapshot")
+_RUN_ROUNDS_STATIC = ("config", "num_rounds", "crash_rate", "rejoin_rate")
 run_rounds = partial(jax.jit, static_argnames=_RUN_ROUNDS_STATIC)(_run_rounds_impl)
 # in-place variant: XLA reuses the input state's HBM for the output (the
 # caller's ``state`` is consumed).  At N=32k the scan needs ~13 GiB without

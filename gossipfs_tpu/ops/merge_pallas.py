@@ -478,6 +478,183 @@ def fused_merge_update_blocked(
     return tuple(out)
 
 
+def _stripe_kernel(
+    n: int, n_fanout: int, r_blk: int, member: int, unknown: int, age_clamp: int
+):
+    def kernel(
+        edges_ref, view_ref, hb_hbm, age_hbm, status_hbm, sa_ref, sb_ref,
+        hb_out, age_out, status_out,
+        stripe, best_scratch, hb_vmem, age_vmem, status_vmem, stripe_sem, row_sems,
+    ):
+        # Grid (nc, n // r_blk): column block j OUTER, receiver block i
+        # inner, so one stripe load serves every receiver block.
+        j = pl.program_id(0)
+        i = pl.program_id(1)
+
+        # stripe DMA: the whole view column block [N, cs, LANE] HBM -> VMEM,
+        # once per j (i == 0).  Every receiver's F-way gather then reads
+        # VMEM — total HBM traffic for the view drops from F x N^2 to N^2.
+        @pl.when(i == 0)
+        def _():
+            pltpu.make_async_copy(view_ref.at[:, j], stripe, stripe_sem).start()
+
+        row_copies = [
+            pltpu.make_async_copy(hb_hbm.at[i, :, j], hb_vmem, row_sems.at[0]),
+            pltpu.make_async_copy(age_hbm.at[i, :, j], age_vmem, row_sems.at[1]),
+            pltpu.make_async_copy(status_hbm.at[i, :, j], status_vmem, row_sems.at[2]),
+        ]
+        for c in row_copies:
+            c.start()
+
+        @pl.when(i == 0)
+        def _():
+            pltpu.make_async_copy(view_ref.at[:, j], stripe, stripe_sem).wait()
+
+        # Phase 1 — F-way max per receiver row, straight from the resident
+        # stripe (vector loads, no per-row DMA descriptors — the gather
+        # kernel's limiter).
+        def body(r, _):
+            acc = stripe[edges_ref[r, 0]].astype(jnp.int32)
+            for f in range(1, n_fanout):
+                acc = jnp.maximum(acc, stripe[edges_ref[r, f]].astype(jnp.int32))
+            best_scratch[r] = acc
+            return 0
+
+        lax.fori_loop(0, r_blk, body, 0, unroll=False)
+        for c in row_copies:
+            c.wait()
+
+        # Phase 2 — block-wide epilogue, identical to _fused_kernel's.
+        best_rel = best_scratch[...]
+        any_member = best_rel >= 0
+        hb = hb_vmem[...].astype(jnp.int32)
+        st = status_vmem[...].astype(jnp.int32)
+        age = age_vmem[...].astype(jnp.int32)
+        sa = sa_ref[0][None]
+        sb = sb_ref[0][None]
+        advance = any_member & (st == member) & (best_rel > hb - sa)
+        add = any_member & (st == unknown)
+        upd = advance | add
+        new_hb = jnp.where(upd, best_rel + (sa - sb), hb - sb)
+        if hb_out.dtype != jnp.int32:
+            info = jnp.iinfo(hb_out.dtype)
+            new_hb = jnp.clip(new_hb, info.min, info.max)
+        hb_out[:, 0] = new_hb.astype(hb_out.dtype)
+        new_age = jnp.minimum(jnp.where(upd, 0, age) + 1, age_clamp)
+        age_out[:, 0] = new_age.astype(age_out.dtype)
+        status_out[:, 0] = jnp.where(add, member, st).astype(status_out.dtype)
+
+    return kernel
+
+
+# The stripe kernel holds one full view column block [N, cs, LANE] resident
+# in VMEM.  int8's native tile is (32, 128), so cs must be a multiple of 32
+# (else Mosaic pads each leading index to a full tile, 4x-ing the stripe);
+# the v5e's 128 MB VMEM then bounds N x 4096 bytes — N <= 16,384 with
+# headroom for the receiver-lane blocks.  Bigger problems use the gather
+# kernel.
+STRIPE_BLOCK_C = 4096
+STRIPE_MAX_BYTES = 72 * 1024 * 1024
+
+
+def stripe_supported(n: int, fanout: int, n_cols: int | None = None) -> bool:
+    if n_cols is None:
+        n_cols = n
+    return (
+        supported(n, fanout, n_cols)
+        and n_cols % STRIPE_BLOCK_C == 0
+        and n * STRIPE_BLOCK_C <= STRIPE_MAX_BYTES
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("member", "unknown", "age_clamp", "block_r", "interpret"),
+)
+def stripe_merge_update_blocked(
+    view: jax.Array,
+    edges: jax.Array,
+    hb: jax.Array,
+    age: jax.Array,
+    status: jax.Array,
+    shift_a: jax.Array,
+    shift_b: jax.Array,
+    alive: jax.Array,
+    *,
+    member: int,
+    unknown: int,
+    age_clamp: int,
+    block_r: int = _FUSED_BLOCK_R,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Gossip merge + membership update + age advance, stripe-resident.
+
+    Same contract as :func:`fused_merge_update_blocked` (int8 view in the
+    ``STRIPE_BLOCK_C`` blocked layout), different memory strategy: instead
+    of per-receiver-row DMA gathers (F x N^2 HBM bytes, bound by DMA
+    descriptor issue), each view column block is loaded into VMEM once and
+    the F-way max reads it with vector loads — HBM view traffic drops F-fold
+    and the descriptor count drops from F x N per round to ~nc.
+    """
+    n, nc, cs, _ = view.shape
+    fanout = edges.shape[1]
+    if not stripe_supported(n, fanout, nc * cs * LANE):
+        raise ValueError(
+            f"stripe merge needs lane-aligned N, cs*LANE == {STRIPE_BLOCK_C} "
+            f"and N*{STRIPE_BLOCK_C} <= {STRIPE_MAX_BYTES} B of VMEM "
+            f"(N={n}, blocked cols={cs * LANE}); use the gather kernel"
+        )
+    r_blk = max(min(block_r, n), _FUSED_BLOCK_R_MIN)
+    while n % r_blk:
+        r_blk //= 2
+
+    # dead receivers merge nothing: remap their edges to self (their own view
+    # row is all -1), as in the gather kernel
+    self_idx = jnp.arange(n, dtype=edges.dtype)[:, None]
+    edges = jnp.where((alive != 0)[:, None], edges, self_idx)
+
+    row_spec = lambda j, i: (i, j, 0, 0)  # noqa: E731
+    lane_blk = lambda dt: pl.BlockSpec(  # noqa: E731
+        (r_blk, 1, cs, LANE), row_spec, memory_space=pltpu.VMEM
+    )
+    hb5 = hb.reshape(n // r_blk, r_blk, nc, cs, LANE)
+    age5 = age.reshape(n // r_blk, r_blk, nc, cs, LANE)
+    status5 = status.reshape(n // r_blk, r_blk, nc, cs, LANE)
+    out = pl.pallas_call(
+        _stripe_kernel(n, fanout, r_blk, member, unknown, age_clamp),
+        grid=(nc, n // r_blk),
+        in_specs=[
+            pl.BlockSpec(
+                (r_blk, fanout), lambda j, i: (i, 0), memory_space=pltpu.SMEM
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((1, cs, LANE), lambda j, i: (j, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, cs, LANE), lambda j, i: (j, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[lane_blk(hb.dtype), lane_blk(age.dtype), lane_blk(status.dtype)],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, nc, cs, LANE), hb.dtype),
+            jax.ShapeDtypeStruct((n, nc, cs, LANE), age.dtype),
+            jax.ShapeDtypeStruct((n, nc, cs, LANE), status.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((n, cs, LANE), view.dtype),
+            pltpu.VMEM((r_blk, cs, LANE), jnp.int32),
+            pltpu.VMEM((r_blk, cs, LANE), hb.dtype),
+            pltpu.VMEM((r_blk, cs, LANE), age.dtype),
+            pltpu.VMEM((r_blk, cs, LANE), status.dtype),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA((3,)),
+        ],
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=110 * 1024 * 1024),
+        interpret=interpret,
+    )(edges, view, hb5, age5, status5, shift_a, shift_b)
+    return tuple(out)
+
+
 def fanout_max_merge_xla(view: jax.Array, edges: jax.Array) -> jax.Array:
     """Reference XLA formulation of the same op (gather + running max).
 

@@ -89,3 +89,73 @@ def test_full_round_equivalence_xla_vs_pallas():
     assert jnp.array_equal(cx.converged, cp.converged)
     assert jnp.array_equal(px.true_detections, pp.true_detections)
     assert jnp.array_equal(px.false_positives, pp.false_positives)
+
+
+def test_stripe_kernel_matches_oracle():
+    """The VMEM-stripe kernel == XLA formulation, through the full epilogue.
+
+    Exercised via the public entry (stripe_merge_update_blocked) against
+    fused_merge_update_blocked, which the other tests pin to the XLA path.
+    """
+    from gossipfs_tpu.config import AGE_CLAMP
+    from gossipfs_tpu.core.state import MEMBER, UNKNOWN
+    from gossipfs_tpu.ops.merge_pallas import (
+        STRIPE_BLOCK_C,
+        blocked_shape,
+        fused_merge_update_blocked,
+        stripe_merge_update_blocked,
+    )
+
+    n, fanout = 4096, 6
+    key = jax.random.PRNGKey(11)
+    ks = jax.random.split(key, 7)
+    shp = blocked_shape(n, STRIPE_BLOCK_C)
+    view = jax.random.randint(ks[0], (n, n), -1, 100, jnp.int32).astype(jnp.int8)
+    edges = jax.random.randint(ks[1], (n, fanout), 0, n, jnp.int32)
+    hb = jax.random.randint(ks[2], (n, n), 0, 120, jnp.int32).astype(jnp.int16)
+    age = jax.random.randint(ks[3], (n, n), 0, 30, jnp.int32).astype(jnp.int8)
+    status = jax.random.randint(ks[4], (n, n), 0, 3, jnp.int32).astype(jnp.int8)
+    shift_a = jax.random.randint(ks[5], (n,), 0, 5, jnp.int32)
+    shift_b = jnp.zeros((n,), jnp.int32)
+    alive = (jax.random.uniform(ks[6], (n,)) > 0.1).astype(jnp.int32)
+    args = (
+        view.reshape(shp), edges, hb.reshape(shp), age.reshape(shp),
+        status.reshape(shp), shift_a.reshape(shp[1:]),
+        shift_b.reshape(shp[1:]), alive,
+    )
+    kw = dict(member=int(MEMBER), unknown=int(UNKNOWN), age_clamp=AGE_CLAMP,
+              interpret=True)
+    want = fused_merge_update_blocked(*args, **kw)
+    got = stripe_merge_update_blocked(*args, **kw)
+    for g, w, name in zip(got, want, ("hb", "age", "status")):
+        assert jnp.array_equal(g, w), name
+
+
+def test_full_round_equivalence_xla_vs_stripe():
+    """run_rounds with merge_kernel=pallas_stripe_interpret reproduces the
+    XLA scan bit-for-bit at a stripe-eligible size."""
+    base = SimConfig(
+        n=4096,
+        topology="random",
+        fanout=6,
+        remove_broadcast=False,
+        fresh_cooldown=True,
+        view_dtype="int8",
+        merge_block_c=4096,
+    )
+    key = jax.random.PRNGKey(3)
+    out = {}
+    for kernel in ("xla", "pallas_stripe_interpret"):
+        cfg = dataclasses.replace(base, merge_kernel=kernel)
+        final, carry, per_round = run_rounds(
+            init_state(cfg), cfg, 6, key, crash_rate=0.01
+        )
+        out[kernel] = (final, carry, per_round)
+    fx, cx, px = out["xla"]
+    fp, cp, pp = out["pallas_stripe_interpret"]
+    assert jnp.array_equal(fx.hb, fp.hb)
+    assert jnp.array_equal(fx.age, fp.age)
+    assert jnp.array_equal(fx.status, fp.status)
+    assert jnp.array_equal(cx.first_detect, cp.first_detect)
+    assert jnp.array_equal(cx.first_observer, cp.first_observer)
+    assert jnp.array_equal(px.true_detections, pp.true_detections)
