@@ -66,13 +66,13 @@ def main() -> None:
         remove_broadcast=False,
         fresh_cooldown=True,
         t_cooldown=12,
-        # the pallas DMA merge kernel (ops/merge_pallas.py) runs the hot op
-        # at the HBM ceiling (~4x XLA's gather); CPU keeps the XLA path
-        merge_kernel="pallas" if use_tpu else "xla",
-        # int8 rebased view + full-row DMA blocks: 16.3 -> 9.0 ms/round on
-        # the merge at N=16k (see BASELINE.md)
+        # the pallas stripe merge kernel (ops/merge_pallas.py) keeps each
+        # view column block resident in VMEM, so the view crosses HBM once
+        # per round instead of F times; CPU keeps the XLA path
+        merge_kernel="pallas_stripe" if use_tpu else "xla",
+        # int8 rebased view (required by the stripe kernel's VMEM budget)
         view_dtype="int8",
-        merge_block_c=16_384,
+        merge_block_c=4_096 if use_tpu else 16_384,
         # int16 hb storage (counters relative to hb_base, renormalized by the
         # merge write) halves the fattest lane's HBM traffic
         hb_dtype="int16",

@@ -38,12 +38,16 @@ CRASH_AT = 10
 TRACK = 8
 
 
-def sweep(ns=DEFAULT_NS, rounds=ROUNDS, crash_rate=0.01, seed=0) -> dict:
+def sweep(ns=DEFAULT_NS, rounds=ROUNDS, crash_rate=0.01, seed=0,
+          topology="random") -> dict:
+    """``topology`` sweeps "random" (iid fanout) or "random_arc" (windowed
+    arc senders) — the arc rows must match the iid rows within noise, which
+    is the protocol-equivalence evidence for the fast arc merge kernel."""
     rows = []
     for n in ns:
         cfg = SimConfig(
             n=n,
-            topology="random",
+            topology=topology,
             fanout=SimConfig.log_fanout(n),
             remove_broadcast=False,
             fresh_cooldown=True,
@@ -78,7 +82,7 @@ def sweep(ns=DEFAULT_NS, rounds=ROUNDS, crash_rate=0.01, seed=0) -> dict:
         )
     return {
         "metric": "time-to-detect & FPR vs N (rounds; 1 round == 1 s reference time)",
-        "protocol": "random fanout=log2(N), gossip-only dissemination, t_fail=5",
+        "protocol": f"{topology} fanout=log2(N), gossip-only dissemination, t_fail=5",
         "crash_churn": crash_rate,
         "rows": rows,
     }
@@ -130,6 +134,8 @@ def main(argv=None) -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--ns", type=int, nargs="+", default=list(DEFAULT_NS))
     p.add_argument("--rounds", type=int, default=ROUNDS)
+    p.add_argument("--topology", choices=["random", "random_arc"],
+                   default="random")
     p.add_argument("--t-fail-sweep", action="store_true",
                    help="sweep t_fail at fixed N instead of N")
     p.add_argument("--out", type=str, default=None)
@@ -137,7 +143,8 @@ def main(argv=None) -> None:
     if args.t_fail_sweep:
         doc = json.dumps(sweep_t_fail(rounds=args.rounds))
     else:
-        doc = json.dumps(sweep(ns=tuple(args.ns), rounds=args.rounds))
+        doc = json.dumps(sweep(ns=tuple(args.ns), rounds=args.rounds,
+                               topology=args.topology))
     print(doc)
     if args.out:
         with open(args.out, "w") as f:

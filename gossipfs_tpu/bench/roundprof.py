@@ -49,6 +49,10 @@ def variants(n: int) -> dict[str, SimConfig]:
         out["pallas_stripe"] = dataclasses.replace(
             cfg, merge_kernel="pallas_stripe", merge_block_c=STRIPE_BLOCK_C
         )
+        out["arc_stripe"] = dataclasses.replace(
+            cfg, topology="random_arc", merge_kernel="pallas_stripe",
+            merge_block_c=STRIPE_BLOCK_C,
+        )
     return out
 
 

@@ -19,7 +19,7 @@ import dataclasses
 import math
 from typing import Literal
 
-Topology = Literal["ring", "random"]
+Topology = Literal["ring", "random", "random_arc"]
 
 # The ``age`` lane is stored as int8 and saturates here: every protocol
 # comparison is against a small threshold (t_fail, t_cooldown), so any age

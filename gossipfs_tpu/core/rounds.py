@@ -199,17 +199,24 @@ class MetricsCarry(NamedTuple):
 
 
 def _apply_events(
-    state: SimState, events: RoundEvents, config: SimConfig, ctx: ShardCtx = LOCAL_CTX
+    state: SimState,
+    events: RoundEvents,
+    config: SimConfig,
+    ctx: ShardCtx = LOCAL_CTX,
+    matrix_events: bool = True,
 ) -> SimState:
     """Crash / leave / join, before the heartbeat tick (see module docstring).
 
-    All-false event masks flow through as plain masked passes: XLA fuses
-    them into the neighbouring elementwise chains nearly for free, and
-    measuring ``lax.cond``-guarded variants showed the branch overhead +
-    lost fusion costs ~8% of round time at N=16k — skip-if-empty does not
-    pay here.
+    ``matrix_events`` is a *static* flag: scans that provably schedule no
+    leave/join events (``run_rounds`` with events=None and rejoin_rate=0 —
+    the headline benchmark's crash-only fault model) drop the leave/join
+    rewrites (~10 elementwise ops x N^2 per round) at trace time.  Inside
+    ``lax.scan`` the per-round masks are tracers even when the stacked
+    array is a constant, so XLA cannot fold them on its own.
     """
     hb, age, status, alive = state.hb, state.age, state.status, state.alive
+    if not matrix_events:
+        return state._replace(alive=alive & ~(events.crash | events.leave))
     n, nd, shp = state.n, hb.ndim, hb.shape
     # the stored encoding of "true heartbeat 0" (see SimState.hb_base):
     # 0 - base per subject, saturating; identically 0 in int32 mode
@@ -300,9 +307,22 @@ def _pre_tick(
 
     basec = state.hb_base.reshape(shp[1:])  # subject-shaped; zero in int32 mode
     elig = _rx(alive, nd) & (status == MEMBER)
-    hb32 = hb.astype(jnp.int32)
-    # true colmax over eligible copies ('true hb 0' filler via -basec), +1
-    colmax_est = jnp.max(jnp.where(elig, hb32, -basec[None]), axis=0) + basec + 1
+    # true colmax over eligible copies ('true hb 0' filler via -basec), +1.
+    # int16 mode reduces in the stored dtype (XLA packs narrow-int
+    # elementwise/reduce ops 2-4x denser than int32 — the round is
+    # ALU-bound): the filler clips at the int16 floor, which can only
+    # matter for a subject with NO eligible copy and basec > 32768, where
+    # nothing downstream observes the difference (no sender gossips such a
+    # subject, so every consumer of its shifts sees masked lanes only).
+    if hb.dtype == jnp.int16:
+        filler = jnp.clip(-basec, -32768, 32767).astype(jnp.int16)
+        cm = jnp.max(jnp.where(elig, hb, filler[None]), axis=0)
+        colmax_est = cm.astype(jnp.int32) + basec + 1
+    else:
+        colmax_est = (
+            jnp.max(jnp.where(elig, hb.astype(jnp.int32), -basec[None]), axis=0)
+            + basec + 1
+        )
     return active, refresher, colmax_est
 
 
@@ -349,9 +369,16 @@ def _tick(
     # have unknown true counters and are excluded (the zombie-rejoin
     # corner, same class as the view-rebase clamp in _merge)
     basec = state.hb_base.reshape(shp[1:])[None]
-    past_grace = hb.astype(jnp.int32) > (config.hb_grace - basec)
     if hb.dtype == jnp.int16:
-        past_grace &= hb != jnp.iinfo(jnp.int16).min
+        # narrow compare (packed 2x): hb > thr  <=>  hb >= thr+1, with the
+        # int32 threshold clipped into int16 — a threshold below the int16
+        # floor admits every lane, exactly like the int32 compare
+        thr = jnp.clip(config.hb_grace - basec + 1, -32768, 32767).astype(
+            jnp.int16
+        )
+        past_grace = (hb >= thr) & (hb != jnp.iinfo(jnp.int16).min)
+    else:
+        past_grace = hb > (config.hb_grace - basec)
     fail = (
         _rx(active, nd)
         & (status == MEMBER)
@@ -409,6 +436,10 @@ def _merge(
 
     from gossipfs_tpu.ops import merge_pallas
 
+    # random_arc passes arc BASES [N]; everything else explicit edges [N, F]
+    arc = config.topology == "random_arc"
+    fanout = config.fanout if arc else edges.shape[1]
+
     # The gossip view: what a sender's datagram contains for each subject
     # (absent entries as -1 — heartbeats are never negative).  Heartbeat
     # counts are rebased per subject so the view fits a narrow dtype
@@ -431,7 +462,6 @@ def _merge(
     nd = hb.ndim
     hb16 = hb.dtype == jnp.int16
     basec = state.hb_base.reshape(hb.shape[1:])  # subject-shaped, all-zero in int32 mode
-    hb32 = hb.astype(jnp.int32)
     colmax = colmax_est
     view_base = jnp.maximum(colmax - config.rebase_window, 0)
     # A: shift from stored to view encoding (== view_base in int32 mode).
@@ -454,14 +484,43 @@ def _merge(
     # what each sender's datagram contains: its MEMBER entries within the
     # rebase window (post-tick status, actual senders this round)
     elig = (status == MEMBER) & _rx(senders, nd)
-    rel = hb32 - shift_a[None]
-    gossiped = elig & (rel >= 0)
     vdtype = jnp.int8 if config.view_dtype == "int8" else jnp.int16
-    view = jnp.where(gossiped, rel, -1).astype(vdtype)
+    if hb16:
+        # Narrow (packed) arithmetic: int16 ops run 2x denser than int32
+        # on the VPU and the round is ALU-bound.  Mod-2^16 adds/subs are
+        # exact whenever the true int32 result is in range; out-of-range
+        # cases are handled by comparisons against int32 thresholds clipped
+        # into int16 (a clipped threshold admits all / none exactly like
+        # the unclipped int32 compare would).  Invariants keeping true
+        # results in range: gossiped lanes have rel in [0, rebase_window]
+        # (window invariant), and shift_a <= ~REBASE_WINDOW + slack.
+        sa16 = shift_a.astype(jnp.int16)
+        # shift_a below int16 range => every stored value >= it
+        sa_all = (shift_a < -32768).reshape(hb.shape[1:])[None]
+        gossiped = elig & ((hb >= sa16[None]) | sa_all)
+        rel = hb - sa16[None]  # exact on gossiped lanes; masked elsewhere
+        view = jnp.where(gossiped, rel, jnp.int16(-1)).astype(vdtype)
+    else:
+        rel = hb.astype(jnp.int32) - shift_a[None]
+        gossiped = elig & (rel >= 0)
+        view = jnp.where(gossiped, rel, -1).astype(vdtype)
     # Both paths include the post-merge global age advance (everything not
     # refreshed this round ages by one, saturating at AGE_CLAMP) so the
     # fused kernel can write each [N, N] lane exactly once.
-    if _use_pallas(config, edges.shape[1], state.n, _nsubj(hb.shape)):
+    use_pallas = _use_pallas(config, fanout, state.n, _nsubj(hb.shape))
+    stripe_kernel = config.merge_kernel.startswith("pallas_stripe")
+    best_rel = None  # set on the paths that share the XLA membership update
+    if use_pallas and hb.ndim == 4 and arc and stripe_kernel:
+        # arc topology: the kernel does only the memory-hard part (windowed
+        # row-max over the resident stripe + ONE narrow gather per
+        # receiver); the membership update below rides XLA fusion, which
+        # runs the widened elementwise arithmetic at streaming efficiency —
+        # measured faster than a hand-written in-kernel epilogue
+        best_rel = merge_pallas.arc_window_max_blocked(
+            view, edges, fanout=fanout, block_r=config.merge_block_r,
+            interpret=config.merge_kernel.endswith("interpret"),
+        )
+    elif use_pallas:
         kernel_kwargs = dict(
             member=int(MEMBER),
             unknown=int(UNKNOWN),
@@ -471,7 +530,10 @@ def _merge(
             interpret=config.merge_kernel.endswith("interpret"),
         )
         alive32 = alive.astype(jnp.int32)
-        if hb.ndim == 4 and config.merge_kernel.startswith("pallas_stripe"):
+        if arc:
+            # the fused gather kernels take explicit edges
+            edges = topology.arc_edges(edges, fanout)
+        if hb.ndim == 4 and stripe_kernel:
             # VMEM-resident column stripes: the view crosses HBM once per
             # round instead of F times (see stripe_merge_update_blocked)
             stripe_kwargs = dict(kernel_kwargs)
@@ -497,22 +559,68 @@ def _merge(
             )
     else:
         # XLA gather path: also the fallback for unsupported shapes/backends
+        if arc:
+            edges = topology.arc_edges(edges, fanout)
         best_rel = merge_pallas.fanout_max_merge_xla(view, edges)
+    if best_rel is not None:
+        # shared XLA membership update (MergeMemberList semantics)
         any_member = best_rel >= 0
-        best32 = best_rel.astype(jnp.int32)
-
         recv = _rx(alive, nd)
-        # max-merge + stamp: best_true > hb_true, both sides shifted into
-        # the stored encoding (int32 mode: best32 + view_base > hb, as ever)
-        advance = (
-            recv & (status == MEMBER) & any_member
-            & (best32 > hb32 - shift_a[None])
-        )
         add = recv & (status == UNKNOWN) & any_member          # learn new member
-        upd = advance | add
-        new32 = jnp.where(upd, best32 + (shift_a - shift_b)[None], hb32 - shift_b[None])
-        info = jnp.iinfo(hb.dtype)
-        hb = jnp.clip(new32, info.min, info.max).astype(hb.dtype)
+        if hb16:
+            # narrow-arithmetic epilogue, bit-identical to the int32+clip
+            # formulation below (see the mod/threshold argument in the view
+            # build).  vmax = top of the view dtype; all int32 threshold
+            # vectors are per-subject (cheap [N] math).
+            vmax = jnp.iinfo(vdtype).max
+            sb32 = shift_b
+            d32 = shift_a - shift_b
+            sa16 = shift_a.astype(jnp.int16)
+            best16 = best_rel.astype(jnp.int16)
+            # advance: best + shift_a > hb over true int32 values.  Top
+            # side cannot overflow (best <= vmax, shift_a <= window +
+            # slack; for the int16 view both windows coincide so shift_a
+            # is tiny).  Bottom side: best + shift_a < -32768 means the
+            # compare is false — mask via a clipped per-subject threshold.
+            cmp_deep = jnp.clip(-32769 - shift_a, -2, vmax).astype(vdtype)
+            lhs = best16 + sa16[None]
+            advance = (
+                recv & (status == MEMBER) & any_member
+                & (best_rel > cmp_deep.reshape(hb.shape[1:])[None])
+                & (lhs > hb)
+            )
+            upd = advance | add
+            # updated value best + (shift_a - shift_b): saturates at the
+            # int16 floor when the true value underflows (clip semantics)
+            up_deep = jnp.clip(-32769 - d32, -2, vmax).astype(vdtype)
+            up_sat = best_rel <= up_deep.reshape(hb.shape[1:])[None]
+            up_val = jnp.where(
+                up_sat, jnp.int16(-32768), best16 + d32.astype(jnp.int16)[None]
+            )
+            # kept value hb - shift_b (shift_b >= 0: base is monotone):
+            # saturates when hb - shift_b < -32768, i.e. hb <= sb - 32769
+            keep_thr = jnp.clip(sb32 - 32769, -32768, 32767).astype(jnp.int16)
+            keep_val = jnp.where(
+                hb <= keep_thr.reshape(hb.shape[1:])[None],
+                jnp.int16(-32768),
+                hb - sb32.astype(jnp.int16)[None],
+            )
+            hb = jnp.where(upd, up_val, keep_val)
+        else:
+            hb32 = hb.astype(jnp.int32)
+            best32 = best_rel.astype(jnp.int32)
+            # max-merge + stamp: best_true > hb_true, both sides shifted
+            # into the stored encoding (best32 + view_base > hb, as ever)
+            advance = (
+                recv & (status == MEMBER) & any_member
+                & (best32 > hb32 - shift_a[None])
+            )
+            upd = advance | add
+            new32 = jnp.where(
+                upd, best32 + (shift_a - shift_b)[None], hb32 - shift_b[None]
+            )
+            info = jnp.iinfo(hb.dtype)
+            hb = jnp.clip(new32, info.min, info.max).astype(hb.dtype)
         age = jnp.where(upd, 0, age)
         status = jnp.where(add, MEMBER, status)
         age = jnp.minimum(age + 1, AGE_CLAMP).astype(jnp.int8)
@@ -528,11 +636,14 @@ def _round_core(
     edges: jax.Array | None,
     config: SimConfig,
     ctx: ShardCtx = LOCAL_CTX,
-) -> tuple[SimState, RoundMetrics, jax.Array]:
+    matrix_events: bool = True,
+) -> tuple[SimState, RoundMetrics, jax.Array, jax.Array, jax.Array]:
     """One round, layout- and shard-generic (state may be 2-D or blocked,
-    square or a subject-axis shard)."""
+    square or a subject-axis shard).
+
+    Returns (state, metrics, fail, any_fail [nloc], first_obs [nloc])."""
     n = state.n
-    state = _apply_events(state, events, config, ctx)
+    state = _apply_events(state, events, config, ctx, matrix_events=matrix_events)
     active, refresher, colmax_est = _pre_tick(state, config, ctx)
     state, fail = _tick(state, config, ctx, active=active, refresher=refresher)
     if config.topology == "ring":
@@ -544,17 +655,21 @@ def _round_core(
     state = _merge(state, edges, active, config, colmax_est)
     state = state._replace(round=state.round + 1)
 
-    dead = ~state.alive
+    # every fail-matrix statistic reduces over the SAME axis (receivers),
+    # so XLA runs one column-reduce pass instead of several full-matrix
+    # ones: per-subject detector counts + lowest firing observer, then
+    # vector math for the scalar metrics
+    nloc = _nsubj(fail.shape)
+    n_det = jnp.sum(fail, axis=0, dtype=jnp.int32).reshape(nloc)
+    first_obs_now = jnp.argmax(fail, axis=0).astype(jnp.int32).reshape(nloc)
+    dead_l = ctx.slice_cols(~state.alive, nloc)
+    alive_l = ctx.slice_cols(state.alive, nloc)
     metrics = RoundMetrics(
-        true_detections=ctx.psum(
-            jnp.sum(fail & _sj(dead, fail.shape, ctx), dtype=jnp.int32)
-        ),
-        false_positives=ctx.psum(
-            jnp.sum(fail & _sj(state.alive, fail.shape, ctx), dtype=jnp.int32)
-        ),
+        true_detections=ctx.psum(jnp.sum(jnp.where(dead_l, n_det, 0))),
+        false_positives=ctx.psum(jnp.sum(jnp.where(alive_l, n_det, 0))),
         n_alive=jnp.sum(state.alive, dtype=jnp.int32),
     )
-    return state, metrics, fail
+    return state, metrics, fail, n_det > 0, first_obs_now
 
 
 @partial(jax.jit, static_argnames=("config",))
@@ -578,7 +693,7 @@ def gossip_round(
     blocked = _use_blocked(config, config.fanout, n)
     if blocked:
         state = _to_blocked(state, config)
-    state, metrics, fail = _round_core(state, events, edges, config)
+    state, metrics, fail, _, _ = _round_core(state, events, edges, config)
     if blocked:
         state = _from_blocked(state)
     return state, metrics, fail.reshape(n, n)
@@ -588,7 +703,8 @@ def _update_carry(
     carry: MetricsCarry,
     state: SimState,
     rejoined: jax.Array,
-    fail: jax.Array,
+    any_fail: jax.Array,
+    first_obs_now: jax.Array,
     round_idx: jax.Array,
     ctx: ShardCtx = LOCAL_CTX,
 ) -> MetricsCarry:
@@ -602,9 +718,6 @@ def _update_carry(
     first_observer = jnp.where(rejoined_l, -1, first_observer)
     converged = jnp.where(rejoined_l, -1, converged)
 
-    any_fail = jnp.any(fail, axis=0).reshape(nloc)
-    # argmax over the receiver axis = lowest observer index that fired
-    first_obs_now = jnp.argmax(fail, axis=0).astype(jnp.int32).reshape(nloc)
     fresh = (first_detect < 0) & any_fail
     first_observer = jnp.where(fresh, first_obs_now, first_observer)
     first_detect = jnp.where(fresh, round_idx, first_detect)
@@ -629,6 +742,7 @@ def _scan_rounds(
     churn_ok: jax.Array | None,
     ctx: ShardCtx,
     mcarry0: MetricsCarry | None = None,
+    matrix_events: bool = True,
 ) -> tuple[SimState, MetricsCarry, RoundMetrics]:
     """The shared scan over rounds (state in its final layout already).
 
@@ -651,18 +765,31 @@ def _scan_rounds(
             crash, join = topology.churn_masks(k_churn, st.alive, crash_rate, rejoin_rate)
             if churn_ok is not None:
                 crash, join = crash & churn_ok, join & churn_ok
-            ev = RoundEvents(crash=ev.crash | crash, leave=ev.leave, join=ev.join | join)
-        edges = (
-            None
-            if config.topology == "ring"
-            else topology.random_in_edges(k_edge, config.n, config.fanout)
-        )
+            # rejoin_rate is static: with no random rejoins, keep ev.join
+            # as-is instead of OR-ing in a dynamically-false mask — if the
+            # scheduled joins are trace-time-constant zeros (crash-only
+            # runs), XLA then folds the whole join chain out of the round
+            if rejoin_rate > 0.0:
+                ev = RoundEvents(crash=ev.crash | crash, leave=ev.leave,
+                                 join=ev.join | join)
+            else:
+                ev = RoundEvents(crash=ev.crash | crash, leave=ev.leave,
+                                 join=ev.join)
+        if config.topology == "ring":
+            edges = None  # derived per-round from the membership tables
+        else:
+            edges = topology.in_edges(config, k_edge, None)
         round_idx = st.round
         alive_before = st.alive
-        st, metrics, fail = _round_core(st, ev, edges, config, ctx)
+        st, metrics, _fail, any_fail, first_obs = _round_core(
+            st, ev, edges, config, ctx, matrix_events=matrix_events
+        )
         # joins lost to a dead introducer don't reset metrics (slave.go:22 SPOF)
-        rejoined = ev.join & ~alive_before & st.alive
-        mc = _update_carry(mc, st, rejoined, fail, round_idx, ctx)
+        if matrix_events:
+            rejoined = ev.join & ~alive_before & st.alive
+        else:
+            rejoined = jnp.zeros_like(st.alive)  # constant: resets fold away
+        mc = _update_carry(mc, st, rejoined, any_fail, first_obs, round_idx, ctx)
         return (st, mc), metrics
 
     if mcarry0 is None:
@@ -704,6 +831,9 @@ def _run_rounds_impl(
     around it; the XLA merge path partitions cleanly either way.
     """
     n = config.n
+    # static: no scheduled events + no random rejoins => the leave/join
+    # matrix rewrites drop out of the compiled round entirely
+    matrix_events = events is not None or rejoin_rate > 0.0
     if events is None:
         zeros = jnp.zeros((num_rounds, n), dtype=bool)
         events = RoundEvents(crash=zeros, leave=zeros, join=zeros)
@@ -714,7 +844,7 @@ def _run_rounds_impl(
         state = _to_blocked(state, config)
     state, mcarry, per_round = _scan_rounds(
         state, config, key, events, crash_rate, rejoin_rate, churn_ok, LOCAL_CTX,
-        mcarry0=mcarry0,
+        mcarry0=mcarry0, matrix_events=matrix_events,
     )
     if blocked:
         state = _from_blocked(state)

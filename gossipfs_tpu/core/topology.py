@@ -74,10 +74,44 @@ def random_in_edges(key: jax.Array, n: int, fanout: int) -> jax.Array:
     return draw + (draw >= self_idx).astype(jnp.int32)
 
 
+def random_arc_bases(key: jax.Array, n: int, fanout: int) -> jax.Array:
+    """int32 [N] — start of each receiver's arc of F *consecutive* senders.
+
+    The ``random_arc`` topology replaces F independent uniform draws with one
+    uniform draw of an arc start: receiver i merges rows
+    ``{(b_i + k) % N, k < F}``.  Arc positions are uniform over the n-F
+    starts whose window excludes i (mirroring ``random_in_edges``'s
+    never-self), so the probability an arc hits any fixed set S is
+    ``~1-(1-|S|/N)^F`` — the same first-order epidemic coverage as F iid
+    picks, re-randomized every round (bench/curves.py verifies TTD/FPR
+    match).  What the structure buys: the F-way random row gather — the
+    round's dominant cost — becomes one windowed row-max (computable in
+    O(log F) passes, independent of F) plus a single 1-way gather
+    (ops/merge_pallas.py ``arc_window_max_blocked``).
+    """
+    draw = jax.random.randint(key, (n,), 0, n - fanout, dtype=jnp.int32)
+    return (jnp.arange(n, dtype=jnp.int32) + 1 + draw) % n
+
+
+def arc_edges(bases: jax.Array, fanout: int) -> jax.Array:
+    """Expand arc bases to explicit [N, F] in-edges (oracle / XLA path)."""
+    n = bases.shape[0]
+    offs = jnp.arange(fanout, dtype=jnp.int32)[None, :]
+    return (bases[:, None] + offs) % n
+
+
 def in_edges(config: SimConfig, key: jax.Array, status: jax.Array) -> jax.Array:
-    """Per-round in-edges for the configured topology (ring needs ``status``)."""
+    """Per-round in-edges in the form the round kernel consumes.
+
+    ring needs ``status``; ``random_arc`` yields arc BASES [N] (what
+    ``gossip_round``/``_merge`` take for that topology — expand with
+    :func:`arc_edges` for consumers needing explicit [N, F] edges);
+    ``random`` yields explicit [N, F] edges.
+    """
     if config.topology == "ring":
         return ring_edges_from_status(status)
+    if config.topology == "random_arc":
+        return random_arc_bases(key, config.n, config.fanout)
     return random_in_edges(key, config.n, config.fanout)
 
 

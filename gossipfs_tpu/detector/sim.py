@@ -100,11 +100,11 @@ class SimDetector:
             self._pending_join.clear()
             k = jax.random.fold_in(self._key, int(self.state.round))
             if self.config.topology == "ring":
-                edges = None
+                edges = None  # derived in-round from the membership tables
             else:
-                from gossipfs_tpu.core.topology import random_in_edges
+                from gossipfs_tpu.core import topology
 
-                edges = random_in_edges(k, n, self.config.fanout)
+                edges = topology.in_edges(self.config, k, None)
             round_idx = int(self.state.round)
             self.state, _, fail = gossip_round(self.state, ev, edges, self.config)
             if not bool(jnp.any(fail)):

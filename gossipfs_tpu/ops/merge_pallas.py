@@ -655,6 +655,157 @@ def stripe_merge_update_blocked(
     return tuple(out)
 
 
+# rows per in-VMEM window-max chunk (arc kernel): each ping-pong buffer is
+# (ARC_CHUNK + F - 1, cs, LANE) bfloat16 — ~8.5 MB at cs=32.  bf16 because
+# v5e Mosaic has no narrow-int vector max (arith.maxsi on i8 fails to
+# legalize); bf16 max is native and exact for the int8 view range.
+ARC_CHUNK = 1024
+
+
+def _arc_window_kernel(n: int, fanout: int, r_blk: int):
+    nchunks = n // ARC_CHUNK
+
+    def kernel(bases_ref, view_ref, best_out, stripe, bufa, bufb, halo, stripe_sem):
+        j = pl.program_id(0)
+        i = pl.program_id(1)
+
+        @pl.when(i == 0)
+        def _():
+            cp = pltpu.make_async_copy(view_ref.at[:, j], stripe, stripe_sem)
+            cp.start()
+            cp.wait()
+            # ---- windowed row max, in place over the stripe -------------
+            # W[r] = max over view rows r..r+F-1 (mod N).  Shift-doubling
+            # to the largest power of two <= F, then one overlapped
+            # combine — O(log F) passes instead of F, amortized over every
+            # receiver that reads the stripe.
+            halo[...] = stripe[0:fanout - 1]  # pre-overwrite wrap rows
+            # largest power of two <= fanout
+            p = 1 << (fanout.bit_length() - 1)
+
+            def chunk_body(c, _):
+                base = c * ARC_CHUNK
+                ext = ARC_CHUNK + fanout - 1
+                bufa[0:ARC_CHUNK] = stripe[pl.ds(base, ARC_CHUNK)].astype(
+                    bufa.dtype
+                )
+
+                @pl.when(c == nchunks - 1)
+                def _():
+                    bufa[ARC_CHUNK:ext] = halo[...].astype(bufa.dtype)
+
+                @pl.when(c < nchunks - 1)
+                def _():
+                    bufa[ARC_CHUNK:ext] = stripe[
+                        pl.ds(base + ARC_CHUNK, fanout - 1)
+                    ].astype(bufa.dtype)
+
+                # shift-doubling ping-pong: after the step with shift s,
+                # the buffer holds window maxes of length 2s
+                src, dst = bufa, bufb
+                length = ext
+                s = 1
+                while s < p:
+                    dst[0:length - s] = jnp.maximum(
+                        src[0:length - s], src[pl.ds(s, length - s)]
+                    )
+                    src, dst = dst, src
+                    length -= s
+                    s *= 2
+                # combine two p-windows into the F-window (overlap is fine
+                # for max): W[r] = max(D_p[r], D_p[r + F - p])
+                if p == fanout:
+                    w = src[0:ARC_CHUNK]
+                else:
+                    w = jnp.maximum(
+                        src[0:ARC_CHUNK],
+                        src[pl.ds(fanout - p, ARC_CHUNK)],
+                    )
+                stripe[pl.ds(base, ARC_CHUNK)] = w.astype(stripe.dtype)
+                return 0
+
+            lax.fori_loop(0, nchunks, chunk_body, 0, unroll=False)
+
+        # one narrow vector load + store per receiver row — no F-way
+        # gather, no widening, no epilogue arithmetic (XLA fuses that into
+        # the round's elementwise chain at streaming efficiency)
+        def body(r, _):
+            best_out[r, 0] = stripe[bases_ref[r, 0]]
+            return 0
+
+        lax.fori_loop(0, r_blk, body, 0, unroll=False)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("fanout", "block_r", "interpret"))
+def arc_window_max_blocked(
+    view: jax.Array,
+    bases: jax.Array,
+    *,
+    fanout: int,
+    block_r: int = _FUSED_BLOCK_R,
+    interpret: bool = False,
+) -> jax.Array:
+    """``best[i, :] = max over view rows bases[i]..bases[i]+F-1 (mod N)``.
+
+    The ``random_arc`` merge core: senders are F *consecutive* rows, so the
+    F-way max factors into one windowed row-max over the VMEM-resident
+    stripe (O(log F) in-VMEM passes per stripe) plus a single vector load
+    per receiver.  Unlike the fused gather kernels this returns only the
+    merged view row — the membership update stays in XLA, whose fusion
+    runs the widened elementwise arithmetic at streaming efficiency
+    (measured faster than a hand-written in-kernel epilogue, which was
+    VPU-bound).
+
+    ``view``: blocked [N, nc, cs, LANE] with cs*LANE == STRIPE_BLOCK_C;
+    ``bases``: int32 [N].  Returns best in the same blocked shape/dtype
+    (-1 lanes = no sender carried the entry).
+    """
+    n, nc, cs, _ = view.shape
+    if not stripe_supported(n, fanout, nc * cs * LANE):
+        raise ValueError(
+            f"arc window max needs lane-aligned N, cs*LANE == "
+            f"{STRIPE_BLOCK_C} and N*{STRIPE_BLOCK_C} <= {STRIPE_MAX_BYTES} B "
+            f"(N={n}, blocked cols={cs * LANE}); use the XLA path"
+        )
+    if n % ARC_CHUNK:
+        raise ValueError(f"arc window max needs N % {ARC_CHUNK} == 0, got {n}")
+    if not 1 < fanout <= ARC_CHUNK:
+        raise ValueError(f"arc fanout must be in (1, {ARC_CHUNK}], got {fanout}")
+    r_blk = max(min(block_r, n), _FUSED_BLOCK_R_MIN)
+    while n % r_blk:
+        r_blk //= 2
+
+    ext = ARC_CHUNK + fanout - 1
+    return pl.pallas_call(
+        _arc_window_kernel(n, fanout, r_blk),
+        grid=(nc, n // r_blk),
+        in_specs=[
+            pl.BlockSpec(
+                (r_blk, 1), lambda j, i: (i, 0), memory_space=pltpu.SMEM
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (r_blk, 1, cs, LANE), lambda j, i: (i, j, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((n, nc, cs, LANE), view.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((n, cs, LANE), view.dtype),
+            # window-max ping-pong runs in bf16: v5e Mosaic cannot legalize
+            # int8 vector max, and bf16 is exact over the int8 view range
+            pltpu.VMEM((ext, cs, LANE), jnp.bfloat16),
+            pltpu.VMEM((ext, cs, LANE), jnp.bfloat16),
+            pltpu.VMEM((fanout - 1, cs, LANE), view.dtype),
+            pltpu.SemaphoreType.DMA,
+        ],
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=110 * 1024 * 1024),
+        interpret=interpret,
+    )(bases.reshape(n, 1), view)
+
+
 def fanout_max_merge_xla(view: jax.Array, edges: jax.Array) -> jax.Array:
     """Reference XLA formulation of the same op (gather + running max).
 

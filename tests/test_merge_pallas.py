@@ -131,6 +131,55 @@ def test_stripe_kernel_matches_oracle():
         assert jnp.array_equal(g, w), name
 
 
+def test_arc_edges_expand_to_consecutive_window():
+    import numpy as np
+
+    from gossipfs_tpu.core.topology import arc_edges, random_arc_bases
+
+    n, fanout = 256, 7
+    bases = random_arc_bases(jax.random.PRNGKey(5), n, fanout)
+    edges = np.asarray(arc_edges(bases, fanout))
+    b = np.asarray(bases)
+    for i in (0, 17, 255):
+        assert list(edges[i]) == [(b[i] + k) % n for k in range(fanout)]
+        # never-self: the arc excludes the receiver
+        assert i not in edges[i]
+    # bases uniform over the n-fanout non-covering starts: all observed
+    # windows must exclude self for every receiver
+    assert all(i not in edges[i] for i in range(n))
+
+
+def test_full_round_equivalence_xla_vs_arc_stripe():
+    """random_arc: the windowed-stripe kernel == the XLA gather over the
+    expanded [N, F] arc edges, bit-for-bit through full rounds."""
+    base = SimConfig(
+        n=4096,
+        topology="random_arc",
+        fanout=6,
+        remove_broadcast=False,
+        fresh_cooldown=True,
+        view_dtype="int8",
+        merge_block_c=4096,
+    )
+    key = jax.random.PRNGKey(9)
+    out = {}
+    for kernel in ("xla", "pallas_stripe_interpret"):
+        cfg = dataclasses.replace(base, merge_kernel=kernel)
+        final, carry, per_round = run_rounds(
+            init_state(cfg), cfg, 6, key, crash_rate=0.01
+        )
+        out[kernel] = (final, carry, per_round)
+    fx, cx, px = out["xla"]
+    fp, cp, pp = out["pallas_stripe_interpret"]
+    assert jnp.array_equal(fx.hb, fp.hb)
+    assert jnp.array_equal(fx.age, fp.age)
+    assert jnp.array_equal(fx.status, fp.status)
+    assert jnp.array_equal(cx.first_detect, cp.first_detect)
+    assert jnp.array_equal(cx.first_observer, cp.first_observer)
+    assert jnp.array_equal(px.true_detections, pp.true_detections)
+    assert jnp.array_equal(px.false_positives, pp.false_positives)
+
+
 def test_full_round_equivalence_xla_vs_stripe():
     """run_rounds with merge_kernel=pallas_stripe_interpret reproduces the
     XLA scan bit-for-bit at a stripe-eligible size."""
