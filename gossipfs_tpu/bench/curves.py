@@ -39,10 +39,15 @@ TRACK = 8
 
 
 def sweep(ns=DEFAULT_NS, rounds=ROUNDS, crash_rate=0.01, seed=0,
-          topology="random") -> dict:
+          topology="random", donate=False) -> dict:
     """``topology`` sweeps "random" (iid fanout) or "random_arc" (windowed
     arc senders) — the arc rows must match the iid rows within noise, which
-    is the protocol-equivalence evidence for the fast arc merge kernel."""
+    is the protocol-equivalence evidence for the fast arc merge kernel.
+    ``donate=True`` runs the buffer-donating scan — required for the
+    N=32,768 single-chip point, whose state would not otherwise fit."""
+    from gossipfs_tpu.core.rounds import run_rounds_donate
+
+    runner = run_rounds_donate if donate else run_rounds
     rows = []
     for n in ns:
         cfg = SimConfig(
@@ -60,7 +65,7 @@ def sweep(ns=DEFAULT_NS, rounds=ROUNDS, crash_rate=0.01, seed=0,
         events, crash_rounds, churn_ok = tracked_crash_events(
             cfg, rounds, TRACK, CRASH_AT
         )
-        final, carry, per_round = run_rounds(
+        final, carry, per_round = runner(
             init_state(cfg), cfg, rounds, jax.random.PRNGKey(seed),
             events=events, crash_rate=crash_rate, churn_ok=churn_ok,
         )
@@ -136,6 +141,8 @@ def main(argv=None) -> None:
     p.add_argument("--rounds", type=int, default=ROUNDS)
     p.add_argument("--topology", choices=["random", "random_arc"],
                    default="random")
+    p.add_argument("--donate", action="store_true",
+                   help="buffer-donating scan (needed for N=32768 single-chip)")
     p.add_argument("--t-fail-sweep", action="store_true",
                    help="sweep t_fail at fixed N instead of N")
     p.add_argument("--out", type=str, default=None)
@@ -144,7 +151,7 @@ def main(argv=None) -> None:
         doc = json.dumps(sweep_t_fail(rounds=args.rounds))
     else:
         doc = json.dumps(sweep(ns=tuple(args.ns), rounds=args.rounds,
-                               topology=args.topology))
+                               topology=args.topology, donate=args.donate))
     print(doc)
     if args.out:
         with open(args.out, "w") as f:

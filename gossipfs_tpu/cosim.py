@@ -46,8 +46,23 @@ def select_observer(
 class CoSim:
     """Gossip detector + SDFS cluster advancing in lockstep rounds."""
 
-    def __init__(self, config: SimConfig, seed: int = 0, log: EventLog | None = None):
+    def __init__(
+        self,
+        config: SimConfig,
+        seed: int = 0,
+        log: EventLog | None = None,
+        election: str = "local",
+    ):
+        """``election``: "local" computes election outcomes centrally inside
+        ``update_membership`` (the in-process fast path); "rpc" defers them —
+        the cluster only flags ``election_pending`` and the gRPC shim drives
+        the real per-node Vote / AssignNewMaster protocol
+        (``ShimServicer.run_pending_election``), matching the reference's
+        distributed revote (slave.go:930-1051)."""
+        if election not in ("local", "rpc"):
+            raise ValueError(f"unknown election mode: {election!r}")
         self.config = config
+        self.election = election
         self.detector = SimDetector(config, seed=seed)
         self.cluster = SDFSCluster(config.n, seed=seed, introducer=config.introducer)
         self.log = log or EventLog()
@@ -88,6 +103,7 @@ class CoSim:
                     self.detector.membership(observer),
                     reachable=self.detector.alive_nodes(),
                     now=now,
+                    elect=self.election == "local",
                 )
                 if self.cluster.master_node != old_master:
                     # the reference logs the vote outcome (revote_master /

@@ -34,6 +34,7 @@ class SDFSCluster:
         self.master = SDFSMaster(seed=seed)
         self.live: list[int] = list(range(n))      # gossip membership VIEW
         self.reachable: set[int] = set(self.live)  # transport-level reachability
+        self.election_pending = False  # master missing, external driver elects
         self.master.update_member(self.live)
 
     # -- membership seam ---------------------------------------------------
@@ -42,6 +43,7 @@ class SDFSCluster:
         view: list[int],
         reachable: list[int] | None = None,
         now: int = 0,
+        elect: bool = True,
     ) -> None:
         """Feed the detector's membership *view* in (the slave.go:478 seam).
 
@@ -51,16 +53,26 @@ class SDFSCluster:
         processes answer RPC/scp at all (a connection to a dead host fails
         immediately even before gossip detects it); it defaults to the view.
         Triggers election when the master is gone from the view
-        (updateMemberList, slave.go:452-457).
+        (updateMemberList, slave.go:452-457); with ``elect=False`` the
+        trigger only sets ``election_pending`` and an external driver (the
+        shim's distributed Vote/AssignNewMaster path) runs the protocol.
         """
         self.live = sorted(view)
         self.reachable = set(reachable) if reachable is not None else set(self.live)
         self.master.update_member(self.live)
         if self.master_node not in self.live and self.live:
-            self._elect(now)
+            if elect:
+                self._elect(now)
+            else:
+                self.election_pending = True
+        else:
+            self.election_pending = False
 
     def _elect(self, now: int = 0) -> None:
-        """Fixed-candidate majority vote + metadata rebuild (slave.go:930-1051).
+        """Fixed-candidate majority vote + metadata rebuild (slave.go:930-1051),
+        computed centrally (the in-process fast path; the gRPC shim's
+        distributed mode drives the same outcome through the Vote /
+        AssignNewMaster RPC surface instead — shim/service.py).
 
         Every live node votes for the lowest-ordered member; with all votes
         cast the majority is automatic.  Candidates must actually answer RPC
@@ -74,10 +86,19 @@ class SDFSCluster:
         # stalls rather than letting a minority rebuild (and shrink) metadata
         if candidate is None or not election.tally(set(candidates), len(self.live)):
             return
-        self.master_node = candidate
         registries = {
             i: self.stores[i].listing() for i in self.live if i in self.reachable
         }
+        self.install_rebuilt_master(candidate, registries, now)
+
+    def install_rebuilt_master(
+        self, winner: int, registries: dict[int, dict[str, int]], now: int
+    ) -> None:
+        """Make ``winner`` the master with metadata rebuilt from collected
+        registries (rebuild_file_meta, slave.go:986-1043) — the commit step
+        shared by the central ``_elect`` and the shim's distributed
+        Vote/AssignNewMaster election."""
+        self.master_node = winner
         # a rebuilt file's true last-write time died with the old master;
         # treat it as not-recent so the conflict window doesn't spuriously
         # reject the first post-election put

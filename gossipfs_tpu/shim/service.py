@@ -42,6 +42,7 @@ import grpc
 
 from gossipfs_tpu.cosim import CoSim
 from gossipfs_tpu.sdfs import election
+from gossipfs_tpu.sdfs.types import CONFIRM_TIMEOUT
 from gossipfs_tpu.shim import wire
 from gossipfs_tpu.shim.wire import SERVICE, deser as _deser, ser as _ser
 
@@ -49,12 +50,34 @@ __all__ = ["SERVICE", "ShimServicer", "ShimServer"]
 
 
 class ShimServicer:
-    """The RPC method implementations over one CoSim (single-writer lock)."""
+    """The RPC method implementations over one CoSim (single-writer lock).
 
-    def __init__(self, sim: CoSim, auto_confirm: bool = False):
+    ``confirm_timeout``: wall-clock seconds the master waits on a
+    write-conflict confirmation callback before defaulting to reject — the
+    reference's 30 s ``Ask_for_confirmation`` timeout (server.go:155-177;
+    1 round == 1 s, so CONFIRM_TIMEOUT doubles as both).
+    ``confirm_handler``: this node's answer when *it* is asked (the
+    interactive yes/no prompt site, server.go:144-153); None falls back to
+    the ``auto_confirm`` policy.
+    """
+
+    def __init__(
+        self,
+        sim: CoSim,
+        auto_confirm: bool = False,
+        confirm_timeout: float = float(CONFIRM_TIMEOUT),
+        confirm_handler=None,
+    ):
         self.sim = sim
         self.auto_confirm = auto_confirm
+        self.confirm_timeout = confirm_timeout
+        self.confirm_handler = confirm_handler
+        self.address: str | None = None  # set by ShimServer after binding
+        self._self_client = None  # loopback channel for the election fan-out
         self._lock = threading.Lock()
+        # serializes tick+election pairs: a concurrent Advance must not
+        # mutate detector state while an election reads per-node views
+        self._election_lock = threading.Lock()
         # Vote tallies: candidate -> set of voters (Receive_vote state,
         # reference: slave/slave.go:53-57, 968-984)
         self._votes: dict[int, set[int]] = {}
@@ -99,10 +122,86 @@ class ShimServicer:
             return {"nodes": self.sim.detector.alive_nodes()}
 
     def Advance(self, req, ctx):
+        # the election lock (taken OUTSIDE the sim lock) serializes whole
+        # tick+election sequences: no other Advance can mutate detector
+        # state while run_pending_election reads per-node views
+        with self._election_lock:
+            with self._lock:
+                self._snapshots = None  # synchronous path resolves bulk scans
+                self.sim.tick(int(req.get("rounds", 1)))
+                out = {"round": self.sim.round}
+            # sim lock released: the distributed election self-dials Vote /
+            # AssignNewMaster on this server, whose handlers take it
+            self.run_pending_election()
+        return out
+
+    # -- distributed election (reference: slave.go:930-1051) ---------------
+    def _self_call(self, method: str, **req):
+        from gossipfs_tpu.shim.client import ShimClient
+
+        if self._self_client is None:
+            self._self_client = ShimClient(self.address, timeout=30.0)
+        return self._self_client.call(method, **req)
+
+    def run_pending_election(self) -> bool:
+        """Drive one election attempt through the real RPC surface.
+
+        Mirrors the reference's per-node protocol: every live node whose own
+        membership row lacks the master votes for the lowest member of ITS
+        OWN row (revote_master, slave.go:930-948) via the Vote RPC; the
+        tally elects on majority (Receive_vote, :968-984); the winner then
+        fans out AssignNewMaster to collect registries and commits the
+        rebuilt metadata (rebuild_file_meta, :986-1051).  Split views that
+        produce no majority stall the election — it retries on the next
+        Advance, like the reference's per-heartbeat revote.  Call under
+        ``_election_lock`` with the sim lock RELEASED (the dialed handlers
+        take it); the election lock keeps concurrent Advances from mutating
+        detector state mid-election.  Returns True if a master was
+        installed.
+        """
+        sim = self.sim
+        if getattr(sim, "election", "local") != "rpc":
+            return False
         with self._lock:
-            self._snapshots = None  # synchronous path resolves any bulk scan
-            self.sim.tick(int(req.get("rounds", 1)))
-            return {"round": self.sim.round}
+            if not sim.cluster.election_pending:
+                return False
+            old_master = sim.cluster.master_node
+            now = sim.round
+        det = sim.detector
+        winner = None
+        for voter in det.alive_nodes():
+            row = det.membership(voter)
+            if not row or old_master in row:
+                continue  # this node still believes in the old master
+            candidate = min(row)  # MemberList[0] in id order (slave.go:936)
+            reply = self._self_call("Vote", candidate=candidate, voter=voter)
+            if reply.get("elected"):
+                winner = candidate
+                break
+        if winner is None:
+            return False  # split view / insufficient votes: stall + retry
+        # the winner collects registries from every member it can reach
+        with self._lock:
+            members = [x for x in sim.cluster.live if x in sim.cluster.reachable]
+        registries: dict[int, dict[str, int]] = {}
+        for node in members:
+            reply = self._self_call("AssignNewMaster", node=node, master=winner)
+            registries[node] = reply["listing"]
+        with self._lock:
+            if winner not in set(det.alive_nodes()):
+                # master crashed during the rebuild: abort the commit; the
+                # next Advance detects the vacancy and re-elects
+                sim.cluster.election_pending = True
+                return False
+            sim.cluster.install_rebuilt_master(winner, registries, now)
+            sim.cluster.election_pending = False
+            sim.log.write(
+                f"Elected new master {winner} via Vote/AssignNewMaster "
+                f"(was {old_master})",
+                round=now,
+                kind="election",
+            )
+        return True
 
     def AdvanceBulk(self, req, ctx):
         """Advance many rounds as ONE compiled scan (SURVEY §7.4's async
@@ -153,22 +252,63 @@ class ShimServicer:
         with self._lock:
             return {"lines": self.sim.log.grep(req["pattern"])}
 
+    def _ask_confirmation(self, callback: str, name: str) -> bool:
+        """Master -> requester confirmation round-trip (server.go:155-177).
+
+        Dials the requester's own shim server at ``callback`` and asks; any
+        error or no answer within ``confirm_timeout`` seconds is the
+        reference's 30 s-timeout outcome: reject.
+        """
+        from gossipfs_tpu.shim.client import ShimClient
+
+        client = ShimClient(callback, timeout=self.confirm_timeout)
+        try:
+            reply = client.call("AskForConfirmation", file=name)
+            return bool(reply.get("confirm", False))
+        except Exception:
+            return False
+        finally:
+            client.close()
+
+    def _resolve_conflict(self, req, name: str) -> bool:
+        """Whether a conflicting put may proceed.  Precedence: explicit
+        ``confirm`` flag (programmatic client) > server auto-confirm policy >
+        callback round-trip to the requester > reject.  Call with the sim
+        lock RELEASED — the callback is a network round-trip.
+        """
+        if req.get("confirm", False) or self.auto_confirm:
+            return True
+        callback = req.get("callback")
+        if callback:
+            return self._ask_confirmation(callback, name)
+        return False
+
     def GetPutInfo(self, req, ctx):
         """Conflict check + placement + version bump (server.go:74-121).
 
-        On a write within the 60-round window the master asks for
-        confirmation; ``confirm`` in the request (or server-side
-        ``auto_confirm``) stands in for the interactive yes/no whose absence
-        times out to a reject after 30 s (server.go:144-177).
+        On a write within the 60-round window the master asks the
+        *requester* for confirmation: a ``callback`` address in the request
+        names the requester's own shim server, which the master dials with
+        a ``confirm_timeout``-second deadline defaulting to reject
+        (Ask_for_confirmation, server.go:144-177).  The callback runs with
+        the lock released (only this request blocks, like the reference's
+        per-connection goroutine); the conflict window is re-checked under
+        the lock before committing, so a put that raced in during the
+        callback still needs its own confirmation.
         """
         name = req["file"]
         with self._lock:
             now = self.sim.round
+            conflict = self.sim.cluster.master.updated_recently(name, now)
+        confirmed = self._resolve_conflict(req, name) if conflict else False
+        if conflict and not confirmed:
+            return {"ok": False, "conflict": True}
+        with self._lock:
             master = self.sim.cluster.master
-            if master.updated_recently(name, now):
-                if not (req.get("confirm", False) or self.auto_confirm):
-                    return {"ok": False, "conflict": True}
-            replicas, version = master.handle_put(name, now)
+            if master.updated_recently(name, self.sim.round) and not confirmed:
+                # a concurrent put landed while we were outside the lock
+                return {"ok": False, "conflict": True}
+            replicas, version = master.handle_put(name, self.sim.round)
             return {"ok": bool(replicas), "replicas": replicas, "version": version}
 
     def GetFileData(self, req, ctx):
@@ -184,8 +324,12 @@ class ShimServicer:
             return {"replicas": replicas, "version": version}
 
     def AskForConfirmation(self, req, ctx):
-        """The interactive conflict prompt (server.go:155-177); the no-answer
-        outcome (30 s timeout -> reject) is the default policy."""
+        """The requester-side conflict prompt (server.go:144-177): the
+        master dialed THIS node back about ``file``.  ``confirm_handler``
+        is the interactive yes/no site; without one, the ``auto_confirm``
+        policy answers (and the master's timeout covers a hung prompt)."""
+        if self.confirm_handler is not None:
+            return {"confirm": bool(self.confirm_handler(req.get("file", "")))}
         return {"confirm": self.auto_confirm}
 
     def GetDeleteInfo(self, req, ctx):
@@ -218,7 +362,11 @@ class ShimServicer:
         with self._lock:
             voters = self._votes.setdefault(candidate, set())
             voters.add(voter)
-            elected = election.tally(voters, len(self.sim.cluster.live))
+            # only count voters still in the current view: a tally that
+            # persists across a stalled round must not let since-dead
+            # voters push a later, smaller majority over the line
+            live = set(self.sim.cluster.live)
+            elected = election.tally(voters & live, len(live))
             if elected:
                 self.sim.cluster.master_node = candidate
                 # election over: clear ALL tallies so losers' votes can't
@@ -270,10 +418,24 @@ class ShimServicer:
     # -- whole-op verbs (CLI surface, README.md:8-29) ----------------------
     def Put(self, req, ctx):
         data = base64.b64decode(req["data_b64"])
+        name = req["file"]
+        # resolve any needed confirmation BEFORE taking the lock: the
+        # callback is a network round-trip (up to confirm_timeout) that must
+        # not stall every other RPC.  The pre-resolved answer feeds the
+        # in-lock put; a conflict that appears only while we were unlocked
+        # gets a None confirm and rejects conservatively.
         with self._lock:
-            ok = self.sim.put(req["file"], data, confirm=(
-                (lambda: True) if (req.get("confirm") or self.auto_confirm) else None
-            ))
+            conflict = self.sim.cluster.master.updated_recently(
+                name, self.sim.round
+            )
+        confirm = None
+        if conflict:
+            allowed = self._resolve_conflict(req, name)
+            confirm = (lambda: allowed)  # noqa: E731
+        elif req.get("confirm") or self.auto_confirm:
+            confirm = lambda: True  # noqa: E731
+        with self._lock:
+            ok = self.sim.put(name, data, confirm=confirm)
             return {"ok": ok}
 
     def Get(self, req, ctx):
@@ -338,10 +500,15 @@ class ShimServer:
         port: int = 0,
         host: str = "127.0.0.1",
         auto_confirm: bool = False,
+        confirm_timeout: float = float(CONFIRM_TIMEOUT),
+        confirm_handler=None,
         max_workers: int = 8,
         max_message_mb: int = wire.MAX_MESSAGE_MB,
     ):
-        self.servicer = ShimServicer(sim, auto_confirm=auto_confirm)
+        self.servicer = ShimServicer(
+            sim, auto_confirm=auto_confirm, confirm_timeout=confirm_timeout,
+            confirm_handler=confirm_handler,
+        )
         # same cap as the client (wire.py — multi-MB file payloads)
         opts = wire.message_size_options(max_message_mb)
         self.server = grpc.server(
@@ -350,12 +517,16 @@ class ShimServer:
         self.server.add_generic_rpc_handlers((self.servicer.generic_handler(),))
         self.port = self.server.add_insecure_port(f"{host}:{port}")
         self.address = f"{host}:{self.port}"
+        self.servicer.address = self.address
 
     def start(self) -> "ShimServer":
         self.server.start()
         return self
 
     def stop(self, grace: float = 0.5) -> None:
+        if self.servicer._self_client is not None:
+            self.servicer._self_client.close()
+            self.servicer._self_client = None
         self.server.stop(grace).wait()
 
 
@@ -394,12 +565,14 @@ def main(argv=None) -> None:
         while True:
             if args.auto_tick > 0:
                 _time.sleep(args.auto_tick)
-                with server.servicer._lock:
-                    # like Advance: the synchronous path resolves any bulk
-                    # scan, so Lsm/AliveNodes can't stay pinned to a stale
-                    # bulk snapshot while the auto-ticked state moves on
-                    server.servicer._snapshots = None
-                    sim.tick(1)
+                with server.servicer._election_lock:
+                    with server.servicer._lock:
+                        # like Advance: the synchronous path resolves any
+                        # bulk scan, so Lsm/AliveNodes can't stay pinned to
+                        # a stale bulk snapshot while the state moves on
+                        server.servicer._snapshots = None
+                        sim.tick(1)
+                    server.servicer.run_pending_election()
             else:
                 _time.sleep(3600)
     except KeyboardInterrupt:

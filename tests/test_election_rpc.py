@@ -1,0 +1,108 @@
+"""Distributed election end-to-end through the RPC surface (VERDICT #3).
+
+The reference's election is a real multi-node protocol (slave.go:930-1051):
+per-node votes over RPC from each node's OWN membership view, a majority
+tally, then AssignNewMaster fan-out collecting registries for the metadata
+rebuild.  These tests run a CoSim in election="rpc" mode behind a live
+gRPC shim and kill the master: the new master must emerge via the
+Vote/AssignNewMaster handlers — the central ``cluster._elect`` shortcut is
+poisoned to prove it is never taken.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from gossipfs_tpu.config import SimConfig
+from gossipfs_tpu.cosim import CoSim
+from gossipfs_tpu.shim.client import ShimClient
+from gossipfs_tpu.shim.service import ShimServer
+
+
+@pytest.fixture()
+def rpc_shim(monkeypatch):
+    sim = CoSim(SimConfig(n=10), seed=3, election="rpc")
+
+    def poisoned(self, now=0):  # pragma: no cover - must never run
+        raise AssertionError("central _elect used in rpc election mode")
+
+    monkeypatch.setattr(type(sim.cluster), "_elect", poisoned)
+    server = ShimServer(sim, port=0).start()
+    client = ShimClient(server.address, timeout=30.0)
+    yield sim, server, client
+    client.close()
+    server.stop()
+
+
+def test_master_crash_elects_via_rpc_surface(rpc_shim):
+    sim, server, client = rpc_shim
+    assert client.put("meta.txt", b"survives the master")
+    client.advance(3)  # counters past the hb grace
+    client.crash(0)    # kill the master (the introducer)
+    # detection ~t_fail after the crash; the election rides the next Advance
+    client.advance(12)
+    assert sim.cluster.master_node == 1
+    assert not sim.cluster.election_pending
+    # the election is visible in the log as the RPC-driven path
+    lines = client.grep("Vote/AssignNewMaster")
+    assert lines and lines[0]["kind"] == "election"
+    # rebuilt metadata still serves the file written under the old master
+    assert client.get("meta.txt") == b"survives the master"
+    replicas, = [client.ls("meta.txt")]
+    assert replicas  # rebuild kept the replica set
+    # and the new master accepts writes
+    assert client.put("after.txt", b"new regime")
+
+
+def test_split_vote_stalls_until_majority(rpc_shim):
+    """No candidate with a majority -> the election stalls (election_pending
+    stays set) and retries; votes through the Vote handler prove the tally
+    is doing the gating."""
+    sim, server, client = rpc_shim
+    n_live = len(sim.cluster.live)
+    # a minority of hand-cast votes elects nobody
+    for voter in range(n_live // 2):
+        reply = client.call("Vote", candidate=7, voter=voter)
+        assert not reply["elected"]
+    assert sim.cluster.master_node == 0  # unchanged
+    # the rest of the cluster joins in: majority crosses, 7 is elected
+    reply = client.call("Vote", candidate=7, voter=n_live // 2)
+    assert reply["elected"]
+    assert sim.cluster.master_node == 7
+
+
+def test_winner_crash_during_rebuild_aborts_and_reelects(rpc_shim):
+    """Master-crash-during-rebuild: the commit is aborted and the next
+    Advance re-elects the following candidate."""
+    sim, server, client = rpc_shim
+    client.advance(3)
+    client.crash(0)
+    # sabotage: the moment the winner starts collecting registries, it dies
+    orig = server.servicer._self_call
+    killed = []
+
+    def crash_winner(method, **req):
+        if method == "AssignNewMaster" and not killed:
+            killed.append(req["master"])
+            sim.detector.crash(req["master"])
+            sim.detector.advance(1)  # the crash lands before the commit check
+        return orig(method, **req)
+
+    server.servicer._self_call = crash_winner
+    client.advance(12)
+    # first attempt: node 1 won the vote but died mid-rebuild -> aborted
+    assert killed == [1]
+    assert sim.cluster.master_node != 1 or sim.cluster.election_pending
+    # next advances detect 1's death; the re-election installs node 2
+    client.advance(12)
+    assert sim.cluster.master_node == 2
+    assert not sim.cluster.election_pending
+
+
+def test_local_mode_unchanged():
+    """Default CoSim keeps the central election (backwards compatible)."""
+    sim = CoSim(SimConfig(n=10), seed=3)
+    sim.tick(3)
+    sim.detector.crash(0)
+    sim.tick(12)
+    assert sim.cluster.master_node == 1
