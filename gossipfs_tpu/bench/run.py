@@ -67,6 +67,11 @@ def tracked_crash_events(
     events = RoundEvents(crash=jnp.asarray(crash), leave=zeros, join=zeros)
     churn_ok = np.ones((n,), dtype=bool)
     churn_ok[nodes] = False
+    # the introducer is exempt from RANDOM churn: joins die with it
+    # (slave.go:22 SPOF, kept by design), so introducer-inclusive churn
+    # collapses the population to ~zero and trivializes the scenario —
+    # model the reference's "introducer VM stays up" deployment instead
+    churn_ok[cfg.introducer] = False
     return events, {node: at for node in nodes}, jnp.asarray(churn_ok)
 
 
@@ -132,6 +137,15 @@ def run_cosim(
     """
     from gossipfs_tpu.cosim import select_observer
 
+    @jax.jit
+    def membership_packet(state: SimState, observer) -> jnp.ndarray:
+        """alive mask + observer's membership row as ONE device array, so
+        each control-plane reaction costs a single host transfer (the
+        per-chunk tunnel round-trips were a config-5 bottleneck)."""
+        return jnp.concatenate(
+            [state.alive, state.status[observer] == MEMBER]
+        )
+
     cluster = SDFSCluster(cfg.n, seed=seed, introducer=cfg.introducer)
     for f in range(sc.n_files):
         cluster.put(f"file{f}.txt", b"payload-%d" % f, now=0)
@@ -141,6 +155,11 @@ def run_cosim(
 
         state = shard_state(state, mesh)
     key = jax.random.PRNGKey(seed)
+    # random churn spares the introducer (see tracked_crash_events): with it
+    # dead no rejoin can ever land and the population decays to nothing
+    churn_ok = jnp.asarray(
+        np.arange(cfg.n) != cfg.introducer
+    )
     # equal-size chunks only: num_rounds is a static jit arg on run_rounds, so
     # a ragged final chunk would trigger a second full XLA compilation
     chunk = RECOVERY_DELAY
@@ -150,33 +169,65 @@ def run_cosim(
     done = 0
     alive: list[int] = []
     runner = _runner(cfg, mesh)
-    # warm up the chunk kernel so compile time stays out of the timed region
-    jax.block_until_ready(
-        runner(
-            state, cfg, chunk, key, crash_rate=sc.crash_rate, rejoin_rate=sc.rejoin_rate
-        )[0]
-    )
-    t0 = time.perf_counter()
-    for _ in range(n_chunks):
-        state, _, _ = runner(
-            state, cfg, chunk, key, crash_rate=sc.crash_rate, rejoin_rate=sc.rejoin_rate
-        )
-        done += chunk
-        alive = np.nonzero(np.asarray(state.alive))[0].tolist()
+    run_chunk = lambda st: runner(  # noqa: E731
+        st, cfg, chunk, key, crash_rate=sc.crash_rate,
+        rejoin_rate=sc.rejoin_rate, churn_ok=churn_ok,
+    )[0]
+    # warm up the chunk kernel AND the packet fetch so compile time stays
+    # out of the timed region
+    jax.block_until_ready(run_chunk(state))
+    jax.block_until_ready(membership_packet(state, cluster.master_node))
+    n = cfg.n
+
+    def react(packet: np.ndarray, now: int, state, fetched_for: int) -> bool:
+        """One control-plane reaction off a resolved membership packet
+        (whose row was prefetched for observer ``fetched_for``).
+        Returns False when the cluster is empty (stop)."""
+        nonlocal repairs, elections, alive
+        alive_mask, row = packet[:n], packet[n:]
+        alive = np.nonzero(alive_mask)[0].tolist()
         if not alive:
-            # feed the empty membership so the closing durability check can't
-            # satisfy quorum against stores of dead nodes
-            cluster.update_membership([], reachable=[], now=done)
-            break
+            # feed the empty membership so the closing durability check
+            # can't satisfy quorum against stores of dead nodes
+            cluster.update_membership([], reachable=[], now=now)
+            return False
         observer = select_observer(cluster.live, set(alive), cluster.master_node)
         if observer is None:
-            continue
-        view = np.nonzero(np.asarray(state.status[observer]) == int(MEMBER))[0]
+            return True
+        if observer != fetched_for:
+            # the prefetch guessed wrong (e.g. an election happened after
+            # dispatch): refetch the actual observer's row, never consume a
+            # dead master's frozen view
+            row = np.asarray(membership_packet(state, observer))[n:]
+        view = np.nonzero(row)[0]
         old_master = cluster.master_node
-        cluster.update_membership(view.tolist(), reachable=alive, now=done)
+        cluster.update_membership(view.tolist(), reachable=alive, now=now)
         if cluster.master_node != old_master:
             elections += 1
         repairs += len(cluster.fail_recover())
+        return True
+
+    t0 = time.perf_counter()
+    # pipelined chunks: the SDFS control plane consumes membership but
+    # never feeds back into the detector state, so chunk k+1 (and its
+    # membership packet) dispatches BEFORE chunk k's reaction runs — the
+    # device streams while the host reacts, instead of a tunnel round-trip
+    # serializing every RECOVERY_DELAY rounds.  Reactions still see each
+    # chunk boundary's exact state, in order.
+    pending = None  # (packet device-future, done_rounds, state, fetched_for)
+    for _ in range(n_chunks):
+        state = run_chunk(state)
+        done += chunk
+        fetched_for = cluster.master_node
+        pkt = membership_packet(state, fetched_for)
+        prev, pending = pending, (pkt, done, state, fetched_for)
+        if prev is not None and not react(
+            np.asarray(prev[0]), prev[1], prev[2], prev[3]
+        ):
+            pending = None
+            break
+    if pending is not None:
+        react(np.asarray(pending[0]), pending[1], pending[2], pending[3])
     elapsed = time.perf_counter() - t0
     # durability: how many files still answer a quorum read at the end
     readable = sum(

@@ -557,10 +557,12 @@ def _merge(
                 view, edges, hb, age, status, shift_a, shift_b, alive32,
                 block_c=config.merge_block_c, **kernel_kwargs
             )
+    elif arc:
+        # XLA arc formulation: windowed row-max + one gather, F-independent
+        # traffic — same results as the F-way gather over expanded edges
+        best_rel = merge_pallas.arc_window_max_xla(view, edges, fanout)
     else:
         # XLA gather path: also the fallback for unsupported shapes/backends
-        if arc:
-            edges = topology.arc_edges(edges, fanout)
         best_rel = merge_pallas.fanout_max_merge_xla(view, edges)
     if best_rel is not None:
         # shared XLA membership update (MergeMemberList semantics)

@@ -818,3 +818,32 @@ def fanout_max_merge_xla(view: jax.Array, edges: jax.Array) -> jax.Array:
 
     init = jnp.full(view.shape, -1, dtype=view.dtype)
     return lax.fori_loop(0, edges.shape[1], body, init)
+
+
+def arc_window_max_xla(view: jax.Array, bases: jax.Array, fanout: int) -> jax.Array:
+    """XLA formulation of the arc merge: shift-doubling windowed row-max
+    plus ONE row gather — F-independent traffic, identical results to
+    ``fanout_max_merge_xla`` over the expanded arc edges.
+
+    The workhorse for arc topologies off the TPU fast path (CPU runs, the
+    sharded virtual-mesh correctness runs at 100k-class N, where the F-way
+    gather's F x N^2 bytes are prohibitive).  Works on 2-D [N, C] and
+    blocked [N, nc, cs, LANE] views alike (axis 0 is always the row).
+    """
+    n = view.shape[0]
+    ext = jnp.concatenate([view, view[: fanout - 1]], axis=0)  # row wrap
+    p = 1 << (fanout.bit_length() - 1)  # largest power of two <= fanout
+    length = n + fanout - 1
+    s = 1
+    while s < p:
+        # after the step with shift s, ext[r] = max over rows r..r+2s-1
+        ext = jnp.maximum(ext[: length - s], ext[s:length])
+        length -= s
+        s *= 2
+    if p == fanout:
+        w = ext[:n]
+    else:
+        # two overlapping p-windows cover the F-window exactly (max is
+        # idempotent): W[r] = max(D_p[r], D_p[r + F - p])
+        w = jnp.maximum(ext[:n], ext[fanout - p:fanout - p + n])
+    return w[bases]

@@ -61,7 +61,8 @@ def shard_state(state: SimState, mesh: Mesh) -> SimState:
 
 
 @functools.lru_cache(maxsize=32)
-def _sharded_runner(mesh, config, crash_rate, rejoin_rate, has_churn_ok):
+def _sharded_runner(mesh, config, crash_rate, rejoin_rate, has_churn_ok,
+                    donate=False, matrix_events=True):
     from jax import lax
     from jax.sharding import PartitionSpec as P
 
@@ -86,6 +87,7 @@ def _sharded_runner(mesh, config, crash_rate, rejoin_rate, has_churn_ok):
         st, mc, pr = rounds._scan_rounds(
             st, config, key, ev, crash_rate, rejoin_rate,
             churn_ok if has_churn_ok else None, ctx,
+            matrix_events=matrix_events,
         )
         if blocked:
             st = rounds._from_blocked(st)
@@ -100,6 +102,10 @@ def _sharded_runner(mesh, config, crash_rate, rejoin_rate, has_churn_ok):
                    rounds.RoundMetrics(rep, rep, rep)),
         check_vma=False,
     )
+    if donate:
+        # in-place [N, N] lanes: the 100k-class runs don't fit with
+        # double-buffered state (the caller's state is consumed)
+        return jax.jit(fn, donate_argnums=(0, 1, 2))
     return jax.jit(fn)
 
 
@@ -113,6 +119,7 @@ def run_rounds_sharded(
     crash_rate: float = 0.0,
     rejoin_rate: float = 0.0,
     churn_ok: jax.Array | None = None,
+    donate: bool = False,
 ):
     """``core.rounds.run_rounds`` over an explicit subject-axis shard_map.
 
@@ -140,6 +147,7 @@ def run_rounds_sharded(
                          "use run_rounds (GSPMD) instead")
     if n % d:
         raise ValueError(f"n={n} must divide over {d} devices")
+    matrix_events = events is not None or rejoin_rate > 0.0
     if events is None:
         zeros = jnp.zeros((num_rounds, n), dtype=bool)
         events = RoundEvents(crash=zeros, leave=zeros, join=zeros)
@@ -149,7 +157,8 @@ def run_rounds_sharded(
         churn_ok_arr = churn_ok
 
     fn = _sharded_runner(mesh, config, crash_rate, rejoin_rate,
-                         churn_ok is not None)
+                         churn_ok is not None, donate=donate,
+                         matrix_events=matrix_events)
     hb, age, status, alive, rnd, hb_base, mc, pr = fn(
         state.hb, state.age, state.status, state.alive, state.round,
         state.hb_base, events.crash, events.leave, events.join, key,

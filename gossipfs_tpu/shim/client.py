@@ -4,7 +4,8 @@ Every SDFS client op in the reference dials the master and calls a
 string-named method (e.g. ``rpc.Dial("tcp", master:9000)`` then
 ``TCPServer.Get_put_info``, reference: slave/slave.go:669-678).  This client
 is the same shape over gRPC: one channel, methods addressed by name under
-``/gossipfs.Shim/``.  JSON in, JSON out — no codegen.
+``/gossipfs.Shim/``, protobuf messages per ``gossipfs.proto`` (dict in,
+dict out — the json_format transcoding lives in wire.py).
 """
 
 from __future__ import annotations
@@ -14,7 +15,7 @@ import base64
 import grpc
 
 from gossipfs_tpu.shim import wire
-from gossipfs_tpu.shim.wire import SERVICE, deser as _deser, ser as _ser
+from gossipfs_tpu.shim.wire import SERVICE
 
 
 class ShimClient:
@@ -38,8 +39,8 @@ class ShimClient:
         if fn is None:
             fn = self._methods[method] = self.channel.unary_unary(
                 f"/{SERVICE}/{method}",
-                request_serializer=_ser,
-                response_deserializer=_deser,
+                request_serializer=wire.request_serializer(method),
+                response_deserializer=wire.response_deserializer(method),
             )
         return fn(request, timeout=self.timeout)
 

@@ -44,7 +44,7 @@ from gossipfs_tpu.cosim import CoSim
 from gossipfs_tpu.sdfs import election
 from gossipfs_tpu.sdfs.types import CONFIRM_TIMEOUT
 from gossipfs_tpu.shim import wire
-from gossipfs_tpu.shim.wire import SERVICE, deser as _deser, ser as _ser
+from gossipfs_tpu.shim.wire import SERVICE
 
 __all__ = ["SERVICE", "ShimServicer", "ShimServer"]
 
@@ -483,8 +483,8 @@ class ShimServicer:
         handlers = {
             name: grpc.unary_unary_rpc_method_handler(
                 getattr(self, name),
-                request_deserializer=_deser,
-                response_serializer=_ser,
+                request_deserializer=wire.request_deserializer(name),
+                response_serializer=wire.response_serializer(name),
             )
             for name in self.METHODS
         }

@@ -1,15 +1,22 @@
-"""Shared shim wire definitions: service name + JSON codec.
+"""Shared shim wire definitions: service name + protobuf codec.
 
-Dependency-free on purpose: the client (``shim/client.py``) must stay a thin
-process that imports neither the server stack nor jax — only this module and
-``grpc``.  Messages are JSON dicts; the gRPC method path is
-``/gossipfs.Shim/<Method>`` (see shim/service.py for the method map onto the
-reference's net/rpc surface, server/server.go:19-251).
+The wire speaks real protobuf over gRPC — ``gossipfs.proto`` is the
+codegen-able contract (any language's gRPC toolchain produces a full
+client from it; the reference's Go CLI included).  Python handlers keep
+their plain-dict ergonomics: each method's serializer/deserializer
+round-trips dict <-> protobuf message via ``google.protobuf.json_format``,
+so the service/client code never touches message classes directly.
+
+Dependency-light on purpose: the client (``shim/client.py``) must stay a
+thin process that imports neither the server stack nor jax — only this
+module, ``grpc`` and the generated message classes.
 """
 
 from __future__ import annotations
 
-import json
+from google.protobuf import json_format
+
+from gossipfs_tpu.shim import gossipfs_pb2 as pb
 
 SERVICE = "gossipfs.Shim"
 
@@ -20,6 +27,37 @@ SERVICE = "gossipfs.Shim"
 # with RESOURCE_EXHAUSTED on one side only.
 MAX_MESSAGE_MB = 64
 
+# method -> (request message class, response message class); the single
+# source of truth tying the service surface to gossipfs.proto
+METHOD_TYPES: dict[str, tuple] = {
+    "Join": (pb.NodeRequest, pb.OkReply),
+    "Leave": (pb.NodeRequest, pb.OkReply),
+    "Crash": (pb.NodeRequest, pb.OkReply),
+    "Lsm": (pb.LsmRequest, pb.LsmReply),
+    "AliveNodes": (pb.Empty, pb.AliveNodesReply),
+    "Advance": (pb.AdvanceRequest, pb.AdvanceReply),
+    "AdvanceBulk": (pb.AdvanceBulkRequest, pb.AdvanceBulkReply),
+    "Events": (pb.EventsRequest, pb.EventsReply),
+    "Grep": (pb.GrepRequest, pb.GrepReply),
+    "GetPutInfo": (pb.PutInfoRequest, pb.PutInfoReply),
+    "GetFileData": (pb.NodeFileRequest, pb.FileDataReply),
+    "GetFileInfo": (pb.FileRequest, pb.FileInfoReply),
+    "AskForConfirmation": (pb.FileRequest, pb.ConfirmReply),
+    "GetDeleteInfo": (pb.FileRequest, pb.DeleteInfoReply),
+    "DeleteFileData": (pb.NodeFileRequest, pb.OkReply),
+    "RemoteReput": (pb.ReputRequest, pb.OkReply),
+    "Vote": (pb.VoteRequest, pb.VoteReply),
+    "AssignNewMaster": (pb.AssignRequest, pb.AssignReply),
+    "UpdateFileVersion": (pb.UpdateVersionRequest, pb.OkReply),
+    "GetUpdateMeta": (pb.UpdateMetaRequest, pb.UpdateMetaReply),
+    "Put": (pb.PutRequest, pb.OkReply),
+    "Get": (pb.FileRequest, pb.GetReply),
+    "Delete": (pb.FileRequest, pb.OkReply),
+    "Ls": (pb.FileRequest, pb.LsReply),
+    "Store": (pb.NodeRequest, pb.StoreReply),
+    "ShowMetadata": (pb.Empty, pb.MetadataReply),
+}
+
 
 def message_size_options(max_message_mb: int = MAX_MESSAGE_MB):
     """grpc channel/server options raising the message size cap."""
@@ -29,9 +67,32 @@ def message_size_options(max_message_mb: int = MAX_MESSAGE_MB):
     ]
 
 
-def ser(obj) -> bytes:
-    return json.dumps(obj).encode("utf-8")
+def _to_dict(msg) -> dict:
+    # scalars without explicit presence always materialize (so handlers can
+    # read req["file"] / reply["ok"] unconditionally); `optional` fields
+    # keep presence semantics (e.g. as_of_round only from snapshot reads)
+    return json_format.MessageToDict(
+        msg,
+        preserving_proto_field_name=True,
+        always_print_fields_with_no_presence=True,
+    )
 
 
-def deser(data: bytes):
-    return json.loads(data.decode("utf-8")) if data else {}
+def request_serializer(method: str):
+    cls = METHOD_TYPES[method][0]
+    return lambda obj: json_format.ParseDict(obj, cls()).SerializeToString()
+
+
+def request_deserializer(method: str):
+    cls = METHOD_TYPES[method][0]
+    return lambda data: _to_dict(cls.FromString(data))
+
+
+def response_serializer(method: str):
+    cls = METHOD_TYPES[method][1]
+    return lambda obj: json_format.ParseDict(obj, cls()).SerializeToString()
+
+
+def response_deserializer(method: str):
+    cls = METHOD_TYPES[method][1]
+    return lambda data: _to_dict(cls.FromString(data))

@@ -28,11 +28,15 @@ def test_tracked_crash_events_spread_and_skip_introducer():
     assert set(crash_rounds.values()) == {10}
     assert cfg.introducer not in crash_rounds
     assert len(crash_rounds) == 4
-    # tracked victims are excluded from random churn (TTD measurement guard)
+    # tracked victims are excluded from random churn (TTD measurement
+    # guard), and so is the introducer (its death severs every rejoin —
+    # slave.go:22 SPOF — which would collapse churny scenarios to nothing)
     import numpy as np
 
     ok = np.asarray(churn_ok)
-    assert not ok[list(crash_rounds)].any() and ok.sum() == 60
+    assert not ok[list(crash_rounds)].any()
+    assert not ok[cfg.introducer]
+    assert ok.sum() == 64 - 4 - 1
 
 
 def test_run_scenario_parity_10_detects_tracked_crashes():

@@ -91,7 +91,7 @@ def test_get_put_info_and_update_file_version(shim):
     assert len(info["replicas"]) == REPLICATION_FACTOR
     # conflicting second request without confirm
     info2 = client.call("GetPutInfo", file="a.txt")
-    assert info2 == {"ok": False, "conflict": True}
+    assert (info2["ok"], info2["conflict"]) == (False, True)
     # confirmed retry bumps the version
     info3 = client.call("GetPutInfo", file="a.txt", confirm=True)
     assert info3["ok"] and info3["version"] == 2
@@ -247,7 +247,7 @@ def test_conflict_confirmation_callback_roundtrip(shim):
         reply = client.call(
             "GetPutInfo", file="w.txt", callback=requester.address
         )
-        assert reply == {"ok": False, "conflict": True}
+        assert (reply["ok"], reply["conflict"]) == (False, True)
     finally:
         requester.stop()
     # no callback, no confirm, no auto-confirm: straight reject
@@ -275,11 +275,11 @@ def test_conflict_confirmation_timeout_rejects():
         t0 = time.monotonic()
         reply = client.call("GetPutInfo", file="t.txt", callback=blackhole)
         elapsed = time.monotonic() - t0
-        assert reply == {"ok": False, "conflict": True}
+        assert (reply["ok"], reply["conflict"]) == (False, True)
         assert 0.9 <= elapsed < 10.0  # the deadline, not a hang
         # connection-refused rejects too (fast-fail flavour of no answer)
         reply = client.call("GetPutInfo", file="t.txt", callback="127.0.0.1:9")
-        assert reply == {"ok": False, "conflict": True}
+        assert (reply["ok"], reply["conflict"]) == (False, True)
     finally:
         silent.close()
         client.close()

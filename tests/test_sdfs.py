@@ -338,3 +338,73 @@ class TestCluster:
         # the stale replica self-repaired (slave.go:799-813)
         assert c.stores[stale].get("a.txt") == b"v2"
         assert c.stores[stale].version("a.txt") == 2
+
+
+class TestBatchRepairPlanner:
+    """The vectorized array-diff planner (config-5 scale) makes the same
+    DECISIONS as the per-file loop: same deficient files, same sources,
+    same copy counts, valid candidates — only the uniform draws differ."""
+
+    def _master_with_files(self, n_files, members, seed=5):
+        from gossipfs_tpu.sdfs.master import SDFSMaster
+
+        m = SDFSMaster(seed=seed)
+        m.update_member(members)
+        for f in range(n_files):
+            m.handle_put(f"f{f}.txt", now=0)
+        return m
+
+    def test_batch_matches_loop_decisions(self):
+        import dataclasses
+
+        from gossipfs_tpu.sdfs import master as master_mod
+
+        members = list(range(64))
+        m = self._master_with_files(100, members)  # >= threshold -> batch
+        # clone metadata into a second, loop-path master
+        m2 = master_mod.SDFSMaster(seed=5)
+        m2.update_member(members)
+        m2.files = {
+            k: dataclasses.replace(v, node_list=list(v.node_list))
+            for k, v in m.files.items()
+        }
+        # kill a third of the membership
+        live = [x for x in members if x % 3 != 0]
+        reach = set(live)
+        batch_plans = {p.file: p for p in m.plan_repairs(live, reachable=reach)}
+        old_thresh = master_mod.BATCH_PLAN_THRESHOLD
+        master_mod.BATCH_PLAN_THRESHOLD = 10**9  # force the loop path
+        try:
+            loop_plans = {p.file: p for p in m2.plan_repairs(live, reachable=reach)}
+        finally:
+            master_mod.BATCH_PLAN_THRESHOLD = old_thresh
+        assert set(batch_plans) == set(loop_plans)
+        for name, lp in loop_plans.items():
+            bp = batch_plans[name]
+            assert bp.source == lp.source
+            assert bp.version == lp.version
+            assert set(bp.survivors) == set(lp.survivors)
+            assert len(bp.new_nodes) == len(lp.new_nodes)
+            # picks are valid: reachable, distinct, not already replicas
+            assert len(set(bp.new_nodes)) == len(bp.new_nodes)
+            for node in bp.new_nodes:
+                assert node in reach
+                assert node not in lp.survivors
+
+    def test_batch_no_reachable_source_skips(self):
+        m = self._master_with_files(80, list(range(32)))
+        name = next(iter(m.files))
+        replicas = m.files[name].node_list
+        live = [x for x in range(32) if x != replicas[0]]
+        # reachable excludes every remaining replica of `name`
+        reach = set(live) - set(replicas)
+        plans = m.plan_repairs(live, reachable=reach)
+        assert name not in {p.file for p in plans}
+
+    def test_batch_unrecoverable_file_skipped(self):
+        m = self._master_with_files(70, list(range(16)))
+        name = next(iter(m.files))
+        dead = set(m.files[name].node_list)
+        live = [x for x in range(16) if x not in dead]
+        plans = m.plan_repairs(live)
+        assert name not in {p.file for p in plans}

@@ -1,0 +1,52 @@
+#!/usr/bin/env bash
+# Non-Python gossipfs client: drives the shim's gRPC surface with nothing
+# but protoc and curl (HTTP/2 prior knowledge) — the proof that
+# gossipfs.proto is a codegen-able contract any non-Python consumer can
+# program against (the reference's Go CLI shape; north star "the Go CLI
+# keeps consuming the membership view through a thin gRPC shim").
+#
+# Usage:
+#   tools/gossipfs_sh_client.sh HOST:PORT METHOD REQ_TYPE RESP_TYPE <<< 'textproto'
+#
+# Examples:
+#   tools/gossipfs_sh_client.sh 127.0.0.1:9000 Join NodeRequest OkReply <<< 'node: 3'
+#   tools/gossipfs_sh_client.sh 127.0.0.1:9000 Advance AdvanceRequest AdvanceReply <<< 'rounds: 5'
+#   tools/gossipfs_sh_client.sh 127.0.0.1:9000 Lsm LsmRequest LsmReply <<< 'observer: 0'
+#
+# The request is read as protobuf text format on stdin, encoded with
+# protoc --encode, framed per the gRPC HTTP/2 wire spec (1-byte compressed
+# flag + 4-byte big-endian length + message), POSTed with curl over h2c,
+# and the response frame is decoded back to text format.
+
+set -euo pipefail
+
+ADDR=${1:?usage: $0 HOST:PORT METHOD REQ_TYPE RESP_TYPE}
+METHOD=${2:?method name, e.g. Join}
+REQ_TYPE=${3:?request message type, e.g. NodeRequest}
+RESP_TYPE=${4:?response message type, e.g. OkReply}
+
+HERE=$(cd "$(dirname "$0")" && pwd)
+PROTO_DIR=${GOSSIPFS_PROTO_DIR:-"$HERE/../gossipfs_tpu/shim"}
+PROTO=gossipfs.proto
+
+tmp=$(mktemp -d)
+trap 'rm -rf "$tmp"' EXIT
+
+# request: textproto (stdin) -> binary message -> gRPC length-prefixed frame
+protoc --encode="gossipfs.$REQ_TYPE" -I "$PROTO_DIR" "$PROTO" > "$tmp/msg.bin"
+len=$(stat -c%s "$tmp/msg.bin")
+printf '\x00' > "$tmp/frame.bin"
+for b in $(printf '%08x' "$len" | sed 's/../& /g'); do
+  printf "\\x$b"
+done >> "$tmp/frame.bin"
+cat "$tmp/msg.bin" >> "$tmp/frame.bin"
+
+curl -s --fail --http2-prior-knowledge \
+  -H 'content-type: application/grpc+proto' \
+  -H 'te: trailers' \
+  --data-binary @"$tmp/frame.bin" \
+  "http://$ADDR/gossipfs.Shim/$METHOD" \
+  -o "$tmp/resp.bin"
+
+# response: strip the 5-byte frame header, decode to text format
+tail -c +6 "$tmp/resp.bin" | protoc --decode="gossipfs.$RESP_TYPE" -I "$PROTO_DIR" "$PROTO"
