@@ -244,6 +244,29 @@ def _apply_events(
     intro_alive = alive[intro]
     eff = join & intro_alive  # joins are lost if the introducer is down (SPOF kept)
 
+    hb_base = state.hb_base
+    if hb.dtype == jnp.int16:
+        # join-time column rebase: the fresh incarnation's true hb 0 must be
+        # representable in THIS round's writes — under a base past 32768 the
+        # hz encoding would saturate the join writes to the floor sentinel,
+        # permanently muting the node (it could neither bump nor be
+        # detected).  Joined subjects' columns rebase to 0 here: fresh
+        # entries encode exactly; old-incarnation lanes clip at the int16
+        # ceiling (outside the gossip window, aging, detectable — ordinary
+        # zombies); floor sentinels stay sentinels.
+        new_base = jnp.where(ctx.slice_cols(eff, _nsubj(shp)), 0, hb_base)
+        renorm = _sj(eff, shp, ctx) & (basec != 0)
+        true32 = hb.astype(jnp.int32) + basec
+        sent = hb == jnp.int16(-32768)
+        hb = jnp.where(
+            renorm & ~sent,
+            jnp.clip(true32, -32768, 32767).astype(hb.dtype),
+            hb,
+        )
+        hb_base = new_base
+        basec = new_base.reshape(shp[1:])[None]
+        hz = jnp.clip(-basec, jnp.iinfo(hb.dtype).min, 0).astype(hb.dtype)
+
     # introducer's own row: unconditional append at hb=0
     intro_row_add = eff & (jnp.arange(n) != intro)
     intro_sel = _rx(jnp.arange(n) == intro, nd) & _sj(intro_row_add, shp, ctx)
@@ -271,7 +294,9 @@ def _apply_events(
     hb = jnp.where(self_sel, hz, hb)
 
     alive = alive | eff
-    return state._replace(hb=hb, age=age, status=status, alive=alive)
+    return state._replace(
+        hb=hb, age=age, status=status, alive=alive, hb_base=hb_base
+    )
 
 
 def _pre_tick(
@@ -285,16 +310,20 @@ def _pre_tick(
       from the per-receiver member counts (slave.go:504-511).  Cross-shard
       under run_rounds_sharded: each shard holds a column slice, so the
       row-sum needs a psum.
-    * ``colmax_est``: per-subject upper bound on the freshest gossip-eligible
-      true counter *after* the tick's bump — the anchor for this round's
-      view/storage rebase (see ``_merge``).  Computed pre-tick so the whole
-      tick + view build can stream in a single fused pass: the estimate is
-      the pre-tick eligible max plus one (the bump adds at most 1/round to
-      any subject's freshest copy).  Eligibility here is alive-receiver
-      MEMBER entries — a superset of post-tick sender eligibility, so the
-      estimate can only exceed the true colmax, shrinking the rebase window
-      by the excess (bounded by 1 except for subjects losing their freshest
-      copy this very round); the config validation margins absorb it.
+    * ``colmax_est``: per-subject upper bound on the freshest *legitimate*
+      true counter after the tick's bump — the anchor for this round's
+      view/storage rebase (see ``_merge``).  Anchored on the DIAGONAL:
+      a subject's own self-entry is the only source of increments, so
+      every current-incarnation copy anywhere satisfies
+      ``copy <= hb[j, j]``, making ``diag + 1`` an exact post-bump bound —
+      and, unlike a column max, one a *rejoin cannot inflate*: the join
+      resets row j (diagonal included), so the fresh incarnation's hb=0
+      entries are in-window immediately, while zombie copies of the old
+      incarnation (now above the window top) are excluded from gossip by
+      the view clamp in ``_merge`` and age out.  This supersedes the
+      reference's incarnation-free max-merge ambiguity
+      (slave.go:419-424) instead of inheriting it, and costs an [N]
+      gather instead of an [N, N] reduction.
     """
     hb, status, alive = state.hb, state.status, state.alive
     nd, shp = hb.ndim, hb.shape
@@ -306,23 +335,15 @@ def _pre_tick(
     refresher = alive & small
 
     basec = state.hb_base.reshape(shp[1:])  # subject-shaped; zero in int32 mode
-    elig = _rx(alive, nd) & (status == MEMBER)
-    # true colmax over eligible copies ('true hb 0' filler via -basec), +1.
-    # int16 mode reduces in the stored dtype (XLA packs narrow-int
-    # elementwise/reduce ops 2-4x denser than int32 — the round is
-    # ALU-bound): the filler clips at the int16 floor, which can only
-    # matter for a subject with NO eligible copy and basec > 32768, where
-    # nothing downstream observes the difference (no sender gossips such a
-    # subject, so every consumer of its shifts sees masked lanes only).
-    if hb.dtype == jnp.int16:
-        filler = jnp.clip(-basec, -32768, 32767).astype(jnp.int16)
-        cm = jnp.max(jnp.where(elig, hb, filler[None]), axis=0)
-        colmax_est = cm.astype(jnp.int32) + basec + 1
+    nloc = _nsubj(shp)
+    cols = ctx.offset + jnp.arange(nloc)  # global row index of each local subject
+    if nd == 2:
+        diag = hb[cols, jnp.arange(nloc)]
     else:
-        colmax_est = (
-            jnp.max(jnp.where(elig, hb.astype(jnp.int32), -basec[None]), axis=0)
-            + basec + 1
-        )
+        _, nc, cs, lane = shp
+        j = jnp.arange(nloc)
+        diag = hb[cols, j // (cs * lane), (j % (cs * lane)) // lane, j % lane]
+    colmax_est = (diag.astype(jnp.int32) + basec.reshape(-1) + 1).reshape(shp[1:])
     return active, refresher, colmax_est
 
 
@@ -445,20 +466,14 @@ def _merge(
     # counts are rebased per subject so the view fits a narrow dtype
     # (config.view_dtype: int16, or int8 for random topologies), shrinking
     # the HBM traffic of the F-way gather — the round's dominant cost — by
-    # 2-4x over int32.  The base anchors on ``colmax_est`` (see ``_pre_tick``)
-    # which is derived from *gossip-eligible* copies only: hb lanes of
-    # FAILED/UNKNOWN entries and dead nodes' frozen rows keep crash-time
-    # counters forever, and anchoring on those would mask a rejoining node's
-    # fresh hb=0 entries out of gossip once the run is > rebase_window
-    # rounds old.
-    # Gossip-eligible entries (MEMBER, so age <= t_fail at the holder) lag
-    # the freshest eligible copy by O(t_fail) per hop, so same-incarnation
-    # copies never fall rebase_window behind.  The one reachable clamp: a
-    # rejoin while a zombie MEMBER copy of the old incarnation (counter
-    # > rebase_window ahead) survives somewhere — the fresh entries drop out
-    # of gossip, but the reference's incarnation-free max-merge dominates
-    # those counts anyway (slave.go:419-424); dissemination rides the
-    # introducer's join broadcast in both worlds.
+    # 2-4x over int32.  The base anchors on ``colmax_est`` — the subject's
+    # own diagonal counter + 1 (see ``_pre_tick``) — so only
+    # current-incarnation values are ever in-window: entries MORE than the
+    # window ahead of the subject's own counter are zombie copies of an
+    # older incarnation, excluded from gossip by the top clamp below (they
+    # never refresh, age out at their holders, and cannot be re-added).
+    # In-window entries lag the diagonal by O(t_fail) per hop, far inside
+    # the window for the random topologies the narrow dtypes validate for.
     nd = hb.ndim
     hb16 = hb.dtype == jnp.int16
     basec = state.hb_base.reshape(hb.shape[1:])  # subject-shaped, all-zero in int32 mode
@@ -469,14 +484,15 @@ def _merge(
     # renormalizes every stored value to this round's base, which is what
     # keeps int16 storage in range with no separate renormalization pass.
     if hb16:
-        # monotone per subject: colmax can collapse when a subject loses all
-        # gossip-eligible copies (crash, sub-min_group cluster), and a base
-        # decrease would shift stored values UP — un-saturating the int16
-        # floor sentinel and clipping live counters at +32767.  A
-        # never-decreasing base keeps every live lane within
-        # [base, base + REBASE_WINDOW] by construction, so the narrow store
-        # can only saturate on don't-care lanes (below base).
-        store_base = jnp.maximum(jnp.maximum(colmax - REBASE_WINDOW, 0), basec)
+        # tracks the diagonal, DOWN included: a rejoin resets the subject's
+        # counter to 0 and the base follows, so the fresh incarnation's
+        # entries are immediately representable.  Old-incarnation lanes
+        # renormalize above the window and saturate at the int16 ceiling —
+        # still past the detection grace, still aging, still clamped out of
+        # gossip — so they die at their holders exactly like any silent
+        # peer.  (The previous monotone base instead pinned rejoins below
+        # the window — the round-1 zombie-rejoin deferral this replaces.)
+        store_base = jnp.maximum(colmax - REBASE_WINDOW, 0)
     else:
         store_base = jnp.zeros_like(basec)
     shift_a = view_base - basec
@@ -493,16 +509,32 @@ def _merge(
         # into int16 (a clipped threshold admits all / none exactly like
         # the unclipped int32 compare would).  Invariants keeping true
         # results in range: gossiped lanes have rel in [0, rebase_window]
-        # (window invariant), and shift_a <= ~REBASE_WINDOW + slack.
+        # (enforced by the window compares — the top side excludes
+        # old-incarnation zombie lanes), and shift_a <= ~REBASE_WINDOW +
+        # slack (both bases derive from the diagonal).
         sa16 = shift_a.astype(jnp.int16)
         # shift_a below int16 range => every stored value >= it
         sa_all = (shift_a < -32768).reshape(hb.shape[1:])[None]
-        gossiped = elig & ((hb >= sa16[None]) | sa_all)
+        # legit lanes are <= the post-bump diagonal (== colmax_est), which
+        # maps to rel == window exactly; anything above is an
+        # old-incarnation zombie (rel fits the view dtype: window is 126
+        # for int8, max 127)
+        hi = shift_a + config.rebase_window  # <= ~16.5k: int16-exact
+        hi16 = jnp.clip(hi, -32768, 32767).astype(jnp.int16)
+        # floor sentinels carry no counter and never gossip — without the
+        # explicit mask a deeply negative shift_a (sa_all) would admit them
+        # and emit wrapped garbage rel values
+        gossiped = (
+            elig
+            & ((hb >= sa16[None]) | sa_all)
+            & (hb <= hi16[None])
+            & (hb != jnp.int16(-32768))
+        )
         rel = hb - sa16[None]  # exact on gossiped lanes; masked elsewhere
         view = jnp.where(gossiped, rel, jnp.int16(-1)).astype(vdtype)
     else:
         rel = hb.astype(jnp.int32) - shift_a[None]
-        gossiped = elig & (rel >= 0)
+        gossiped = elig & (rel >= 0) & (rel <= config.rebase_window)
         view = jnp.where(gossiped, rel, -1).astype(vdtype)
     # Both paths include the post-merge global age advance (everything not
     # refreshed this round ages by one, saturating at AGE_CLAMP) so the
@@ -599,13 +631,24 @@ def _merge(
             up_val = jnp.where(
                 up_sat, jnp.int16(-32768), best16 + d32.astype(jnp.int16)[None]
             )
-            # kept value hb - shift_b (shift_b >= 0: base is monotone):
-            # saturates when hb - shift_b < -32768, i.e. hb <= sb - 32769
+            # kept value hb - shift_b.  shift_b can be NEGATIVE now (the
+            # base follows the diagonal down on rejoin), so both clip sides
+            # need guards: bottom-saturate (-> the floor sentinel) when
+            # hb <= sb - 32769; top-saturate (old-incarnation zombie lanes
+            # renormalizing above the ceiling) when hb >= 32768 + sb, only
+            # reachable for sb < 0.
             keep_thr = jnp.clip(sb32 - 32769, -32768, 32767).astype(jnp.int16)
+            hi_thr = jnp.clip(32768 + sb32, -32768, 32767).astype(jnp.int16)
+            has_hi = (sb32 < 0).reshape(hb.shape[1:])[None]
+            keep_val = jnp.where(
+                has_hi & (hb >= hi_thr.reshape(hb.shape[1:])[None]),
+                jnp.int16(32767),
+                hb - sb32.astype(jnp.int16)[None],
+            )
             keep_val = jnp.where(
                 hb <= keep_thr.reshape(hb.shape[1:])[None],
                 jnp.int16(-32768),
-                hb - sb32.astype(jnp.int16)[None],
+                keep_val,
             )
             hb = jnp.where(upd, up_val, keep_val)
         else:

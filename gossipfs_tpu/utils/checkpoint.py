@@ -132,30 +132,28 @@ def restore_checkpoint(
     # fresh base instead of silently wrapping.
     true_hb = restored["state"]["hb"] + restored["state"]["hb_base"][None, :]
     if config.hb_dtype == "int16":
-        # Mirror _merge's gossip-eligibility filter when anchoring the
-        # restore base: FAILED/UNKNOWN entries and dead nodes' frozen rows
-        # keep crash-time counters forever, and since store_base is monotone
-        # a base inflated by such a zombie lane at restore time would be
-        # permanent — pinning a rejoined subject's fresh entries below base
-        # (saturated, out of gossip).  Subjects with no eligible copy fall
-        # back to the 'true hb 0' filler, exactly like the in-round colmax.
-        from gossipfs_tpu.core.state import MEMBER as _MEMBER
-
-        elig = (restored["state"]["status"] == _MEMBER) & restored["state"][
-            "alive"
-        ][:, None]
-        elig_max = jnp.max(jnp.where(elig, true_hb, 0), axis=0)
-        # never DECREASE below the checkpoint's own base either: a lower
-        # base would re-encode int16 floor-sentinel lanes (unknown-counter
-        # markers) as ordinary values inflated by base - 32768 — the exact
-        # resurrection the sticky-sentinel bump guard in _tick prevents
-        new_base = jnp.maximum(
-            jnp.maximum(elig_max - REBASE_WINDOW, 0),
-            restored["state"]["hb_base"],
+        # Anchor the restore base exactly like the in-round rebase
+        # (core/rounds._pre_tick): on the subject's own DIAGONAL counter —
+        # the only legitimate maximum of the current incarnation.  Zombie
+        # lanes above it re-encode at the int16 ceiling (out of gossip via
+        # the view window clamp, still detectable) and neither they nor the
+        # base can mute a rejoin.  Floor sentinels from int16-era
+        # checkpoints (stored == -32768 under a positive base: unknown
+        # counters, not values) stay sentinels — re-encoding them against a
+        # LOWER base would otherwise fabricate ordinary counters.
+        sentinel = (restored["state"]["hb"] == -32768) & (
+            restored["state"]["hb_base"][None, :] > 0
         )
-        restored["state"]["hb"] = jnp.clip(
-            true_hb - new_base[None, :], -32768, 32767
-        ).astype(jnp.int16)
+        n_ck = true_hb.shape[0]
+        diag = true_hb[jnp.arange(n_ck), jnp.arange(n_ck)]
+        new_base = jnp.maximum(diag + 1 - REBASE_WINDOW, 0)
+        restored["state"]["hb"] = jnp.where(
+            sentinel,
+            jnp.int16(-32768),
+            jnp.clip(true_hb - new_base[None, :], -32768, 32767).astype(
+                jnp.int16
+            ),
+        )
         restored["state"]["hb_base"] = new_base
     else:
         restored["state"]["hb"] = true_hb

@@ -9,6 +9,15 @@ entry-for-entry every round on small N ("golden-trace equivalence", SURVEY §4).
 Synchronous-rounds semantics identical to gossipfs_tpu.core.rounds:
 events -> tick (refresh/bump/detect/remove-broadcast/cooldown) -> merge -> age+1.
 Only rows of *alive* nodes are meaningful (dead processes don't run).
+
+One deliberate supersession of the reference is modeled here too: gossip
+carries only entries within ``config.rebase_window`` of the subject's own
+(post-bump) counter.  In-window this is invisible — same-incarnation
+copies lag by O(t_fail) hops — but copies of an OLD incarnation more than
+a window ahead are excluded instead of dominating the reference's
+incarnation-free max-merge (slave.go:419-424), which is what lets the
+narrow-dtype rebased storage resolve zombie-rejoin instead of inheriting
+the ambiguity (core/rounds.py `_pre_tick`/`_merge`).
 """
 
 from __future__ import annotations
@@ -97,6 +106,11 @@ class NaiveSim:
             self.tables[j] = row
             self.alive[j] = True
 
+        # the gossip window anchors on each subject's own pre-tick counter
+        # + 1 (== post-bump when the subject bumps); captured post-events so
+        # a join's row reset takes effect immediately
+        prediag = [self.tables[j][j].hb for j in range(n)]
+
         # tick
         active = [False] * n
         fails = []
@@ -157,6 +171,13 @@ class NaiveSim:
                 for j in range(n):
                     se = snapshot[k][j]
                     if se.status != MEMBER:
+                        continue
+                    # window rule: gossip carries values in
+                    # [view_base, view_base + window], the view's exact
+                    # representable range (zombie exclusion only once the
+                    # base has lifted off zero)
+                    vb = max(prediag[j] + 1 - cfg.rebase_window, 0)
+                    if se.hb < vb or se.hb > vb + cfg.rebase_window:
                         continue
                     e = self.tables[i][j]
                     if e.status == MEMBER and se.hb > e.hb:

@@ -1,0 +1,139 @@
+"""Randomized golden-parity fuzz (VERDICT round-1 #6).
+
+Seeded random event schedules — crash/leave/join storms, introducer kill,
+rejoin-while-cooling races — swept across {ring, random, random_arc}
+topologies and {int32, int16} heartbeat storage x {int16, int8} view
+dtypes, checked entry-for-entry against the naive per-node oracle every
+round.  This is exactly the corner territory of the narrow-dtype rebase
+logic that the hand-picked golden schedules miss.
+"""
+
+from __future__ import annotations
+
+import random as pyrandom
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gossipfs_tpu.config import SimConfig
+from gossipfs_tpu.core.rounds import gossip_round
+from gossipfs_tpu.core.state import RoundEvents, init_state
+from gossipfs_tpu.core import topology
+from reference_model import NaiveSim
+
+
+def random_schedule(rng: pyrandom.Random, n: int, rounds: int,
+                    kill_introducer: bool) -> dict[int, dict]:
+    """Seeded event schedule: sparse storms of every event type.
+
+    Joins target recently-dead nodes with bias, so rejoin-while-cooling
+    (the zombie corner) is exercised constantly.
+    """
+    schedule: dict[int, dict] = {}
+    recently_dead: list[int] = []
+    for r in range(3, rounds):
+        ev = {"crash": [], "leave": [], "join": []}
+        if rng.random() < 0.10:
+            ev["crash"] = rng.sample(range(1, n), k=rng.randint(1, 3))
+            recently_dead.extend(ev["crash"])
+        if rng.random() < 0.06:
+            ev["leave"] = [rng.randrange(1, n)]
+            recently_dead.append(ev["leave"][0])
+        if rng.random() < 0.12 and recently_dead:
+            # bias toward the most recent corpse: rejoin while others are
+            # still cooling on its old entry
+            pick = recently_dead[-1] if rng.random() < 0.5 else rng.choice(recently_dead)
+            ev["join"] = [pick]
+        if kill_introducer and r == rounds // 2:
+            ev["crash"] = sorted(set(ev["crash"]) | {0})
+        if any(ev.values()):
+            schedule[r] = ev
+    return schedule
+
+
+def to_events(n: int, ev: dict) -> RoundEvents:
+    def m(idx):
+        a = np.zeros(n, dtype=bool)
+        if idx:
+            a[list(idx)] = True
+        return jnp.asarray(a)
+
+    return RoundEvents(crash=m(ev.get("crash", [])), leave=m(ev.get("leave", [])),
+                       join=m(ev.get("join", [])))
+
+
+def compare(state, naive, where: str) -> None:
+    n = state.n
+    assert np.array(state.alive).tolist() == naive.alive, f"alive @ {where}"
+    hb = np.array(state.hb_true())  # absolute counters whatever the storage
+    age = np.array(state.age)
+    status = np.array(state.status)
+    for i in range(n):
+        if not naive.alive[i]:
+            continue  # dead processes don't run; their rows are unspecified
+        row = naive.tables[i]
+        for j in range(n):
+            e = row[j]
+            assert status[i][j] == e.status, f"status[{i},{j}] @ {where}"
+            if e.status != 0:
+                assert hb[i][j] == e.hb, f"hb[{i},{j}] @ {where}"
+                assert age[i][j] == e.age, f"age[{i},{j}] @ {where}"
+
+
+CONFIGS = [
+    # (name, cfg kwargs, kill_introducer)
+    ("ring-i32", dict(n=24), False),
+    ("ring-i32-introkill", dict(n=24), True),
+    ("rand-i32-v16", dict(n=32, topology="random", fanout=5), False),
+    ("rand-i32-v8", dict(n=32, topology="random", fanout=5,
+                         view_dtype="int8"), False),
+    ("rand-i16-v16", dict(n=32, topology="random", fanout=5,
+                          hb_dtype="int16"), False),
+    ("rand-i16-v8", dict(n=48, topology="random", fanout=6,
+                         hb_dtype="int16", view_dtype="int8"), False),
+    ("rand-i16-v8-introkill", dict(n=32, topology="random", fanout=5,
+                                   hb_dtype="int16", view_dtype="int8"), True),
+    ("arc-i32-v16", dict(n=32, topology="random_arc", fanout=5), False),
+    ("arc-i16-v8", dict(n=64, topology="random_arc", fanout=6,
+                        hb_dtype="int16", view_dtype="int8"), False),
+    ("nobcast-i16-v8", dict(n=32, topology="random", fanout=5,
+                            remove_broadcast=False, fresh_cooldown=True,
+                            hb_dtype="int16", view_dtype="int8"), False),
+]
+
+
+@pytest.mark.parametrize("name,kwargs,introkill", CONFIGS,
+                         ids=[c[0] for c in CONFIGS])
+@pytest.mark.parametrize("seed", [1, 2])
+def test_fuzz_matches_oracle(name, kwargs, introkill, seed):
+    cfg = SimConfig(**kwargs)
+    rounds = 200
+    rng = pyrandom.Random(1000 * seed + len(name))
+    schedule = random_schedule(rng, cfg.n, rounds, introkill)
+    state = init_state(cfg)
+    naive = NaiveSim(cfg)
+    key = jax.random.PRNGKey(seed)
+    for r in range(rounds):
+        ev = schedule.get(r, {})
+        events = to_events(cfg.n, ev)
+        k = jax.random.fold_in(key, r)
+        if cfg.topology == "ring":
+            edges = None
+            oracle_edges = None
+        else:
+            edges = topology.in_edges(cfg, k, None)
+            oracle_edges = (
+                np.array(topology.arc_edges(edges, cfg.fanout))
+                if cfg.topology == "random_arc"
+                else np.array(edges)
+            )
+        state, _, _ = gossip_round(state, events, edges, cfg)
+        naive.step(oracle_edges, crash=ev.get("crash", []),
+                   leave=ev.get("leave", []), join=ev.get("join", []))
+        # compare every 5 rounds (and right after event rounds) — full
+        # entry-for-entry comparison is O(N^2) Python per round
+        if r % 5 == 0 or r in schedule or (r - 1) in schedule:
+            compare(state, naive, where=f"{name} seed={seed} round {r}")
+    compare(state, naive, where=f"{name} seed={seed} final")

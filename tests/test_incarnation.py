@@ -1,0 +1,137 @@
+"""Zombie-rejoin corner: diagonal-anchored rebase kills it (VERDICT #7).
+
+Round 1 deferred two related corners (PARITY.md):
+
+* int16 storage: the per-subject store base was MONOTONE, so a node
+  rejoining after the base had climbed past 32768 (reachable within the
+  soak's own horizon) had its fresh hb=0 entries clamp to the floor
+  sentinel — permanently out of gossip and detection ("per-incarnation
+  lifetime bound").
+* int8 view: a rejoin while zombie MEMBER copies of the old incarnation
+  (counters > the 126-round window ahead) survive anchored the view base
+  on the zombies, clamping the fresh entries out of the gossip view.
+
+The diagonal-anchored rebase (core/rounds._pre_tick) resolves both: the
+base follows the subject's OWN counter — down included — so a rejoin
+resets it; old-incarnation lanes renormalize above the window, are
+excluded from gossip by the view clamp, and age out at their holders.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gossipfs_tpu.config import SimConfig
+from gossipfs_tpu.core.rounds import run_rounds
+from gossipfs_tpu.core.state import MEMBER, RoundEvents, init_state
+
+KEY = jax.random.PRNGKey(4)
+
+
+def scheduled(n, rounds, crash_at=None, crash=(), join_at=None, join=()):
+    c = np.zeros((rounds, n), dtype=bool)
+    j = np.zeros((rounds, n), dtype=bool)
+    if crash_at is not None:
+        c[crash_at, list(crash)] = True
+    if join_at is not None:
+        j[join_at, list(join)] = True
+    z = jnp.zeros((rounds, n), dtype=bool)
+    return RoundEvents(crash=jnp.asarray(c), leave=z, join=jnp.asarray(j))
+
+
+@pytest.mark.parametrize("base_val", [40_000, 60_000])
+def test_int16_rejoin_under_high_base_recovers(base_val):
+    """The permanent round-1 corner: rejoin with the store base past the
+    int16 floor's reach.  The state is constructed as a run ~40k/60k rounds
+    in (true counters = base_val, stored relative to base_val - window).
+    base_val=60,000 puts the base itself beyond 32,768 — the regime where
+    the hz join-encoding saturates and only the join-time column rebase
+    (core/rounds._apply_events) keeps the fresh incarnation representable;
+    the old monotone base bricked such rejoins permanently."""
+    from gossipfs_tpu.config import REBASE_WINDOW
+
+    n = 16
+    cfg = SimConfig(
+        n=n, topology="random", fanout=4, remove_broadcast=False,
+        fresh_cooldown=True, t_cooldown=12, view_dtype="int8",
+        hb_dtype="int16",
+    )
+    state = init_state(cfg)
+    state = state._replace(
+        # true counter = stored + base = 40,000 for every entry
+        hb=jnp.full_like(state.hb, REBASE_WINDOW - 1),
+        hb_base=jnp.full_like(state.hb_base, base_val - (REBASE_WINDOW - 1)),
+    )
+    assert int(np.asarray(state.hb_true())[0, 0]) == base_val
+
+    # crash node 5, let detection + cooldown fully expire its old entries
+    state, _, _ = run_rounds(
+        state, cfg, 25, KEY, events=scheduled(n, 25, crash_at=0, crash=[5])
+    )
+    assert not bool(np.asarray(state.alive)[5])
+    # rejoin: the new incarnation starts at hb 0, ~40k below the old base
+    state, _, _ = run_rounds(
+        state, cfg, 30, KEY, events=scheduled(n, 30, join_at=0, join=[5])
+    )
+    status = np.asarray(state.status)
+    true_hb = np.asarray(state.hb_true())
+    assert bool(np.asarray(state.alive)[5])
+    # the base followed the diagonal down
+    assert int(np.asarray(state.hb_base)[5]) == 0
+    for obs in range(n):
+        assert status[obs, 5] == int(MEMBER), f"observer {obs} lost node 5"
+        # fresh-incarnation counters (~30 bumps), not sentinels, not zombies
+        assert 1 <= true_hb[obs, 5] <= 60, (obs, true_hb[obs, 5])
+    # dissemination is live gossip, not just the introducer's one-shot push
+    assert true_hb[1, 5] >= true_hb[5, 5] - 15
+
+
+def test_int8_view_rejoin_while_zombie_member_copies_live():
+    """The transient view corner: rejoin a few rounds after the crash,
+    while the holders' MEMBER copies still carry the old incarnation's
+    counter (> window ahead of the fresh hb=0).  The view base must follow
+    the fresh incarnation immediately — the zombies must neither clamp the
+    fresh entries out of gossip nor resurrect the old counter."""
+    n = 16
+    cfg = SimConfig(
+        n=n, topology="random", fanout=4, remove_broadcast=False,
+        fresh_cooldown=True, t_cooldown=12, view_dtype="int8",
+    )
+    state = init_state(cfg)
+    # 200 quiet rounds: counters ~200, beyond the 126-round int8 window
+    state, _, _ = run_rounds(state, cfg, 200, KEY)
+    assert int(np.asarray(state.hb_true())[0, 0]) > 130
+    # crash 5, rejoin 3 rounds later — before detection (t_fail=5) fires,
+    # so every holder still has a MEMBER zombie copy at ~200
+    ev = scheduled(n, 40, crash_at=0, crash=[5], join_at=3, join=[5])
+    state, _, _ = run_rounds(state, cfg, 40, KEY, events=ev)
+    status = np.asarray(state.status)
+    true_hb = np.asarray(state.hb_true())
+    assert bool(np.asarray(state.alive)[5])
+    for obs in range(n):
+        assert status[obs, 5] == int(MEMBER), f"observer {obs} lost node 5"
+        # fresh incarnation's counter (< 40), not the ~200 zombie value
+        assert 1 <= true_hb[obs, 5] <= 60, (obs, true_hb[obs, 5])
+
+
+def test_zombie_copies_cannot_readd_dead_node():
+    """Zombie values are clamped out of the gossip view entirely: stale
+    copies of a long-dead node can never re-add it."""
+    n = 16
+    cfg = SimConfig(
+        n=n, topology="random", fanout=4, remove_broadcast=False,
+        fresh_cooldown=True, t_cooldown=12, view_dtype="int8",
+    )
+    state = init_state(cfg)
+    state, _, _ = run_rounds(state, cfg, 200, KEY)
+    dead = [x for x in range(n) if x not in (0, 1, 2, 3)]
+    state, _, _ = run_rounds(
+        state, cfg, 60, KEY, events=scheduled(n, 60, crash_at=0, crash=dead)
+    )
+    status = np.asarray(state.status)
+    for obs in (0, 1, 2, 3):
+        for subj in dead:
+            assert status[obs, subj] != int(MEMBER), (obs, subj)
