@@ -53,6 +53,17 @@ def variants(n: int) -> dict[str, SimConfig]:
             cfg, topology="random_arc", merge_kernel="pallas_stripe",
             merge_block_c=STRIPE_BLOCK_C,
         )
+        out["stripe_hb8"] = dataclasses.replace(
+            cfg, merge_kernel="pallas_stripe", merge_block_c=STRIPE_BLOCK_C,
+            hb_dtype="int8",
+        )
+        out["arc_hb8"] = dataclasses.replace(
+            cfg, topology="random_arc", merge_kernel="pallas_stripe",
+            merge_block_c=STRIPE_BLOCK_C, hb_dtype="int8",
+        )
+        out["arc_hb8_xla"] = dataclasses.replace(
+            cfg, topology="random_arc", merge_kernel="xla", hb_dtype="int8",
+        )
     return out
 
 

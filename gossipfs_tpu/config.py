@@ -107,7 +107,13 @@ class SimConfig:
                                      # every round by the merge — halves the
                                      # fattest lane's HBM traffic and memory;
                                      # random topologies only, same lag
-                                     # argument as the view rebase)
+                                     # argument as the view rebase) | "int8"
+                                     # (storage window == the int8 view's
+                                     # 126 rounds: every matrix lane is then
+                                     # int8, which lets XLA pack the
+                                     # ALU-bound round 4x denser AND fuse
+                                     # the epilogue's outputs into one pass;
+                                     # requires view_dtype="int8")
 
     def __post_init__(self) -> None:
         if self.n < 2:
@@ -162,12 +168,18 @@ class SimConfig:
                 )
         if self.view_dtype not in ("int16", "int8"):
             raise ValueError(f"unknown view_dtype: {self.view_dtype!r}")
-        if self.hb_dtype not in ("int32", "int16"):
+        if self.hb_dtype not in ("int32", "int16", "int8"):
             raise ValueError(f"unknown hb_dtype: {self.hb_dtype!r}")
-        if self.hb_dtype == "int16" and self.topology == "ring":
-            # stored counters sit within REBASE_WINDOW of the per-subject
+        if self.hb_dtype != "int32" and self.topology == "ring":
+            # stored counters sit within a rebase window of the per-subject
             # maximum; ring lag grows ~N/2 and can cross that window
-            raise ValueError("hb_dtype='int16' requires topology='random'")
+            raise ValueError(
+                f"hb_dtype={self.hb_dtype!r} requires a random topology"
+            )
+        if self.hb_dtype == "int8" and self.view_dtype != "int8":
+            # the narrow arithmetic's overflow-freedom relies on the view
+            # and storage windows coinciding (shift_a <= diagonal advance)
+            raise ValueError("hb_dtype='int8' requires view_dtype='int8'")
         if self.view_dtype == "int8":
             if self.topology == "ring":
                 # steady-state ring lag grows with graph distance (~N/2

@@ -35,7 +35,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from gossipfs_tpu.config import AGE_CLAMP, REBASE_WINDOW, SimConfig
+from gossipfs_tpu.config import (
+    AGE_CLAMP,
+    INT8_REBASE_WINDOW,
+    REBASE_WINDOW,
+    SimConfig,
+)
 from gossipfs_tpu.core import topology
 from gossipfs_tpu.core.state import FAILED, MEMBER, UNKNOWN, RoundEvents, SimState
 
@@ -245,27 +250,28 @@ def _apply_events(
     eff = join & intro_alive  # joins are lost if the introducer is down (SPOF kept)
 
     hb_base = state.hb_base
-    if hb.dtype == jnp.int16:
+    if hb.dtype != jnp.int32:
         # join-time column rebase: the fresh incarnation's true hb 0 must be
-        # representable in THIS round's writes — under a base past 32768 the
-        # hz encoding would saturate the join writes to the floor sentinel,
-        # permanently muting the node (it could neither bump nor be
-        # detected).  Joined subjects' columns rebase to 0 here: fresh
-        # entries encode exactly; old-incarnation lanes clip at the int16
-        # ceiling (outside the gossip window, aging, detectable — ordinary
-        # zombies); floor sentinels stay sentinels.
+        # representable in THIS round's writes — under a base beyond the
+        # storage range the hz encoding would saturate the join writes to
+        # the floor sentinel, permanently muting the node (it could neither
+        # bump nor be detected).  Joined subjects' columns rebase to 0
+        # here: fresh entries encode exactly; old-incarnation lanes clip at
+        # the storage ceiling (outside the gossip window, aging, detectable
+        # — ordinary zombies); floor sentinels stay sentinels.
+        info = jnp.iinfo(hb.dtype)
         new_base = jnp.where(ctx.slice_cols(eff, _nsubj(shp)), 0, hb_base)
         renorm = _sj(eff, shp, ctx) & (basec != 0)
         true32 = hb.astype(jnp.int32) + basec
-        sent = hb == jnp.int16(-32768)
+        sent = hb == info.min
         hb = jnp.where(
             renorm & ~sent,
-            jnp.clip(true32, -32768, 32767).astype(hb.dtype),
+            jnp.clip(true32, info.min, info.max).astype(hb.dtype),
             hb,
         )
         hb_base = new_base
         basec = new_base.reshape(shp[1:])[None]
-        hz = jnp.clip(-basec, jnp.iinfo(hb.dtype).min, 0).astype(hb.dtype)
+        hz = jnp.clip(-basec, info.min, 0).astype(hb.dtype)
 
     # introducer's own row: unconditional append at hb=0
     intro_row_add = eff & (jnp.arange(n) != intro)
@@ -372,13 +378,13 @@ def _tick(
     # list (updateMemberList matches by address, slave.go:443-448; a node that
     # processed a REMOVE about itself stops bumping)
     bump = eye & _rx(active, nd) & (status == MEMBER)
-    if hb.dtype == jnp.int16:
+    if hb.dtype != jnp.int32:
         # entries saturated at the storage floor hold unknown true counters
         # (the zombie-rejoin corner): a bump would move the lane off the
         # sentinel and resurrect a counter inflated by base - 32768.  Keep
         # the sentinel sticky — the entry stays excluded from gossip and
         # detection until the introducer's join push rewrites it.
-        bump &= hb != jnp.iinfo(jnp.int16).min
+        bump &= hb != jnp.iinfo(hb.dtype).min
     hb = hb + bump.astype(hb.dtype)
     age = jnp.where(bump, 0, age)
 
@@ -390,14 +396,15 @@ def _tick(
     # have unknown true counters and are excluded (the zombie-rejoin
     # corner, same class as the view-rebase clamp in _merge)
     basec = state.hb_base.reshape(shp[1:])[None]
-    if hb.dtype == jnp.int16:
-        # narrow compare (packed 2x): hb > thr  <=>  hb >= thr+1, with the
-        # int32 threshold clipped into int16 — a threshold below the int16
-        # floor admits every lane, exactly like the int32 compare
-        thr = jnp.clip(config.hb_grace - basec + 1, -32768, 32767).astype(
-            jnp.int16
+    if hb.dtype != jnp.int32:
+        # narrow compare (packed 2-4x): hb > thr  <=>  hb >= thr+1, with
+        # the int32 threshold clipped into the storage dtype — a threshold
+        # below the floor admits every lane, exactly like the int32 compare
+        info = jnp.iinfo(hb.dtype)
+        thr = jnp.clip(config.hb_grace - basec + 1, info.min, info.max).astype(
+            hb.dtype
         )
-        past_grace = (hb >= thr) & (hb != jnp.iinfo(jnp.int16).min)
+        past_grace = (hb >= thr) & (hb != info.min)
     else:
         past_grace = hb > (config.hb_grace - basec)
     fail = (
@@ -475,7 +482,7 @@ def _merge(
     # In-window entries lag the diagonal by O(t_fail) per hop, far inside
     # the window for the random topologies the narrow dtypes validate for.
     nd = hb.ndim
-    hb16 = hb.dtype == jnp.int16
+    narrow = hb.dtype != jnp.int32
     basec = state.hb_base.reshape(hb.shape[1:])  # subject-shaped, all-zero in int32 mode
     colmax = colmax_est
     view_base = jnp.maximum(colmax - config.rebase_window, 0)
@@ -483,16 +490,19 @@ def _merge(
     # B: shift from the old stored base to the new one — the merge write
     # renormalizes every stored value to this round's base, which is what
     # keeps int16 storage in range with no separate renormalization pass.
-    if hb16:
+    if narrow:
         # tracks the diagonal, DOWN included: a rejoin resets the subject's
         # counter to 0 and the base follows, so the fresh incarnation's
         # entries are immediately representable.  Old-incarnation lanes
-        # renormalize above the window and saturate at the int16 ceiling —
+        # renormalize above the window and saturate at the storage ceiling —
         # still past the detection grace, still aging, still clamped out of
         # gossip — so they die at their holders exactly like any silent
         # peer.  (The previous monotone base instead pinned rejoins below
         # the window — the round-1 zombie-rejoin deferral this replaces.)
-        store_base = jnp.maximum(colmax - REBASE_WINDOW, 0)
+        store_window = (
+            REBASE_WINDOW if hb.dtype == jnp.int16 else INT8_REBASE_WINDOW
+        )
+        store_base = jnp.maximum(colmax - store_window, 0)
     else:
         store_base = jnp.zeros_like(basec)
     shift_a = view_base - basec
@@ -501,37 +511,39 @@ def _merge(
     # rebase window (post-tick status, actual senders this round)
     elig = (status == MEMBER) & _rx(senders, nd)
     vdtype = jnp.int8 if config.view_dtype == "int8" else jnp.int16
-    if hb16:
-        # Narrow (packed) arithmetic: int16 ops run 2x denser than int32
-        # on the VPU and the round is ALU-bound.  Mod-2^16 adds/subs are
-        # exact whenever the true int32 result is in range; out-of-range
-        # cases are handled by comparisons against int32 thresholds clipped
-        # into int16 (a clipped threshold admits all / none exactly like
-        # the unclipped int32 compare would).  Invariants keeping true
-        # results in range: gossiped lanes have rel in [0, rebase_window]
-        # (enforced by the window compares — the top side excludes
-        # old-incarnation zombie lanes), and shift_a <= ~REBASE_WINDOW +
-        # slack (both bases derive from the diagonal).
-        sa16 = shift_a.astype(jnp.int16)
-        # shift_a below int16 range => every stored value >= it
-        sa_all = (shift_a < -32768).reshape(hb.shape[1:])[None]
+    if narrow:
+        # Narrow (packed) arithmetic: int16/int8 ops run 2-4x denser than
+        # int32 on the VPU and the round is ALU-bound.  Mod-2^k adds/subs
+        # are exact whenever the true int32 result is in range;
+        # out-of-range cases are handled by comparisons against int32
+        # thresholds clipped into the storage dtype (a clipped threshold
+        # admits all / none exactly like the unclipped int32 compare
+        # would).  Invariants keeping true results in range: gossiped
+        # lanes have rel in [0, rebase_window] (enforced by the window
+        # compares — the top side excludes old-incarnation zombie lanes),
+        # and shift_a <= window + slack (both bases derive from the
+        # diagonal).
+        info = jnp.iinfo(hb.dtype)
+        sa_n = shift_a.astype(hb.dtype)
+        # shift_a below the storage range => every stored value >= it
+        sa_all = (shift_a < info.min).reshape(hb.shape[1:])[None]
         # legit lanes are <= the post-bump diagonal (== colmax_est), which
         # maps to rel == window exactly; anything above is an
         # old-incarnation zombie (rel fits the view dtype: window is 126
         # for int8, max 127)
-        hi = shift_a + config.rebase_window  # <= ~16.5k: int16-exact
-        hi16 = jnp.clip(hi, -32768, 32767).astype(jnp.int16)
+        hi = shift_a + config.rebase_window
+        hi_n = jnp.clip(hi, info.min, info.max).astype(hb.dtype)
         # floor sentinels carry no counter and never gossip — without the
         # explicit mask a deeply negative shift_a (sa_all) would admit them
         # and emit wrapped garbage rel values
         gossiped = (
             elig
-            & ((hb >= sa16[None]) | sa_all)
-            & (hb <= hi16[None])
-            & (hb != jnp.int16(-32768))
+            & ((hb >= sa_n[None]) | sa_all)
+            & (hb <= hi_n[None])
+            & (hb != info.min)
         )
-        rel = hb - sa16[None]  # exact on gossiped lanes; masked elsewhere
-        view = jnp.where(gossiped, rel, jnp.int16(-1)).astype(vdtype)
+        rel = hb - sa_n[None]  # exact on gossiped lanes; masked elsewhere
+        view = jnp.where(gossiped, rel, jnp.asarray(-1, hb.dtype)).astype(vdtype)
     else:
         rel = hb.astype(jnp.int32) - shift_a[None]
         gossiped = elig & (rel >= 0) & (rel <= config.rebase_window)
@@ -601,23 +613,26 @@ def _merge(
         any_member = best_rel >= 0
         recv = _rx(alive, nd)
         add = recv & (status == UNKNOWN) & any_member          # learn new member
-        if hb16:
+        if narrow:
             # narrow-arithmetic epilogue, bit-identical to the int32+clip
             # formulation below (see the mod/threshold argument in the view
             # build).  vmax = top of the view dtype; all int32 threshold
-            # vectors are per-subject (cheap [N] math).
+            # vectors are per-subject (cheap [N] math).  Top-side
+            # exactness of ``lhs``: best <= window and shift_a <= 1 + the
+            # diagonal's per-round advance (both bases derive from the
+            # diagonal), so best + shift_a <= storage max for both the
+            # int16 and int8 modes.
+            info = jnp.iinfo(hb.dtype)
             vmax = jnp.iinfo(vdtype).max
             sb32 = shift_b
             d32 = shift_a - shift_b
-            sa16 = shift_a.astype(jnp.int16)
-            best16 = best_rel.astype(jnp.int16)
-            # advance: best + shift_a > hb over true int32 values.  Top
-            # side cannot overflow (best <= vmax, shift_a <= window +
-            # slack; for the int16 view both windows coincide so shift_a
-            # is tiny).  Bottom side: best + shift_a < -32768 means the
-            # compare is false — mask via a clipped per-subject threshold.
-            cmp_deep = jnp.clip(-32769 - shift_a, -2, vmax).astype(vdtype)
-            lhs = best16 + sa16[None]
+            sa_n = shift_a.astype(hb.dtype)
+            best_n = best_rel.astype(hb.dtype)
+            # advance: best + shift_a > hb over true int32 values.  Bottom
+            # side: best + shift_a < storage floor means the compare is
+            # false — mask via a clipped per-subject threshold.
+            cmp_deep = jnp.clip(info.min - 1 - shift_a, -2, vmax).astype(vdtype)
+            lhs = best_n + sa_n[None]
             advance = (
                 recv & (status == MEMBER) & any_member
                 & (best_rel > cmp_deep.reshape(hb.shape[1:])[None])
@@ -625,29 +640,31 @@ def _merge(
             )
             upd = advance | add
             # updated value best + (shift_a - shift_b): saturates at the
-            # int16 floor when the true value underflows (clip semantics)
-            up_deep = jnp.clip(-32769 - d32, -2, vmax).astype(vdtype)
+            # storage floor when the true value underflows (clip semantics)
+            up_deep = jnp.clip(info.min - 1 - d32, -2, vmax).astype(vdtype)
             up_sat = best_rel <= up_deep.reshape(hb.shape[1:])[None]
             up_val = jnp.where(
-                up_sat, jnp.int16(-32768), best16 + d32.astype(jnp.int16)[None]
+                up_sat,
+                jnp.asarray(info.min, hb.dtype),
+                best_n + d32.astype(hb.dtype)[None],
             )
-            # kept value hb - shift_b.  shift_b can be NEGATIVE now (the
-            # base follows the diagonal down on rejoin), so both clip sides
+            # kept value hb - shift_b.  shift_b can be NEGATIVE (the base
+            # follows the diagonal down on rejoin), so both clip sides
             # need guards: bottom-saturate (-> the floor sentinel) when
-            # hb <= sb - 32769; top-saturate (old-incarnation zombie lanes
-            # renormalizing above the ceiling) when hb >= 32768 + sb, only
-            # reachable for sb < 0.
-            keep_thr = jnp.clip(sb32 - 32769, -32768, 32767).astype(jnp.int16)
-            hi_thr = jnp.clip(32768 + sb32, -32768, 32767).astype(jnp.int16)
+            # hb - sb underflows; top-saturate (old-incarnation zombie
+            # lanes renormalizing above the ceiling) when it overflows,
+            # only reachable for sb < 0.
+            keep_thr = jnp.clip(sb32 + info.min - 1, info.min, info.max).astype(hb.dtype)
+            hi_thr = jnp.clip(sb32 - info.min, info.min, info.max).astype(hb.dtype)
             has_hi = (sb32 < 0).reshape(hb.shape[1:])[None]
             keep_val = jnp.where(
                 has_hi & (hb >= hi_thr.reshape(hb.shape[1:])[None]),
-                jnp.int16(32767),
-                hb - sb32.astype(jnp.int16)[None],
+                jnp.asarray(info.max, hb.dtype),
+                hb - sb32.astype(hb.dtype)[None],
             )
             keep_val = jnp.where(
                 hb <= keep_thr.reshape(hb.shape[1:])[None],
-                jnp.int16(-32768),
+                jnp.asarray(info.min, hb.dtype),
                 keep_val,
             )
             hb = jnp.where(upd, up_val, keep_val)

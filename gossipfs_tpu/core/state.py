@@ -96,7 +96,9 @@ def init_state(config: SimConfig, member_mask: jax.Array | None = None) -> SimSt
     if member_mask is None:
         member_mask = jnp.ones((n,), dtype=bool)
     member_mask = member_mask.astype(bool)
-    hb_dtype = jnp.int16 if config.hb_dtype == "int16" else jnp.int32
+    hb_dtype = {"int32": jnp.int32, "int16": jnp.int16, "int8": jnp.int8}[
+        config.hb_dtype
+    ]
     # i knows j iff both are initial members
     know = member_mask[:, None] & member_mask[None, :]
     return SimState(

@@ -28,7 +28,11 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-from gossipfs_tpu.parallel.mesh import AXIS
+# NOTE: deliberately no gossipfs imports at module level — callers must be
+# able to ``from gossipfs_tpu.parallel import distributed`` and call
+# ``initialize()`` BEFORE anything touches jax computations (several
+# modules build jnp constants at import time, and jax.distributed refuses
+# to initialize after the first computation).
 
 
 def initialize(
@@ -79,4 +83,6 @@ def global_mesh() -> Mesh:
     neighbouring shards share ICI and only shard-boundary collectives
     cross DCN.
     """
+    from gossipfs_tpu.parallel.mesh import AXIS
+
     return Mesh(np.array(jax.devices()), (AXIS,))

@@ -50,7 +50,9 @@ def _abstract_like(config: SimConfig, mesh: Mesh | None) -> dict:
     sh = shardings or SimState(
         hb=None, age=None, status=None, alive=None, round=None, hb_base=None
     )
-    hb_dtype = jnp.int16 if config.hb_dtype == "int16" else jnp.int32
+    hb_dtype = {"int32": jnp.int32, "int16": jnp.int16, "int8": jnp.int8}[
+        config.hb_dtype
+    ]
     state = SimState(
         hb=spec((n, n), hb_dtype, sh.hb),
         age=spec((n, n), jnp.int8, sh.age),
@@ -131,7 +133,7 @@ def restore_checkpoint(
     # requested mode.  Counters above int16 range renormalize against a
     # fresh base instead of silently wrapping.
     true_hb = restored["state"]["hb"] + restored["state"]["hb_base"][None, :]
-    if config.hb_dtype == "int16":
+    if config.hb_dtype != "int32":
         # Anchor the restore base exactly like the in-round rebase
         # (core/rounds._pre_tick): on the subject's own DIAGONAL counter —
         # the only legitimate maximum of the current incarnation.  Zombie
@@ -141,18 +143,32 @@ def restore_checkpoint(
         # checkpoints (stored == -32768 under a positive base: unknown
         # counters, not values) stay sentinels — re-encoding them against a
         # LOWER base would otherwise fabricate ordinary counters.
-        sentinel = (restored["state"]["hb"] == -32768) & (
+        from gossipfs_tpu.config import INT8_REBASE_WINDOW
+
+        tgt = jnp.int16 if config.hb_dtype == "int16" else jnp.int8
+        info = jnp.iinfo(tgt)
+        window = REBASE_WINDOW if config.hb_dtype == "int16" else INT8_REBASE_WINDOW
+        # a narrow-era checkpoint's floor sentinels are stored at the SAVED
+        # dtype's minimum under a positive base (probe the saved dtype from
+        # the checkpoint metadata; default to int16-era)
+        saved_min = -32768
+        try:
+            meta = ocp.StandardCheckpointer().metadata(path)
+            tree = meta.item_metadata if hasattr(meta, "item_metadata") else meta
+            saved_dtype = getattr(tree, "tree", tree)["state"]["hb"].dtype
+            saved_min = jnp.iinfo(saved_dtype).min
+        except Exception:
+            pass
+        sentinel = (restored["state"]["hb"] == saved_min) & (
             restored["state"]["hb_base"][None, :] > 0
         )
         n_ck = true_hb.shape[0]
         diag = true_hb[jnp.arange(n_ck), jnp.arange(n_ck)]
-        new_base = jnp.maximum(diag + 1 - REBASE_WINDOW, 0)
+        new_base = jnp.maximum(diag + 1 - window, 0)
         restored["state"]["hb"] = jnp.where(
             sentinel,
-            jnp.int16(-32768),
-            jnp.clip(true_hb - new_base[None, :], -32768, 32767).astype(
-                jnp.int16
-            ),
+            jnp.asarray(info.min, tgt),
+            jnp.clip(true_hb - new_base[None, :], info.min, info.max).astype(tgt),
         )
         restored["state"]["hb_base"] = new_base
     else:

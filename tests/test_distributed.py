@@ -1,0 +1,102 @@
+"""Multi-process (DCN-path) bring-up: jax.distributed over 2 CPU processes.
+
+Round 1 shipped ``parallel/distributed.py`` untested.  This spawns two real
+Python processes that rendezvous through the env-driven ``initialize()``
+path (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID), build
+the global 2-device mesh, run the sharded scan — whose collectives now
+actually cross process boundaries — and verify each process's addressable
+shard bit-matches the single-device reference run.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+
+WORKER = r"""
+import os
+
+# initialize the distributed runtime FIRST: several gossipfs modules build
+# jnp constants at import, and jax.distributed refuses to start after the
+# first computation
+from gossipfs_tpu.parallel import distributed
+
+ok = distributed.initialize()  # env-driven branch (the untested round-1 path)
+assert ok, "expected distributed mode from env vars"
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from gossipfs_tpu.config import SimConfig
+from gossipfs_tpu.core.rounds import run_rounds
+from gossipfs_tpu.core.state import RoundEvents, init_state
+from gossipfs_tpu.parallel.mesh import run_rounds_sharded, state_shardings
+
+assert jax.process_count() == 2
+mesh = distributed.global_mesh()
+assert mesh.devices.size == 2
+
+cfg = SimConfig(n=256, topology="random", fanout=6)
+rounds = 15
+crash = np.zeros((rounds, cfg.n), dtype=bool)
+crash[3, 7] = True
+z = jnp.zeros((rounds, cfg.n), dtype=bool)
+ev = RoundEvents(crash=jnp.asarray(crash), leave=z, join=z)
+key = jax.random.PRNGKey(11)
+
+state = jax.jit(lambda: init_state(cfg), out_shardings=state_shardings(mesh))()
+got, mc, pr = run_rounds_sharded(state, cfg, rounds, key, mesh, events=ev)
+
+ref, mc_ref, pr_ref = run_rounds(init_state(cfg), cfg, rounds, key, events=ev)
+for arr, full in ((got.hb, ref.hb), (got.status, ref.status), (got.age, ref.age)):
+    want = np.asarray(full)
+    for shard in arr.addressable_shards:
+        np.testing.assert_array_equal(np.asarray(shard.data), want[shard.index])
+print("DIST-OK", jax.process_index(), flush=True)
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_cpu_mesh(tmp_path):
+    port = _free_port()
+    env_base = dict(os.environ)
+    env_base.pop("PALLAS_AXON_POOL_IPS", None)
+    env_base.update(
+        JAX_PLATFORMS="cpu",
+        JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+        JAX_NUM_PROCESSES="2",
+        # one device per process (the parent test env forces 8 virtual
+        # devices, which would make the global mesh 16-wide)
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+    )
+    procs = []
+    for pid in range(2):
+        env = dict(env_base, JAX_PROCESS_ID=str(pid))
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-c", WORKER],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            )
+        )
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=420)
+        outs.append((p.returncode, out.decode(), err.decode()))
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed:\n{err[-2000:]}"
+    assert "DIST-OK 0" in outs[0][1]
+    assert "DIST-OK 1" in outs[1][1]

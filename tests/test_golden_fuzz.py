@@ -78,7 +78,14 @@ def compare(state, naive, where: str) -> None:
             e = row[j]
             assert status[i][j] == e.status, f"status[{i},{j}] @ {where}"
             if e.status != 0:
-                assert hb[i][j] == e.hb, f"hb[{i},{j}] @ {where}"
+                # old-incarnation zombie lanes (above the subject's own
+                # counter — only reachable after a rejoin) saturate at the
+                # narrow storage's ceiling by design; they are excluded
+                # from gossip on both sides, so only status/age carry
+                # protocol meaning for them
+                zombie = e.hb > naive.tables[j][j].hb
+                if not zombie:
+                    assert hb[i][j] == e.hb, f"hb[{i},{j}] @ {where}"
                 assert age[i][j] == e.age, f"age[{i},{j}] @ {where}"
 
 
@@ -101,6 +108,11 @@ CONFIGS = [
     ("nobcast-i16-v8", dict(n=32, topology="random", fanout=5,
                             remove_broadcast=False, fresh_cooldown=True,
                             hb_dtype="int16", view_dtype="int8"), False),
+    ("rand-i8-v8", dict(n=32, topology="random", fanout=5,
+                        hb_dtype="int8", view_dtype="int8"), False),
+    ("arc-i8-v8-introkill", dict(n=64, topology="random_arc", fanout=6,
+                                 remove_broadcast=False, fresh_cooldown=True,
+                                 hb_dtype="int8", view_dtype="int8"), True),
 ]
 
 

@@ -135,3 +135,39 @@ def test_zombie_copies_cannot_readd_dead_node():
     for obs in (0, 1, 2, 3):
         for subj in dead:
             assert status[obs, subj] != int(MEMBER), (obs, subj)
+
+
+def test_int8_storage_rejoin_under_high_base_recovers():
+    """Same corner for the all-int8 storage mode (hb_dtype='int8'): the
+    tiny 126-round window makes deep bases routine, so the join-time
+    column rebase is load-bearing from the first few hundred rounds."""
+    from gossipfs_tpu.config import INT8_REBASE_WINDOW
+
+    n = 16
+    cfg = SimConfig(
+        n=n, topology="random", fanout=4, remove_broadcast=False,
+        fresh_cooldown=True, t_cooldown=12, view_dtype="int8",
+        hb_dtype="int8",
+    )
+    base_val = 40_000
+    state = init_state(cfg)
+    state = state._replace(
+        hb=jnp.full_like(state.hb, INT8_REBASE_WINDOW - 1),
+        hb_base=jnp.full_like(
+            state.hb_base, base_val - (INT8_REBASE_WINDOW - 1)
+        ),
+    )
+    assert int(np.asarray(state.hb_true())[0, 0]) == base_val
+    state, _, _ = run_rounds(
+        state, cfg, 25, KEY, events=scheduled(n, 25, crash_at=0, crash=[5])
+    )
+    state, _, _ = run_rounds(
+        state, cfg, 30, KEY, events=scheduled(n, 30, join_at=0, join=[5])
+    )
+    status = np.asarray(state.status)
+    true_hb = np.asarray(state.hb_true())
+    assert bool(np.asarray(state.alive)[5])
+    assert int(np.asarray(state.hb_base)[5]) == 0
+    for obs in range(n):
+        assert status[obs, 5] == int(MEMBER), f"observer {obs} lost node 5"
+        assert 1 <= true_hb[obs, 5] <= 60, (obs, true_hb[obs, 5])
