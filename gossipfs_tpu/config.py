@@ -114,6 +114,15 @@ class SimConfig:
                                      # ALU-bound round 4x denser AND fuse
                                      # the epilogue's outputs into one pass;
                                      # requires view_dtype="int8")
+    fused_tick: str = "auto"         # "auto": rounds with no join/leave events
+                                     # and remove_broadcast off fuse the
+                                     # heartbeat tick (bump/detect/cooldown)
+                                     # into the merge epilogue so the [N, N]
+                                     # lanes are read+written once per round
+                                     # (core/rounds._round_core_fused; the
+                                     # TPU stripe kernel runs the whole tick
+                                     # in-kernel).  "off": always use the
+                                     # separate-pass round (debug/parity)
 
     def __post_init__(self) -> None:
         if self.n < 2:
@@ -166,6 +175,8 @@ class SimConfig:
                     f" (needs n % {STRIPE_BLOCK_C} == 0 and "
                     f"n * {STRIPE_BLOCK_C} <= {STRIPE_MAX_BYTES} B of VMEM)"
                 )
+        if self.fused_tick not in ("auto", "off"):
+            raise ValueError(f"unknown fused_tick: {self.fused_tick!r}")
         if self.view_dtype not in ("int16", "int8"):
             raise ValueError(f"unknown view_dtype: {self.view_dtype!r}")
         if self.hb_dtype not in ("int32", "int16", "int8"):

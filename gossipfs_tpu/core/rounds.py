@@ -113,6 +113,22 @@ def _subj_axes(a: jax.Array) -> tuple[int, ...]:
     return tuple(range(1, a.ndim))
 
 
+def _diag(arr: jax.Array, ctx: ShardCtx = LOCAL_CTX) -> jax.Array:
+    """Gather the diagonal (receiver == subject) of a 2-D or blocked lane.
+
+    Returns a [nloc] vector; under subject-axis sharding the global row of
+    local subject j is ``ctx.offset + j``.
+    """
+    shp = arr.shape
+    nloc = _nsubj(shp)
+    j = jnp.arange(nloc)
+    rows = ctx.offset + j
+    if arr.ndim == 2:
+        return arr[rows, j]
+    _, _, cs, lane = shp
+    return arr[rows, j // (cs * lane), (j % (cs * lane)) // lane, j % lane]
+
+
 def _use_pallas(config: SimConfig, fanout: int, n: int, n_cols: int | None = None) -> bool:
     """Whether this run executes a pallas merge kernel."""
     from gossipfs_tpu.ops import merge_pallas
@@ -179,6 +195,21 @@ class RoundMetrics(NamedTuple):
     true_detections: jax.Array   # detector fired on an actually-dead subject
     false_positives: jax.Array   # detector fired on a live subject
     n_alive: jax.Array
+
+
+def _round_stats(
+    n_det: jax.Array, state: SimState, ctx: ShardCtx
+) -> tuple[RoundMetrics, jax.Array]:
+    """Scalar RoundMetrics + any_fail from the per-subject detector counts."""
+    nloc = n_det.shape[0]
+    dead_l = ctx.slice_cols(~state.alive, nloc)
+    alive_l = ctx.slice_cols(state.alive, nloc)
+    metrics = RoundMetrics(
+        true_detections=ctx.psum(jnp.sum(jnp.where(dead_l, n_det, 0))),
+        false_positives=ctx.psum(jnp.sum(jnp.where(alive_l, n_det, 0))),
+        n_alive=jnp.sum(state.alive, dtype=jnp.int32),
+    )
+    return metrics, n_det > 0
 
 
 class MetricsCarry(NamedTuple):
@@ -341,14 +372,7 @@ def _pre_tick(
     refresher = alive & small
 
     basec = state.hb_base.reshape(shp[1:])  # subject-shaped; zero in int32 mode
-    nloc = _nsubj(shp)
-    cols = ctx.offset + jnp.arange(nloc)  # global row index of each local subject
-    if nd == 2:
-        diag = hb[cols, jnp.arange(nloc)]
-    else:
-        _, nc, cs, lane = shp
-        j = jnp.arange(nloc)
-        diag = hb[cols, j // (cs * lane), (j % (cs * lane)) // lane, j % lane]
+    diag = _diag(hb, ctx)
     colmax_est = (diag.astype(jnp.int32) + basec.reshape(-1) + 1).reshape(shp[1:])
     return active, refresher, colmax_est
 
@@ -438,13 +462,212 @@ def _tick(
     return state._replace(hb=hb, age=age, status=status, alive=alive), fail
 
 
+def _rebase_shifts(
+    state: SimState, config: SimConfig, colmax_est: jax.Array
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Per-subject rebase vectors for this round's view build and merge write.
+
+    Returns (shift_a, shift_b, store_base), all subject-shaped:
+    ``shift_a`` maps stored -> view encoding, ``shift_b`` maps the old stored
+    base to the new one (the merge write renormalizes every stored value to
+    this round's base), ``store_base`` is the new per-subject base (zero in
+    int32 mode).  See the anchoring argument in :func:`_pre_tick`.
+    """
+    hb = state.hb
+    basec = state.hb_base.reshape(hb.shape[1:])  # all-zero in int32 mode
+    view_base = jnp.maximum(colmax_est - config.rebase_window, 0)
+    if hb.dtype != jnp.int32:
+        # tracks the diagonal, DOWN included: a rejoin resets the subject's
+        # counter to 0 and the base follows, so the fresh incarnation's
+        # entries are immediately representable.  Old-incarnation lanes
+        # renormalize above the window and saturate at the storage ceiling —
+        # still past the detection grace, still aging, still clamped out of
+        # gossip — so they die at their holders exactly like any silent
+        # peer.  (The previous monotone base instead pinned rejoins below
+        # the window — the round-1 zombie-rejoin deferral this replaces.)
+        store_window = (
+            REBASE_WINDOW if hb.dtype == jnp.int16 else INT8_REBASE_WINDOW
+        )
+        store_base = jnp.maximum(colmax_est - store_window, 0)
+    else:
+        store_base = jnp.zeros_like(basec)
+    return view_base - basec, store_base - basec, store_base
+
+
+def _gossip_view(
+    state: SimState, senders: jax.Array, shift_a: jax.Array, config: SimConfig
+) -> jax.Array:
+    """What each sender's datagram contains, as a narrow-dtype tensor.
+
+    Entries are the sender's MEMBER rows within the rebase window, encoded
+    relative to ``shift_a``; absent entries are -1 (heartbeats are never
+    negative).  See the window/zombie-exclusion argument in :func:`_merge`.
+    """
+    hb, status = state.hb, state.status
+    nd = hb.ndim
+    elig = (status == MEMBER) & _rx(senders, nd)
+    vdtype = jnp.int8 if config.view_dtype == "int8" else jnp.int16
+    if hb.dtype != jnp.int32:
+        # Narrow (packed) arithmetic: int16/int8 ops run 2-4x denser than
+        # int32 on the VPU and the round is ALU-bound.  Mod-2^k adds/subs
+        # are exact whenever the true int32 result is in range;
+        # out-of-range cases are handled by comparisons against int32
+        # thresholds clipped into the storage dtype (a clipped threshold
+        # admits all / none exactly like the unclipped int32 compare
+        # would).  Invariants keeping true results in range: gossiped
+        # lanes have rel in [0, rebase_window] (enforced by the window
+        # compares — the top side excludes old-incarnation zombie lanes),
+        # and shift_a <= window + slack (both bases derive from the
+        # diagonal).
+        info = jnp.iinfo(hb.dtype)
+        sa_n = shift_a.astype(hb.dtype)
+        # shift_a below the storage range => every stored value >= it
+        sa_all = (shift_a < info.min)[None]
+        # legit lanes are <= the post-bump diagonal (== colmax_est), which
+        # maps to rel == window exactly; anything above is an
+        # old-incarnation zombie (rel fits the view dtype: window is 126
+        # for int8, max 127)
+        hi = shift_a + config.rebase_window
+        hi_n = jnp.clip(hi, info.min, info.max).astype(hb.dtype)
+        # floor sentinels carry no counter and never gossip — without the
+        # explicit mask a deeply negative shift_a (sa_all) would admit them
+        # and emit wrapped garbage rel values
+        gossiped = (
+            elig
+            & ((hb >= sa_n[None]) | sa_all)
+            & (hb <= hi_n[None])
+            & (hb != info.min)
+        )
+        rel = hb - sa_n[None]  # exact on gossiped lanes; masked elsewhere
+        return jnp.where(gossiped, rel, jnp.asarray(-1, hb.dtype)).astype(vdtype)
+    rel = hb.astype(jnp.int32) - shift_a[None]
+    gossiped = elig & (rel >= 0) & (rel <= config.rebase_window)
+    return jnp.where(gossiped, rel, -1).astype(vdtype)
+
+
+def _membership_update(
+    state: SimState,
+    best_rel: jax.Array,
+    shift_a: jax.Array,
+    shift_b: jax.Array,
+    config: SimConfig,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """MergeMemberList semantics over a precomputed merged view row.
+
+    ``best_rel[i, :] = max_f view[edges[i, f], :]`` (view encoding, -1 =
+    no sender carried the entry).  Applies max-merge advance, UNKNOWN add,
+    fresh stamp, and the post-merge global age advance; returns the updated
+    (hb, age, status) lanes.  Shared by the XLA merge paths and the fused
+    tick round (the pallas fused kernels run the same math in-kernel).
+    """
+    hb, age, status, alive = state.hb, state.age, state.status, state.alive
+    nd = hb.ndim
+    narrow = hb.dtype != jnp.int32
+    vdtype = jnp.int8 if config.view_dtype == "int8" else jnp.int16
+    any_member = best_rel >= 0
+    recv = _rx(alive, nd)
+    add = recv & (status == UNKNOWN) & any_member          # learn new member
+    if narrow:
+        # narrow-arithmetic epilogue, bit-identical to the int32+clip
+        # formulation below (see the mod/threshold argument in the view
+        # build).  vmax = top of the view dtype; all int32 threshold
+        # vectors are per-subject (cheap [N] math).  Top-side
+        # exactness of ``lhs``: best <= window and shift_a <= 1 + the
+        # diagonal's per-round advance (both bases derive from the
+        # diagonal), so best + shift_a <= storage max for both the
+        # int16 and int8 modes.
+        info = jnp.iinfo(hb.dtype)
+        vmax = jnp.iinfo(vdtype).max
+        sb32 = shift_b
+        d32 = shift_a - shift_b
+        sa_n = shift_a.astype(hb.dtype)
+        best_n = best_rel.astype(hb.dtype)
+        # advance: best + shift_a > hb over true int32 values.  Bottom
+        # side: best + shift_a < storage floor means the compare is
+        # false — mask via a clipped per-subject threshold.
+        cmp_deep = jnp.clip(info.min - 1 - shift_a, -2, vmax).astype(vdtype)
+        lhs = best_n + sa_n[None]
+        advance = (
+            recv & (status == MEMBER) & any_member
+            & (best_rel > cmp_deep[None])
+            & (lhs > hb)
+        )
+        upd = advance | add
+        # updated value best + (shift_a - shift_b): saturates at the
+        # storage floor when the true value underflows (clip semantics)
+        up_deep = jnp.clip(info.min - 1 - d32, -2, vmax).astype(vdtype)
+        up_sat = best_rel <= up_deep[None]
+        up_val = jnp.where(
+            up_sat,
+            jnp.asarray(info.min, hb.dtype),
+            best_n + d32.astype(hb.dtype)[None],
+        )
+        # kept value hb - shift_b.  shift_b can be NEGATIVE (the base
+        # follows the diagonal down on rejoin), so both clip sides
+        # need guards: bottom-saturate (-> the floor sentinel) when
+        # hb - sb underflows; top-saturate (old-incarnation zombie
+        # lanes renormalizing above the ceiling) when it overflows,
+        # only reachable for sb < 0.
+        keep_thr = jnp.clip(sb32 + info.min - 1, info.min, info.max).astype(hb.dtype)
+        hi_thr = jnp.clip(sb32 - info.min, info.min, info.max).astype(hb.dtype)
+        has_hi = (sb32 < 0)[None]
+        keep_val = jnp.where(
+            has_hi & (hb >= hi_thr[None]),
+            jnp.asarray(info.max, hb.dtype),
+            hb - sb32.astype(hb.dtype)[None],
+        )
+        keep_val = jnp.where(
+            hb <= keep_thr[None],
+            jnp.asarray(info.min, hb.dtype),
+            keep_val,
+        )
+        hb = jnp.where(upd, up_val, keep_val)
+    else:
+        hb32 = hb.astype(jnp.int32)
+        best32 = best_rel.astype(jnp.int32)
+        # max-merge + stamp: best_true > hb_true, both sides shifted
+        # into the stored encoding (best32 + view_base > hb, as ever)
+        advance = (
+            recv & (status == MEMBER) & any_member
+            & (best32 > hb32 - shift_a[None])
+        )
+        upd = advance | add
+        new32 = jnp.where(
+            upd, best32 + (shift_a - shift_b)[None], hb32 - shift_b[None]
+        )
+        info = jnp.iinfo(hb.dtype)
+        hb = jnp.clip(new32, info.min, info.max).astype(hb.dtype)
+    age = jnp.where(upd, 0, age)
+    status = jnp.where(add, MEMBER, status)
+    age = jnp.minimum(age + 1, AGE_CLAMP).astype(jnp.int8)
+    return hb, age, status
+
+
+def _merge_best(
+    state: SimState, view: jax.Array, edges: jax.Array, config: SimConfig
+) -> jax.Array:
+    """Dispatch the merged-view-row computation (best_rel) only.
+
+    Used by the barrier-fused round, which :func:`_fused_ok` restricts to
+    the pure-XLA merge paths (any live pallas kernel takes the
+    separate-pass round, whose epilogue already runs in-kernel).
+    """
+    from gossipfs_tpu.ops import merge_pallas
+
+    if config.topology == "random_arc":
+        return merge_pallas.arc_window_max_xla(view, edges, config.fanout)
+    return merge_pallas.fanout_max_merge_xla(view, edges)
+
+
 def _merge(
     state: SimState,
     edges: jax.Array,
     senders: jax.Array,
     config: SimConfig,
     colmax_est: jax.Array,
-) -> SimState:
+    ctx: ShardCtx = LOCAL_CTX,
+    detect_stats: bool = False,
+) -> tuple[SimState, jax.Array | None, jax.Array | None, jax.Array | None]:
     """Gossip exchange: gather sender rows over in-edges, elementwise-max merge.
 
     Implements MergeMemberList (slave.go:414-440): shared members take the max
@@ -459,6 +682,16 @@ def _merge(
     TPU fast path); shapes the kernel's tiling can't express fall back to
     XLA.  One definition of the op serves both paths, so the kernel-parity
     tests pin exactly what production runs.
+
+    Returns (state, member_col, n_det, first_obs), the last three None
+    off the stripe-kernel paths: the kernels additionally produce the
+    per-subject count of live non-self observers holding the entry (feeds
+    :func:`_update_carry`'s convergence test) and — when ``detect_stats``,
+    i.e. the crash-only fresh-cooldown fault model where "detected this
+    round" is readable off the post-tick lanes — this round's per-subject
+    detector firings and lowest firing observer.  All three replace
+    full-matrix major-axis reductions in XLA, measured ~6x slower than
+    their in-kernel accumulation.
     """
     hb, age, status, alive = state.hb, state.age, state.status, state.alive
 
@@ -481,88 +714,31 @@ def _merge(
     # never refresh, age out at their holders, and cannot be re-added).
     # In-window entries lag the diagonal by O(t_fail) per hop, far inside
     # the window for the random topologies the narrow dtypes validate for.
-    nd = hb.ndim
-    narrow = hb.dtype != jnp.int32
-    basec = state.hb_base.reshape(hb.shape[1:])  # subject-shaped, all-zero in int32 mode
-    colmax = colmax_est
-    view_base = jnp.maximum(colmax - config.rebase_window, 0)
-    # A: shift from stored to view encoding (== view_base in int32 mode).
-    # B: shift from the old stored base to the new one — the merge write
-    # renormalizes every stored value to this round's base, which is what
-    # keeps int16 storage in range with no separate renormalization pass.
-    if narrow:
-        # tracks the diagonal, DOWN included: a rejoin resets the subject's
-        # counter to 0 and the base follows, so the fresh incarnation's
-        # entries are immediately representable.  Old-incarnation lanes
-        # renormalize above the window and saturate at the storage ceiling —
-        # still past the detection grace, still aging, still clamped out of
-        # gossip — so they die at their holders exactly like any silent
-        # peer.  (The previous monotone base instead pinned rejoins below
-        # the window — the round-1 zombie-rejoin deferral this replaces.)
-        store_window = (
-            REBASE_WINDOW if hb.dtype == jnp.int16 else INT8_REBASE_WINDOW
-        )
-        store_base = jnp.maximum(colmax - store_window, 0)
-    else:
-        store_base = jnp.zeros_like(basec)
-    shift_a = view_base - basec
-    shift_b = store_base - basec
+    shift_a, shift_b, store_base = _rebase_shifts(state, config, colmax_est)
     # what each sender's datagram contains: its MEMBER entries within the
     # rebase window (post-tick status, actual senders this round)
-    elig = (status == MEMBER) & _rx(senders, nd)
-    vdtype = jnp.int8 if config.view_dtype == "int8" else jnp.int16
-    if narrow:
-        # Narrow (packed) arithmetic: int16/int8 ops run 2-4x denser than
-        # int32 on the VPU and the round is ALU-bound.  Mod-2^k adds/subs
-        # are exact whenever the true int32 result is in range;
-        # out-of-range cases are handled by comparisons against int32
-        # thresholds clipped into the storage dtype (a clipped threshold
-        # admits all / none exactly like the unclipped int32 compare
-        # would).  Invariants keeping true results in range: gossiped
-        # lanes have rel in [0, rebase_window] (enforced by the window
-        # compares — the top side excludes old-incarnation zombie lanes),
-        # and shift_a <= window + slack (both bases derive from the
-        # diagonal).
-        info = jnp.iinfo(hb.dtype)
-        sa_n = shift_a.astype(hb.dtype)
-        # shift_a below the storage range => every stored value >= it
-        sa_all = (shift_a < info.min).reshape(hb.shape[1:])[None]
-        # legit lanes are <= the post-bump diagonal (== colmax_est), which
-        # maps to rel == window exactly; anything above is an
-        # old-incarnation zombie (rel fits the view dtype: window is 126
-        # for int8, max 127)
-        hi = shift_a + config.rebase_window
-        hi_n = jnp.clip(hi, info.min, info.max).astype(hb.dtype)
-        # floor sentinels carry no counter and never gossip — without the
-        # explicit mask a deeply negative shift_a (sa_all) would admit them
-        # and emit wrapped garbage rel values
-        gossiped = (
-            elig
-            & ((hb >= sa_n[None]) | sa_all)
-            & (hb <= hi_n[None])
-            & (hb != info.min)
-        )
-        rel = hb - sa_n[None]  # exact on gossiped lanes; masked elsewhere
-        view = jnp.where(gossiped, rel, jnp.asarray(-1, hb.dtype)).astype(vdtype)
-    else:
-        rel = hb.astype(jnp.int32) - shift_a[None]
-        gossiped = elig & (rel >= 0) & (rel <= config.rebase_window)
-        view = jnp.where(gossiped, rel, -1).astype(vdtype)
+    view = _gossip_view(state, senders, shift_a, config)
     # Both paths include the post-merge global age advance (everything not
     # refreshed this round ages by one, saturating at AGE_CLAMP) so the
     # fused kernel can write each [N, N] lane exactly once.
     use_pallas = _use_pallas(config, fanout, state.n, _nsubj(hb.shape))
     stripe_kernel = config.merge_kernel.startswith("pallas_stripe")
     best_rel = None  # set on the paths that share the XLA membership update
+    cnt_incl = None  # per-subject live-member count (self included)
+    k_ndet = k_fobs = None  # in-kernel detection stats (detect_stats only)
     if use_pallas and hb.ndim == 4 and arc and stripe_kernel:
-        # arc topology: the kernel does only the memory-hard part (windowed
-        # row-max over the resident stripe + ONE narrow gather per
-        # receiver); the membership update below rides XLA fusion, which
-        # runs the widened elementwise arithmetic at streaming efficiency —
-        # measured faster than a hand-written in-kernel epilogue
-        best_rel = merge_pallas.arc_window_max_blocked(
-            view, edges, fanout=fanout, block_r=config.merge_block_r,
-            interpret=config.merge_kernel.endswith("interpret"),
+        # arc topology: windowed row-max over the resident stripe (O(log F)
+        # shared passes) + one vector load per receiver + the block-wide
+        # epilogue, all in one kernel — each lane read and written once
+        alive32 = alive.astype(jnp.int32)
+        hb, age, status, cnt_incl, k_ndet, k_fobs = (
+            merge_pallas.arc_merge_update_blocked(
+                view, edges, hb, age, status, shift_a, shift_b, alive32,
+                fanout=fanout, member=int(MEMBER), unknown=int(UNKNOWN),
+                age_clamp=AGE_CLAMP, failed=int(FAILED),
+                detect_stats=detect_stats, block_r=config.merge_block_r,
+                interpret=config.merge_kernel.endswith("interpret"),
+            )
         )
     elif use_pallas:
         kernel_kwargs = dict(
@@ -582,9 +758,12 @@ def _merge(
             # round instead of F times (see stripe_merge_update_blocked)
             stripe_kwargs = dict(kernel_kwargs)
             del stripe_kwargs["slots"]
-            hb, age, status = merge_pallas.stripe_merge_update_blocked(
-                view, edges, hb, age, status, shift_a, shift_b, alive32,
-                **stripe_kwargs
+            hb, age, status, cnt_incl, k_ndet, k_fobs = (
+                merge_pallas.stripe_merge_update_blocked(
+                    view, edges, hb, age, status, shift_a, shift_b, alive32,
+                    failed=int(FAILED), detect_stats=detect_stats,
+                    **stripe_kwargs
+                )
             )
         elif hb.ndim == 4:
             # blocked layout (see module header): view/hb/age/status arrive
@@ -610,86 +789,23 @@ def _merge(
         best_rel = merge_pallas.fanout_max_merge_xla(view, edges)
     if best_rel is not None:
         # shared XLA membership update (MergeMemberList semantics)
-        any_member = best_rel >= 0
-        recv = _rx(alive, nd)
-        add = recv & (status == UNKNOWN) & any_member          # learn new member
-        if narrow:
-            # narrow-arithmetic epilogue, bit-identical to the int32+clip
-            # formulation below (see the mod/threshold argument in the view
-            # build).  vmax = top of the view dtype; all int32 threshold
-            # vectors are per-subject (cheap [N] math).  Top-side
-            # exactness of ``lhs``: best <= window and shift_a <= 1 + the
-            # diagonal's per-round advance (both bases derive from the
-            # diagonal), so best + shift_a <= storage max for both the
-            # int16 and int8 modes.
-            info = jnp.iinfo(hb.dtype)
-            vmax = jnp.iinfo(vdtype).max
-            sb32 = shift_b
-            d32 = shift_a - shift_b
-            sa_n = shift_a.astype(hb.dtype)
-            best_n = best_rel.astype(hb.dtype)
-            # advance: best + shift_a > hb over true int32 values.  Bottom
-            # side: best + shift_a < storage floor means the compare is
-            # false — mask via a clipped per-subject threshold.
-            cmp_deep = jnp.clip(info.min - 1 - shift_a, -2, vmax).astype(vdtype)
-            lhs = best_n + sa_n[None]
-            advance = (
-                recv & (status == MEMBER) & any_member
-                & (best_rel > cmp_deep.reshape(hb.shape[1:])[None])
-                & (lhs > hb)
-            )
-            upd = advance | add
-            # updated value best + (shift_a - shift_b): saturates at the
-            # storage floor when the true value underflows (clip semantics)
-            up_deep = jnp.clip(info.min - 1 - d32, -2, vmax).astype(vdtype)
-            up_sat = best_rel <= up_deep.reshape(hb.shape[1:])[None]
-            up_val = jnp.where(
-                up_sat,
-                jnp.asarray(info.min, hb.dtype),
-                best_n + d32.astype(hb.dtype)[None],
-            )
-            # kept value hb - shift_b.  shift_b can be NEGATIVE (the base
-            # follows the diagonal down on rejoin), so both clip sides
-            # need guards: bottom-saturate (-> the floor sentinel) when
-            # hb - sb underflows; top-saturate (old-incarnation zombie
-            # lanes renormalizing above the ceiling) when it overflows,
-            # only reachable for sb < 0.
-            keep_thr = jnp.clip(sb32 + info.min - 1, info.min, info.max).astype(hb.dtype)
-            hi_thr = jnp.clip(sb32 - info.min, info.min, info.max).astype(hb.dtype)
-            has_hi = (sb32 < 0).reshape(hb.shape[1:])[None]
-            keep_val = jnp.where(
-                has_hi & (hb >= hi_thr.reshape(hb.shape[1:])[None]),
-                jnp.asarray(info.max, hb.dtype),
-                hb - sb32.astype(hb.dtype)[None],
-            )
-            keep_val = jnp.where(
-                hb <= keep_thr.reshape(hb.shape[1:])[None],
-                jnp.asarray(info.min, hb.dtype),
-                keep_val,
-            )
-            hb = jnp.where(upd, up_val, keep_val)
-        else:
-            hb32 = hb.astype(jnp.int32)
-            best32 = best_rel.astype(jnp.int32)
-            # max-merge + stamp: best_true > hb_true, both sides shifted
-            # into the stored encoding (best32 + view_base > hb, as ever)
-            advance = (
-                recv & (status == MEMBER) & any_member
-                & (best32 > hb32 - shift_a[None])
-            )
-            upd = advance | add
-            new32 = jnp.where(
-                upd, best32 + (shift_a - shift_b)[None], hb32 - shift_b[None]
-            )
-            info = jnp.iinfo(hb.dtype)
-            hb = jnp.clip(new32, info.min, info.max).astype(hb.dtype)
-        age = jnp.where(upd, 0, age)
-        status = jnp.where(add, MEMBER, status)
-        age = jnp.minimum(age + 1, AGE_CLAMP).astype(jnp.int8)
+        hb, age, status = _membership_update(
+            state, best_rel, shift_a, shift_b, config
+        )
+    member_col = None
+    if cnt_incl is not None:
+        # the kernels count live holders INCLUDING the subject's own row;
+        # _update_carry wants non-self observers — subtract the diagonal
+        # ([N] gather over the fresh status, vector math)
+        nloc = _nsubj(status.shape)
+        self_member = ctx.slice_cols(alive, nloc) & (_diag(status, ctx) == MEMBER)
+        member_col = cnt_incl.reshape(nloc) - self_member.astype(jnp.int32)
+    if not detect_stats:
+        k_ndet = k_fobs = None
     return state._replace(
         hb=hb, age=age, status=status, alive=alive,
         hb_base=store_base.reshape(-1),
-    )
+    ), member_col, k_ndet, k_fobs
 
 
 def _round_core(
@@ -699,11 +815,12 @@ def _round_core(
     config: SimConfig,
     ctx: ShardCtx = LOCAL_CTX,
     matrix_events: bool = True,
-) -> tuple[SimState, RoundMetrics, jax.Array, jax.Array, jax.Array]:
+) -> tuple[SimState, RoundMetrics, jax.Array, jax.Array, jax.Array, jax.Array | None]:
     """One round, layout- and shard-generic (state may be 2-D or blocked,
     square or a subject-axis shard).
 
-    Returns (state, metrics, fail, any_fail [nloc], first_obs [nloc])."""
+    Returns (state, metrics, fail, any_fail [nloc], first_obs [nloc],
+    member_col [nloc] | None — see :func:`_merge`)."""
     n = state.n
     state = _apply_events(state, events, config, ctx, matrix_events=matrix_events)
     active, refresher, colmax_est = _pre_tick(state, config, ctx)
@@ -711,10 +828,22 @@ def _round_core(
     if config.topology == "ring":
         edges = topology.ring_edges_from_status(state.status.reshape(n, n))
     assert edges is not None
+    # crash-only + fresh-cooldown + no-remove-broadcast: this round's
+    # detector firings are readable off the post-tick lanes the merge
+    # kernel loads anyway (status == FAILED and age == 0), so the kernels
+    # accumulate the detection stats and the fail matrix never leaves the
+    # tick fusion (its XLA reductions measured ~3 ms/round at N=16k)
+    det_ok = (
+        not matrix_events
+        and config.fresh_cooldown
+        and not config.remove_broadcast
+    )
     # _merge also advances age for every entry not refreshed this round
     # (refreshes wrote 0, then everything ages by one, saturating at
     # AGE_CLAMP — beyond every protocol threshold, config.py)
-    state = _merge(state, edges, active, config, colmax_est)
+    state, member_col, k_ndet, k_fobs = _merge(
+        state, edges, active, config, colmax_est, ctx, detect_stats=det_ok
+    )
     state = state._replace(round=state.round + 1)
 
     # every fail-matrix statistic reduces over the SAME axis (receivers),
@@ -722,16 +851,116 @@ def _round_core(
     # ones: per-subject detector counts + lowest firing observer, then
     # vector math for the scalar metrics
     nloc = _nsubj(fail.shape)
+    if k_ndet is not None:
+        n_det = k_ndet.reshape(nloc)
+        # kernel stats carry n where no observer fired; _update_carry only
+        # reads first_obs where a detection happened, so the disagreement
+        # with argmax's 0-on-empty is unobservable
+        first_obs_now = k_fobs.reshape(nloc)
+    else:
+        n_det = jnp.sum(fail, axis=0, dtype=jnp.int32).reshape(nloc)
+        first_obs_now = jnp.argmax(fail, axis=0).astype(jnp.int32).reshape(nloc)
+    metrics, any_fail = _round_stats(n_det, state, ctx)
+    return state, metrics, fail, any_fail, first_obs_now, member_col
+
+
+def _fused_ok(config: SimConfig, matrix_events: bool, n: int, nloc: int) -> bool:
+    """Whether the barrier-fused (recomputed-tick) round applies to this scan.
+
+    The fused round recomputes the elementwise heartbeat tick inside the
+    post-merge update fusion instead of materializing a post-tick state
+    across the merge kernel.  It requires purely elementwise per-round
+    state rewrites: join/leave events (cross-row introducer pushes) and the
+    REMOVE broadcast (a cross-receiver reduction feeding the same round's
+    view) force the separate-pass round.  Ring mode re-derives edges from
+    2-D tables and stays on the parity path.  When a stripe kernel serves
+    this shape, the separate-pass round wins instead — its in-kernel
+    epilogue already writes each lane once, and the XLA tick+view pass
+    measured at streaming efficiency.
+    """
+    if (
+        config.fused_tick != "auto"
+        or matrix_events
+        or config.remove_broadcast
+        or config.topology == "ring"
+    ):
+        return False
+    # any live pallas kernel (stripe, arc, or gather) means the separate-pass
+    # round already runs a fused epilogue in-kernel; the barrier round serves
+    # the pure-XLA merge paths only
+    return not _use_pallas(config, config.fanout, n, nloc)
+
+
+def _round_core_fused(
+    state: SimState,
+    crash: jax.Array,
+    edges: jax.Array,
+    config: SimConfig,
+    ctx: ShardCtx = LOCAL_CTX,
+) -> tuple[SimState, RoundMetrics, jax.Array, jax.Array, jax.Array | None]:
+    """One crash-only round with the tick recomputed around the merge kernel.
+
+    Semantically identical to :func:`_round_core` under
+    ``matrix_events=False`` and ``remove_broadcast=False`` (pinned by
+    tests/test_fused_round.py), but the post-tick state never materializes:
+    the tick (bump / detect / cooldown, :func:`_tick`) is recomputed
+    elementwise inside both consumers — the gossip-view build and the
+    post-kernel membership update — and the fail matrix never
+    materializes, only its column reductions.  Serves the XLA merge paths
+    (CPU, shards, shapes without a stripe kernel); stripe-kernel shapes use
+    the separate-pass round, whose in-kernel epilogue already writes each
+    lane once (see :func:`_fused_ok`).
+
+    Returns (state, metrics, member_col, any_fail, first_obs).
+    """
+    n = state.n
+    state = state._replace(alive=state.alive & ~crash)
+    active, refresher, colmax_est = _pre_tick(state, config, ctx)
+    shift_a, shift_b, store_base = _rebase_shifts(state, config, colmax_est)
+    # one traced tick: XLA fuses it into the view build and the fail
+    # reductions below (the arrays of st2 that feed neither are dead code)
+    st2, fail = _tick(state, config, ctx, active=active, refresher=refresher)
+    view = _gossip_view(st2, active, shift_a, config)
+
+    best_rel = _merge_best(st2, view, edges, config)
+    # The tick feeds consumers on BOTH sides of the opaque merge kernel:
+    # the view build above and the membership update below.  Left alone,
+    # XLA CSEs the two into one tick whose post-tick lanes then
+    # materialize across the kernel (a full [N, N] x 3 write + read).
+    # The barrier gives the second tick distinct operands, so each
+    # consumer fusion recomputes the elementwise tick from the carry
+    # lanes instead — duplicated ALU, one less round trip to HBM.
+    hb_b, age_b, status_b = lax.optimization_barrier(
+        (state.hb, state.age, state.status)
+    )
+    st2b, _ = _tick(
+        state._replace(hb=hb_b, age=age_b, status=status_b),
+        config, ctx, active=active, refresher=refresher,
+    )
+    hb, age, status = _membership_update(
+        st2b, best_rel, shift_a, shift_b, config
+    )
+    new_state = st2b._replace(
+        hb=hb, age=age, status=status, hb_base=store_base.reshape(-1)
+    )
+    # per-subject live-observer count off the fresh status (fuses as a
+    # consumer of the update pass; replaces _update_carry's full-matrix
+    # all_dropped reduction)
+    member_col = jnp.sum(
+        (
+            (status == MEMBER)
+            & _rx(new_state.alive, status.ndim)
+            & ~_eye(n, status.shape, ctx)
+        ).astype(jnp.int32),
+        axis=0,
+    ).reshape(_nsubj(status.shape))
+    new_state = new_state._replace(round=state.round + 1)
+
+    nloc = _nsubj(fail.shape)
     n_det = jnp.sum(fail, axis=0, dtype=jnp.int32).reshape(nloc)
     first_obs_now = jnp.argmax(fail, axis=0).astype(jnp.int32).reshape(nloc)
-    dead_l = ctx.slice_cols(~state.alive, nloc)
-    alive_l = ctx.slice_cols(state.alive, nloc)
-    metrics = RoundMetrics(
-        true_detections=ctx.psum(jnp.sum(jnp.where(dead_l, n_det, 0))),
-        false_positives=ctx.psum(jnp.sum(jnp.where(alive_l, n_det, 0))),
-        n_alive=jnp.sum(state.alive, dtype=jnp.int32),
-    )
-    return state, metrics, fail, n_det > 0, first_obs_now
+    metrics, any_fail = _round_stats(n_det, new_state, ctx)
+    return new_state, metrics, member_col, any_fail, first_obs_now
 
 
 @partial(jax.jit, static_argnames=("config",))
@@ -755,7 +984,7 @@ def gossip_round(
     blocked = _use_blocked(config, config.fanout, n)
     if blocked:
         state = _to_blocked(state, config)
-    state, metrics, fail, _, _ = _round_core(state, events, edges, config)
+    state, metrics, fail, _, _, _ = _round_core(state, events, edges, config)
     if blocked:
         state = _from_blocked(state)
     return state, metrics, fail.reshape(n, n)
@@ -769,6 +998,7 @@ def _update_carry(
     first_obs_now: jax.Array,
     round_idx: jax.Array,
     ctx: ShardCtx = LOCAL_CTX,
+    member_col: jax.Array | None = None,
 ) -> MetricsCarry:
     n = state.n
     nd, shp = state.status.ndim, state.status.shape
@@ -784,9 +1014,17 @@ def _update_carry(
     first_observer = jnp.where(fresh, first_obs_now, first_observer)
     first_detect = jnp.where(fresh, round_idx, first_detect)
 
-    dropped = ~_rx(state.alive, nd) | _eye(n, shp, ctx) | (state.status != MEMBER)
     alive_l = ctx.slice_cols(state.alive, nloc)
-    all_dropped = jnp.all(dropped, axis=0).reshape(nloc) & ~alive_l
+    if member_col is not None:
+        # per-subject count of live non-self observers still holding the
+        # entry, computed on the side by the fused stripe kernel — spares
+        # the full-matrix reduction below
+        all_dropped = (member_col.reshape(nloc) == 0) & ~alive_l
+    else:
+        dropped = (
+            ~_rx(state.alive, nd) | _eye(n, shp, ctx) | (state.status != MEMBER)
+        )
+        all_dropped = jnp.all(dropped, axis=0).reshape(nloc) & ~alive_l
     converged = jnp.where((converged < 0) & all_dropped, round_idx, converged)
     return MetricsCarry(
         first_detect=first_detect, first_observer=first_observer,
@@ -819,6 +1057,8 @@ def _scan_rounds(
     small membership view between chunks) accumulates first-detection /
     convergence rounds exactly as one long scan would.
     """
+    fused = _fused_ok(config, matrix_events, state.n, _nsubj(state.hb.shape))
+
     def step(carry, ev: RoundEvents):
         st, mc = carry
         k = jax.random.fold_in(key, st.round)
@@ -843,15 +1083,23 @@ def _scan_rounds(
             edges = topology.in_edges(config, k_edge, None)
         round_idx = st.round
         alive_before = st.alive
-        st, metrics, _fail, any_fail, first_obs = _round_core(
-            st, ev, edges, config, ctx, matrix_events=matrix_events
-        )
+        if fused:
+            # matrix_events is False here, so scheduled leaves (if any) can
+            # only mean silent death — same liveness effect as a crash
+            st, metrics, member_col, any_fail, first_obs = _round_core_fused(
+                st, ev.crash | ev.leave, edges, config, ctx
+            )
+        else:
+            st, metrics, _fail, any_fail, first_obs, member_col = _round_core(
+                st, ev, edges, config, ctx, matrix_events=matrix_events
+            )
         # joins lost to a dead introducer don't reset metrics (slave.go:22 SPOF)
         if matrix_events:
             rejoined = ev.join & ~alive_before & st.alive
         else:
             rejoined = jnp.zeros_like(st.alive)  # constant: resets fold away
-        mc = _update_carry(mc, st, rejoined, any_fail, first_obs, round_idx, ctx)
+        mc = _update_carry(mc, st, rejoined, any_fail, first_obs, round_idx, ctx,
+                           member_col=member_col)
         return (st, mc), metrics
 
     if mcarry0 is None:

@@ -478,12 +478,88 @@ def fused_merge_update_blocked(
     return tuple(out)
 
 
+def _epilogue_and_count(
+    best_scratch, hb_vmem, age_vmem, status_vmem, alive_ref, sa_ref, sb_ref,
+    hb_out, age_out, status_out, cnt_out, ndet_out, fobs_out,
+    i, r_blk: int, member: int, unknown: int, age_clamp: int,
+    failed: int, detect_stats: bool, n: int,
+):
+    """Block-wide merge epilogue shared by the stripe kernels.
+
+    MergeMemberList semantics over post-tick lanes (core/rounds.py
+    ``_membership_update``'s int32+clip formulation), plus per-subject
+    reductions accumulated across the consecutive receiver blocks that
+    revisit the same output block (grid: j outer, i inner):
+
+    * ``cnt_out`` — live observers holding the entry (self included — the
+      caller subtracts the diagonal);
+    * ``ndet_out`` / ``fobs_out`` (only when ``detect_stats``) — this
+      round's detector firings per subject and the lowest firing observer.
+      Valid under the crash-only + fresh_cooldown + no-remove-broadcast
+      fault model, where "detected this round" is exactly
+      ``status == FAILED and age == 0`` on the post-tick input lanes
+      (the detector is the only writer of FAILED, it stamps age 0, and
+      every older FAILED entry has aged at least once).  ``fobs_out`` is
+      ``n`` where no observer fired.
+
+    These replace full-matrix major-axis reductions in XLA, which measured
+    ~6x slower than minor-axis reductions.
+    """
+    best_rel = best_scratch[...]
+    any_member = best_rel >= 0
+    hb = hb_vmem[...].astype(jnp.int32)
+    st = status_vmem[...].astype(jnp.int32)
+    age = age_vmem[...].astype(jnp.int32)
+    sa = sa_ref[0][None]
+    sb = sb_ref[0][None]
+    # receiver liveness, replicated across lanes by the wrapper so it
+    # broadcasts over the subject dims without sublane shuffles
+    recv = alive_ref[...].reshape(alive_ref.shape[0], 1, LANE) != 0
+    advance = recv & any_member & (st == member) & (best_rel > hb - sa)
+    add = recv & any_member & (st == unknown)
+    upd = advance | add
+    new_hb = jnp.where(upd, best_rel + (sa - sb), hb - sb)
+    if hb_out.dtype != jnp.int32:
+        info = jnp.iinfo(hb_out.dtype)
+        new_hb = jnp.clip(new_hb, info.min, info.max)
+    hb_out[:, 0] = new_hb.astype(hb_out.dtype)
+    new_age = jnp.minimum(jnp.where(upd, 0, age) + 1, age_clamp)
+    age_out[:, 0] = new_age.astype(age_out.dtype)
+    st_new = jnp.where(add, member, st)
+    status_out[:, 0] = st_new.astype(status_out.dtype)
+
+    part = jnp.sum((recv & (st_new == member)).astype(jnp.int32), axis=0)[None]
+    if detect_stats:
+        fresh = (st == failed) & (age == 0)
+        ndet_part = jnp.sum(fresh.astype(jnp.int32), axis=0)[None]
+        rows = lax.broadcasted_iota(jnp.int32, st.shape, 0) + i * r_blk
+        fobs_part = jnp.min(jnp.where(fresh, rows, n), axis=0)[None]
+
+    @pl.when(i == 0)
+    def _():
+        cnt_out[...] = part
+        if detect_stats:
+            ndet_out[...] = ndet_part
+            fobs_out[...] = fobs_part
+        else:
+            ndet_out[...] = jnp.zeros_like(ndet_out)
+            fobs_out[...] = jnp.zeros_like(fobs_out)
+
+    @pl.when(i > 0)
+    def _():
+        cnt_out[...] = cnt_out[...] + part
+        if detect_stats:
+            ndet_out[...] = ndet_out[...] + ndet_part
+            fobs_out[...] = jnp.minimum(fobs_out[...], fobs_part)
+
+
 def _stripe_kernel(
-    n: int, n_fanout: int, r_blk: int, member: int, unknown: int, age_clamp: int
+    n: int, n_fanout: int, r_blk: int, member: int, unknown: int,
+    age_clamp: int, failed: int, detect_stats: bool,
 ):
     def kernel(
-        edges_ref, view_ref, hb_hbm, age_hbm, status_hbm, sa_ref, sb_ref,
-        hb_out, age_out, status_out,
+        edges_ref, view_ref, hb_hbm, age_hbm, status_hbm, alive_ref, sa_ref, sb_ref,
+        hb_out, age_out, status_out, cnt_out, ndet_out, fobs_out,
         stripe, best_scratch, hb_vmem, age_vmem, status_vmem, stripe_sem, row_sems,
     ):
         # Grid (nc, n // r_blk): column block j OUTER, receiver block i
@@ -524,25 +600,13 @@ def _stripe_kernel(
         for c in row_copies:
             c.wait()
 
-        # Phase 2 — block-wide epilogue, identical to _fused_kernel's.
-        best_rel = best_scratch[...]
-        any_member = best_rel >= 0
-        hb = hb_vmem[...].astype(jnp.int32)
-        st = status_vmem[...].astype(jnp.int32)
-        age = age_vmem[...].astype(jnp.int32)
-        sa = sa_ref[0][None]
-        sb = sb_ref[0][None]
-        advance = any_member & (st == member) & (best_rel > hb - sa)
-        add = any_member & (st == unknown)
-        upd = advance | add
-        new_hb = jnp.where(upd, best_rel + (sa - sb), hb - sb)
-        if hb_out.dtype != jnp.int32:
-            info = jnp.iinfo(hb_out.dtype)
-            new_hb = jnp.clip(new_hb, info.min, info.max)
-        hb_out[:, 0] = new_hb.astype(hb_out.dtype)
-        new_age = jnp.minimum(jnp.where(upd, 0, age) + 1, age_clamp)
-        age_out[:, 0] = new_age.astype(age_out.dtype)
-        status_out[:, 0] = jnp.where(add, member, st).astype(status_out.dtype)
+        # Phase 2 — block-wide epilogue + per-subject reductions.
+        _epilogue_and_count(
+            best_scratch, hb_vmem, age_vmem, status_vmem, alive_ref,
+            sa_ref, sb_ref, hb_out, age_out, status_out, cnt_out,
+            ndet_out, fobs_out,
+            i, r_blk, member, unknown, age_clamp, failed, detect_stats, n,
+        )
 
     return kernel
 
@@ -569,7 +633,10 @@ def stripe_supported(n: int, fanout: int, n_cols: int | None = None) -> bool:
 
 @functools.partial(
     jax.jit,
-    static_argnames=("member", "unknown", "age_clamp", "block_r", "interpret"),
+    static_argnames=(
+        "member", "unknown", "age_clamp", "failed", "detect_stats",
+        "block_r", "interpret",
+    ),
 )
 def stripe_merge_update_blocked(
     view: jax.Array,
@@ -584,9 +651,11 @@ def stripe_merge_update_blocked(
     member: int,
     unknown: int,
     age_clamp: int,
+    failed: int = 2,
+    detect_stats: bool = False,
     block_r: int = _FUSED_BLOCK_R,
     interpret: bool = False,
-) -> tuple[jax.Array, jax.Array, jax.Array]:
+) -> tuple[jax.Array, ...]:
     """Gossip merge + membership update + age advance, stripe-resident.
 
     Same contract as :func:`fused_merge_update_blocked` (int8 view in the
@@ -595,6 +664,13 @@ def stripe_merge_update_blocked(
     descriptor issue), each view column block is loaded into VMEM once and
     the F-way max reads it with vector loads — HBM view traffic drops F-fold
     and the descriptor count drops from F x N per round to ~nc.
+
+    Returns (hb, age, status, member_cnt, n_det, first_obs): ``member_cnt``
+    int32 [nc, cs, LANE] counts, per subject, the live observers whose
+    updated list holds the entry (self INCLUDED — callers subtract the
+    diagonal); ``n_det``/``first_obs`` carry this round's detection stats
+    when ``detect_stats`` (see :func:`_epilogue_and_count`), zeros
+    otherwise.
     """
     n, nc, cs, _ = view.shape
     fanout = edges.shape[1]
@@ -612,16 +688,22 @@ def stripe_merge_update_blocked(
     # row is all -1), as in the gather kernel
     self_idx = jnp.arange(n, dtype=edges.dtype)[:, None]
     edges = jnp.where((alive != 0)[:, None], edges, self_idx)
+    # liveness replicated across the lane dim for clean vector broadcast
+    alive_lanes = jnp.broadcast_to(alive.astype(jnp.int32)[:, None], (n, LANE))
 
     row_spec = lambda j, i: (i, j, 0, 0)  # noqa: E731
     lane_blk = lambda dt: pl.BlockSpec(  # noqa: E731
         (r_blk, 1, cs, LANE), row_spec, memory_space=pltpu.VMEM
     )
+    subj_spec = pl.BlockSpec(
+        (1, cs, LANE), lambda j, i: (j, 0, 0), memory_space=pltpu.VMEM
+    )
     hb5 = hb.reshape(n // r_blk, r_blk, nc, cs, LANE)
     age5 = age.reshape(n // r_blk, r_blk, nc, cs, LANE)
     status5 = status.reshape(n // r_blk, r_blk, nc, cs, LANE)
     out = pl.pallas_call(
-        _stripe_kernel(n, fanout, r_blk, member, unknown, age_clamp),
+        _stripe_kernel(n, fanout, r_blk, member, unknown, age_clamp,
+                       failed, detect_stats),
         grid=(nc, n // r_blk),
         in_specs=[
             pl.BlockSpec(
@@ -631,14 +713,23 @@ def stripe_merge_update_blocked(
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec((1, cs, LANE), lambda j, i: (j, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, cs, LANE), lambda j, i: (j, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (r_blk, LANE), lambda j, i: (i, 0), memory_space=pltpu.VMEM
+            ),
+            subj_spec,
+            subj_spec,
         ],
-        out_specs=[lane_blk(hb.dtype), lane_blk(age.dtype), lane_blk(status.dtype)],
+        out_specs=[
+            lane_blk(hb.dtype), lane_blk(age.dtype), lane_blk(status.dtype),
+            subj_spec, subj_spec, subj_spec,
+        ],
         out_shape=[
             jax.ShapeDtypeStruct((n, nc, cs, LANE), hb.dtype),
             jax.ShapeDtypeStruct((n, nc, cs, LANE), age.dtype),
             jax.ShapeDtypeStruct((n, nc, cs, LANE), status.dtype),
+            jax.ShapeDtypeStruct((nc, cs, LANE), jnp.int32),
+            jax.ShapeDtypeStruct((nc, cs, LANE), jnp.int32),
+            jax.ShapeDtypeStruct((nc, cs, LANE), jnp.int32),
         ],
         scratch_shapes=[
             pltpu.VMEM((n, cs, LANE), view.dtype),
@@ -651,7 +742,7 @@ def stripe_merge_update_blocked(
         ],
         compiler_params=pltpu.CompilerParams(vmem_limit_bytes=110 * 1024 * 1024),
         interpret=interpret,
-    )(edges, view, hb5, age5, status5, shift_a, shift_b)
+    )(edges, view, hb5, age5, status5, alive_lanes, shift_a, shift_b)
     return tuple(out)
 
 
@@ -660,6 +751,59 @@ def stripe_merge_update_blocked(
 # v5e Mosaic has no narrow-int vector max (arith.maxsi on i8 fails to
 # legalize); bf16 max is native and exact for the int8 view range.
 ARC_CHUNK = 1024
+
+
+def _windowmax_inplace(stripe, bufa, bufb, halo, fanout: int, nchunks: int):
+    """Windowed row max, in place over the resident stripe.
+
+    W[r] = max over view rows r..r+F-1 (mod N).  Shift-doubling to the
+    largest power of two <= F, then one overlapped combine — O(log F)
+    passes instead of F, amortized over every receiver reading the stripe.
+    """
+    halo[...] = stripe[0:fanout - 1]  # pre-overwrite wrap rows
+    # largest power of two <= fanout
+    p = 1 << (fanout.bit_length() - 1)
+
+    def chunk_body(c, _):
+        base = c * ARC_CHUNK
+        ext = ARC_CHUNK + fanout - 1
+        bufa[0:ARC_CHUNK] = stripe[pl.ds(base, ARC_CHUNK)].astype(bufa.dtype)
+
+        @pl.when(c == nchunks - 1)
+        def _():
+            bufa[ARC_CHUNK:ext] = halo[...].astype(bufa.dtype)
+
+        @pl.when(c < nchunks - 1)
+        def _():
+            bufa[ARC_CHUNK:ext] = stripe[
+                pl.ds(base + ARC_CHUNK, fanout - 1)
+            ].astype(bufa.dtype)
+
+        # shift-doubling ping-pong: after the step with shift s,
+        # the buffer holds window maxes of length 2s
+        src, dst = bufa, bufb
+        length = ext
+        s = 1
+        while s < p:
+            dst[0:length - s] = jnp.maximum(
+                src[0:length - s], src[pl.ds(s, length - s)]
+            )
+            src, dst = dst, src
+            length -= s
+            s *= 2
+        # combine two p-windows into the F-window (overlap is fine
+        # for max): W[r] = max(D_p[r], D_p[r + F - p])
+        if p == fanout:
+            w = src[0:ARC_CHUNK]
+        else:
+            w = jnp.maximum(
+                src[0:ARC_CHUNK],
+                src[pl.ds(fanout - p, ARC_CHUNK)],
+            )
+        stripe[pl.ds(base, ARC_CHUNK)] = w.astype(stripe.dtype)
+        return 0
+
+    lax.fori_loop(0, nchunks, chunk_body, 0, unroll=False)
 
 
 def _arc_window_kernel(n: int, fanout: int, r_blk: int):
@@ -674,57 +818,7 @@ def _arc_window_kernel(n: int, fanout: int, r_blk: int):
             cp = pltpu.make_async_copy(view_ref.at[:, j], stripe, stripe_sem)
             cp.start()
             cp.wait()
-            # ---- windowed row max, in place over the stripe -------------
-            # W[r] = max over view rows r..r+F-1 (mod N).  Shift-doubling
-            # to the largest power of two <= F, then one overlapped
-            # combine — O(log F) passes instead of F, amortized over every
-            # receiver that reads the stripe.
-            halo[...] = stripe[0:fanout - 1]  # pre-overwrite wrap rows
-            # largest power of two <= fanout
-            p = 1 << (fanout.bit_length() - 1)
-
-            def chunk_body(c, _):
-                base = c * ARC_CHUNK
-                ext = ARC_CHUNK + fanout - 1
-                bufa[0:ARC_CHUNK] = stripe[pl.ds(base, ARC_CHUNK)].astype(
-                    bufa.dtype
-                )
-
-                @pl.when(c == nchunks - 1)
-                def _():
-                    bufa[ARC_CHUNK:ext] = halo[...].astype(bufa.dtype)
-
-                @pl.when(c < nchunks - 1)
-                def _():
-                    bufa[ARC_CHUNK:ext] = stripe[
-                        pl.ds(base + ARC_CHUNK, fanout - 1)
-                    ].astype(bufa.dtype)
-
-                # shift-doubling ping-pong: after the step with shift s,
-                # the buffer holds window maxes of length 2s
-                src, dst = bufa, bufb
-                length = ext
-                s = 1
-                while s < p:
-                    dst[0:length - s] = jnp.maximum(
-                        src[0:length - s], src[pl.ds(s, length - s)]
-                    )
-                    src, dst = dst, src
-                    length -= s
-                    s *= 2
-                # combine two p-windows into the F-window (overlap is fine
-                # for max): W[r] = max(D_p[r], D_p[r + F - p])
-                if p == fanout:
-                    w = src[0:ARC_CHUNK]
-                else:
-                    w = jnp.maximum(
-                        src[0:ARC_CHUNK],
-                        src[pl.ds(fanout - p, ARC_CHUNK)],
-                    )
-                stripe[pl.ds(base, ARC_CHUNK)] = w.astype(stripe.dtype)
-                return 0
-
-            lax.fori_loop(0, nchunks, chunk_body, 0, unroll=False)
+            _windowmax_inplace(stripe, bufa, bufb, halo, fanout, nchunks)
 
         # one narrow vector load + store per receiver row — no F-way
         # gather, no widening, no epilogue arithmetic (XLA fuses that into
@@ -736,6 +830,172 @@ def _arc_window_kernel(n: int, fanout: int, r_blk: int):
         lax.fori_loop(0, r_blk, body, 0, unroll=False)
 
     return kernel
+
+
+def _arc_update_kernel(
+    n: int, fanout: int, r_blk: int, member: int, unknown: int,
+    age_clamp: int, failed: int, detect_stats: bool,
+):
+    nchunks = n // ARC_CHUNK
+
+    def kernel(
+        bases_ref, view_ref, hb_hbm, age_hbm, status_hbm, alive_ref, sa_ref, sb_ref,
+        hb_out, age_out, status_out, cnt_out, ndet_out, fobs_out,
+        stripe, bufa, bufb, halo, best_scratch,
+        hb_vmem, age_vmem, status_vmem, stripe_sem, row_sems,
+    ):
+        j = pl.program_id(0)
+        i = pl.program_id(1)
+
+        row_copies = [
+            pltpu.make_async_copy(hb_hbm.at[i, :, j], hb_vmem, row_sems.at[0]),
+            pltpu.make_async_copy(age_hbm.at[i, :, j], age_vmem, row_sems.at[1]),
+            pltpu.make_async_copy(status_hbm.at[i, :, j], status_vmem, row_sems.at[2]),
+        ]
+        for c in row_copies:
+            c.start()
+
+        @pl.when(i == 0)
+        def _():
+            cp = pltpu.make_async_copy(view_ref.at[:, j], stripe, stripe_sem)
+            cp.start()
+            cp.wait()
+            _windowmax_inplace(stripe, bufa, bufb, halo, fanout, nchunks)
+
+        # Phase 1 — one widened vector load per receiver row (the windowed
+        # max did the F-way work once per stripe, O(log F) instead of F)
+        def body(r, _):
+            best_scratch[r] = stripe[bases_ref[r, 0]].astype(jnp.int32)
+            return 0
+
+        lax.fori_loop(0, r_blk, body, 0, unroll=False)
+        for c in row_copies:
+            c.wait()
+
+        # Phase 2 — block-wide epilogue + per-subject reductions.
+        # The receiver-liveness gate is load-bearing here: arc bases cannot
+        # be remapped to a "blank" row (every window-maxed stripe row holds
+        # real values), so dead receivers are masked in the epilogue.
+        _epilogue_and_count(
+            best_scratch, hb_vmem, age_vmem, status_vmem, alive_ref,
+            sa_ref, sb_ref, hb_out, age_out, status_out, cnt_out,
+            ndet_out, fobs_out,
+            i, r_blk, member, unknown, age_clamp, failed, detect_stats, n,
+        )
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "fanout", "member", "unknown", "age_clamp", "failed", "detect_stats",
+        "block_r", "interpret",
+    ),
+)
+def arc_merge_update_blocked(
+    view: jax.Array,
+    bases: jax.Array,
+    hb: jax.Array,
+    age: jax.Array,
+    status: jax.Array,
+    shift_a: jax.Array,
+    shift_b: jax.Array,
+    alive: jax.Array,
+    *,
+    fanout: int,
+    member: int,
+    unknown: int,
+    age_clamp: int,
+    failed: int = 2,
+    detect_stats: bool = False,
+    block_r: int = _FUSED_BLOCK_R,
+    interpret: bool = False,
+) -> tuple[jax.Array, ...]:
+    """Arc merge + membership update + age advance + member count, fused.
+
+    The ``random_arc`` production kernel: combines
+    :func:`arc_window_max_blocked`'s O(log F) windowed row-max (senders are
+    F consecutive rows) with :func:`stripe_merge_update_blocked`'s
+    block-wide epilogue, so the hb/age/status lanes are read and written
+    exactly once per round AND the per-receiver merge work is one vector
+    load instead of an F-way max — the cheapest per-element round this
+    module has.  Same contract as ``stripe_merge_update_blocked`` except
+    senders come as arc ``bases`` int32 [N].
+    """
+    n, nc, cs, _ = view.shape
+    if not stripe_supported(n, fanout, nc * cs * LANE):
+        raise ValueError(
+            f"arc merge update needs lane-aligned N, cs*LANE == "
+            f"{STRIPE_BLOCK_C} and N*{STRIPE_BLOCK_C} <= {STRIPE_MAX_BYTES} B "
+            f"(N={n}, blocked cols={cs * LANE}); use the XLA path"
+        )
+    if n % ARC_CHUNK:
+        raise ValueError(f"arc merge update needs N % {ARC_CHUNK} == 0, got {n}")
+    if not 1 < fanout <= ARC_CHUNK:
+        raise ValueError(f"arc fanout must be in (1, {ARC_CHUNK}], got {fanout}")
+    r_blk = max(min(block_r, n), _FUSED_BLOCK_R_MIN)
+    while n % r_blk:
+        r_blk //= 2
+
+    alive_lanes = jnp.broadcast_to(alive.astype(jnp.int32)[:, None], (n, LANE))
+    ext = ARC_CHUNK + fanout - 1
+    row_spec = lambda j, i: (i, j, 0, 0)  # noqa: E731
+    lane_blk = lambda dt: pl.BlockSpec(  # noqa: E731
+        (r_blk, 1, cs, LANE), row_spec, memory_space=pltpu.VMEM
+    )
+    subj_spec = pl.BlockSpec(
+        (1, cs, LANE), lambda j, i: (j, 0, 0), memory_space=pltpu.VMEM
+    )
+    hb5 = hb.reshape(n // r_blk, r_blk, nc, cs, LANE)
+    age5 = age.reshape(n // r_blk, r_blk, nc, cs, LANE)
+    status5 = status.reshape(n // r_blk, r_blk, nc, cs, LANE)
+    out = pl.pallas_call(
+        _arc_update_kernel(n, fanout, r_blk, member, unknown, age_clamp,
+                           failed, detect_stats),
+        grid=(nc, n // r_blk),
+        in_specs=[
+            pl.BlockSpec(
+                (r_blk, 1), lambda j, i: (i, 0), memory_space=pltpu.SMEM
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(
+                (r_blk, LANE), lambda j, i: (i, 0), memory_space=pltpu.VMEM
+            ),
+            subj_spec,
+            subj_spec,
+        ],
+        out_specs=[
+            lane_blk(hb.dtype), lane_blk(age.dtype), lane_blk(status.dtype),
+            subj_spec, subj_spec, subj_spec,
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, nc, cs, LANE), hb.dtype),
+            jax.ShapeDtypeStruct((n, nc, cs, LANE), age.dtype),
+            jax.ShapeDtypeStruct((n, nc, cs, LANE), status.dtype),
+            jax.ShapeDtypeStruct((nc, cs, LANE), jnp.int32),
+            jax.ShapeDtypeStruct((nc, cs, LANE), jnp.int32),
+            jax.ShapeDtypeStruct((nc, cs, LANE), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((n, cs, LANE), view.dtype),
+            pltpu.VMEM((ext, cs, LANE), jnp.bfloat16),
+            pltpu.VMEM((ext, cs, LANE), jnp.bfloat16),
+            pltpu.VMEM((fanout - 1, cs, LANE), view.dtype),
+            pltpu.VMEM((r_blk, cs, LANE), jnp.int32),
+            pltpu.VMEM((r_blk, cs, LANE), hb.dtype),
+            pltpu.VMEM((r_blk, cs, LANE), age.dtype),
+            pltpu.VMEM((r_blk, cs, LANE), status.dtype),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA((3,)),
+        ],
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=110 * 1024 * 1024),
+        interpret=interpret,
+    )(bases.reshape(n, 1), view, hb5, age5, status5, alive_lanes, shift_a, shift_b)
+    return tuple(out)
 
 
 @functools.partial(jax.jit, static_argnames=("fanout", "block_r", "interpret"))

@@ -1,0 +1,131 @@
+"""Fused-tick round == separate-pass round, bit for bit.
+
+The barrier-fused round (core/rounds._round_core_fused) recomputes the
+heartbeat tick around the merge kernel for crash-only scans on the XLA
+merge paths.  It must be indistinguishable from the separate-pass round
+the golden-parity suite pins to the reference protocol: same states, same
+detection/convergence rounds, same per-round metrics.
+
+The interpret-mode tests cross-check the stripe/arc production kernels
+(whose configs route to the separate-pass round, see _fused_ok) against
+the barrier-fused XLA round — two maximally different implementations of
+the same round must agree exactly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from gossipfs_tpu.config import SimConfig
+from gossipfs_tpu.core.rounds import run_rounds
+from gossipfs_tpu.core.state import init_state
+
+
+def _run(cfg: SimConfig, rounds: int, crash_rate: float, seed: int = 0):
+    key = jax.random.PRNGKey(seed)
+    state = init_state(cfg)
+    return run_rounds(state, cfg, rounds, key, crash_rate=crash_rate)
+
+
+def _assert_same(a, b):
+    fa, ca, pa = a
+    fb, cb, pb = b
+    assert jnp.array_equal(fa.hb, fb.hb)
+    assert jnp.array_equal(fa.age, fb.age)
+    assert jnp.array_equal(fa.status, fb.status)
+    assert jnp.array_equal(fa.alive, fb.alive)
+    assert jnp.array_equal(fa.hb_base, fb.hb_base)
+    assert jnp.array_equal(ca.first_detect, cb.first_detect)
+    assert jnp.array_equal(ca.first_observer, cb.first_observer)
+    assert jnp.array_equal(ca.converged, cb.converged)
+    assert jnp.array_equal(pa.true_detections, pb.true_detections)
+    assert jnp.array_equal(pa.false_positives, pb.false_positives)
+    assert jnp.array_equal(pa.n_alive, pb.n_alive)
+
+
+@pytest.mark.parametrize(
+    "topology,view_dtype,hb_dtype",
+    [
+        ("random", "int16", "int32"),
+        ("random", "int16", "int16"),
+        ("random", "int8", "int8"),
+        ("random_arc", "int8", "int8"),
+        ("random_arc", "int16", "int32"),
+    ],
+)
+def test_fused_matches_unfused(topology, view_dtype, hb_dtype):
+    base = SimConfig(
+        n=128,
+        topology=topology,
+        fanout=5,
+        remove_broadcast=False,
+        fresh_cooldown=True,
+        view_dtype=view_dtype,
+        hb_dtype=hb_dtype,
+    )
+    fused = _run(dataclasses.replace(base, fused_tick="auto"), 40, 0.02)
+    plain = _run(dataclasses.replace(base, fused_tick="off"), 40, 0.02)
+    _assert_same(fused, plain)
+
+
+def test_fused_small_group_refresh_parity():
+    """Fused rounds handle the min_group refresh path identically (most of
+    the cluster dead, survivors only refresh timestamps)."""
+    base = SimConfig(
+        n=128,
+        topology="random",
+        fanout=4,
+        remove_broadcast=False,
+        fresh_cooldown=True,
+    )
+    mask = jnp.arange(128) < 3  # below min_group=4 from the start
+    key = jax.random.PRNGKey(3)
+    out = {}
+    for mode in ("auto", "off"):
+        cfg = dataclasses.replace(base, fused_tick=mode)
+        out[mode] = run_rounds(init_state(cfg, mask), cfg, 20, key, crash_rate=0.0)
+    _assert_same(out["auto"], out["off"])
+
+
+def test_stripe_kernel_round_matches_xla_fused():
+    """Unfused stripe-kernel round (interpret) == barrier-fused XLA round."""
+    base = SimConfig(
+        n=4096,
+        topology="random",
+        fanout=6,
+        remove_broadcast=False,
+        fresh_cooldown=True,
+        view_dtype="int8",
+        hb_dtype="int8",
+        merge_block_c=4096,
+    )
+    key = jax.random.PRNGKey(5)
+    out = {}
+    for kernel in ("xla", "pallas_stripe_interpret"):
+        cfg = dataclasses.replace(base, merge_kernel=kernel)
+        out[kernel] = run_rounds(init_state(cfg), cfg, 8, key, crash_rate=0.01)
+    _assert_same(out["pallas_stripe_interpret"], out["xla"])
+
+
+def test_arc_kernel_round_matches_xla_fused():
+    """Unfused arc-kernel round (interpret) == barrier-fused XLA round."""
+    base = SimConfig(
+        n=4096,
+        topology="random_arc",
+        fanout=6,
+        remove_broadcast=False,
+        fresh_cooldown=True,
+        view_dtype="int8",
+        hb_dtype="int8",
+        merge_block_c=4096,
+    )
+    key = jax.random.PRNGKey(7)
+    out = {}
+    for kernel in ("xla", "pallas_stripe_interpret"):
+        cfg = dataclasses.replace(base, merge_kernel=kernel)
+        out[kernel] = run_rounds(init_state(cfg), cfg, 8, key, crash_rate=0.01)
+    _assert_same(out["pallas_stripe_interpret"], out["xla"])

@@ -118,6 +118,10 @@ def test_stripe_kernel_matches_oracle():
     shift_a = jax.random.randint(ks[5], (n,), 0, 5, jnp.int32)
     shift_b = jnp.zeros((n,), jnp.int32)
     alive = (jax.random.uniform(ks[6], (n,)) > 0.1).astype(jnp.int32)
+    # protocol invariant the kernels' two dead-receiver mechanisms (edge
+    # remap to self vs explicit liveness gate) both rely on: a dead node
+    # never sends, so its view row is all -1
+    view = jnp.where((alive != 0)[:, None], view, jnp.int8(-1))
     args = (
         view.reshape(shp), edges, hb.reshape(shp), age.reshape(shp),
         status.reshape(shp), shift_a.reshape(shp[1:]),
@@ -126,9 +130,15 @@ def test_stripe_kernel_matches_oracle():
     kw = dict(member=int(MEMBER), unknown=int(UNKNOWN), age_clamp=AGE_CLAMP,
               interpret=True)
     want = fused_merge_update_blocked(*args, **kw)
-    got = stripe_merge_update_blocked(*args, **kw)
+    *got, cnt, _ndet, _fobs = stripe_merge_update_blocked(*args, **kw)
     for g, w, name in zip(got, want, ("hb", "age", "status")):
         assert jnp.array_equal(g, w), name
+    # the member-count side output == the live-row column count (incl. self)
+    st_new = got[2].reshape(n, n)
+    want_cnt = jnp.sum(
+        ((alive != 0)[:, None]) & (st_new == MEMBER), axis=0, dtype=jnp.int32
+    )
+    assert jnp.array_equal(cnt.reshape(n), want_cnt)
 
 
 def test_arc_edges_expand_to_consecutive_window():
