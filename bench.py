@@ -73,9 +73,11 @@ def main() -> None:
         # int8 rebased view (required by the stripe kernel's VMEM budget)
         view_dtype="int8",
         merge_block_c=4_096 if use_tpu else 16_384,
-        # int16 hb storage (counters relative to hb_base, renormalized by the
-        # merge write) halves the fattest lane's HBM traffic
-        hb_dtype="int16",
+        # all-int8 state: every matrix lane is 1 B, the ALU-bound round
+        # packs 4x denser and the kernel's lane DMAs shrink accordingly.
+        # The 126-round int8 rebase window is certified by the 50k-round
+        # churn soak (bench/soak_hb16.py, int8 lane)
+        hb_dtype="int8",
     )
     key = jax.random.PRNGKey(0)
     state = init_state(cfg)

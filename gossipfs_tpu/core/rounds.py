@@ -873,10 +873,12 @@ def _fused_ok(config: SimConfig, matrix_events: bool, n: int, nloc: int) -> bool
     state rewrites: join/leave events (cross-row introducer pushes) and the
     REMOVE broadcast (a cross-receiver reduction feeding the same round's
     view) force the separate-pass round.  Ring mode re-derives edges from
-    2-D tables and stays on the parity path.  When a stripe kernel serves
-    this shape, the separate-pass round wins instead — its in-kernel
-    epilogue already writes each lane once, and the XLA tick+view pass
-    measured at streaming efficiency.
+    2-D tables and stays on the parity path.  Any live pallas kernel means
+    the separate-pass round instead: its epilogue (and the per-subject
+    reductions) already run in-kernel, and moving the elementwise tick
+    into Mosaic measured ~3x slower than XLA's elementwise engine (three
+    fused-tick kernel variants were built and rejected on the v5e — see
+    BASELINE.md's round-profile notes).
     """
     if (
         config.fused_tick != "auto"
@@ -885,9 +887,6 @@ def _fused_ok(config: SimConfig, matrix_events: bool, n: int, nloc: int) -> bool
         or config.topology == "ring"
     ):
         return False
-    # any live pallas kernel (stripe, arc, or gather) means the separate-pass
-    # round already runs a fused epilogue in-kernel; the barrier round serves
-    # the pure-XLA merge paths only
     return not _use_pallas(config, config.fanout, n, nloc)
 
 

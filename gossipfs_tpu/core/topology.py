@@ -87,7 +87,7 @@ def random_arc_bases(key: jax.Array, n: int, fanout: int) -> jax.Array:
     match).  What the structure buys: the F-way random row gather — the
     round's dominant cost — becomes one windowed row-max (computable in
     O(log F) passes, independent of F) plus a single 1-way gather
-    (ops/merge_pallas.py ``arc_window_max_blocked``).
+    (ops/merge_pallas.py ``arc_merge_update_blocked``).
     """
     draw = jax.random.randint(key, (n,), 0, n - fanout, dtype=jnp.int32)
     return (jnp.arange(n, dtype=jnp.int32) + 1 + draw) % n

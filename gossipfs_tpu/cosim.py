@@ -88,11 +88,14 @@ class CoSim:
             new_events = self.detector.drain_events()
             self.events.extend(new_events)
             for ev in new_events:
+                # logged by the DETECTING machine (slave.go:474): the entry
+                # lands in the observer's own Machine.log view
                 self.log.write(
                     f"Failure Detected of node {ev.subject} by {ev.observer}",
                     round=now,
                     kind="failure_detected",
                     false_positive=ev.false_positive,
+                    node=ev.observer,
                 )
                 # detection schedules recovery 8 heartbeats out (slave.go:1123)
                 self._recover_at.append(now + RECOVERY_DELAY)
@@ -113,26 +116,32 @@ class CoSim:
                         f"(was {old_master})",
                         round=now,
                         kind="election",
+                        node=self.cluster.master_node,  # the winner announces
                     )
             due = [r for r in self._recover_at if r <= now]
             if due:
                 self._recover_at = [r for r in self._recover_at if r > now]
                 plans = self.cluster.fail_recover()
                 for plan in plans:
+                    # logged by the SOURCE machine doing the Re_put
+                    # (slave.go:1174)
                     self.log.write(
                         f"Re-replicated {plan.file} v{plan.version} "
                         f"from {plan.source} to {list(plan.new_nodes)}",
                         round=now,
                         kind="re_replicate",
+                        node=plan.source,
                     )
 
     # -- client verbs delegated with sim time ------------------------------
     def put(self, name: str, data: bytes, confirm=None) -> bool:
         ok = self.cluster.put(name, data, now=self.round, confirm=confirm)
+        # logged at the master handling Get_put_info (server.go:74-121)
         self.log.write(
             f"put {name} -> {'ok' if ok else 'rejected'}",
             round=self.round,
             kind="put",
+            node=self.cluster.master_node,
         )
         return ok
 

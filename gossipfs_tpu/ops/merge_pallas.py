@@ -479,42 +479,34 @@ def fused_merge_update_blocked(
 
 
 def _epilogue_and_count(
-    best_scratch, hb_vmem, age_vmem, status_vmem, alive_ref, sa_ref, sb_ref,
+    best_rel, hb, age, st, recv, sa, sb,
     hb_out, age_out, status_out, cnt_out, ndet_out, fobs_out,
     i, r_blk: int, member: int, unknown: int, age_clamp: int,
-    failed: int, detect_stats: bool, n: int,
+    failed: int, detect_stats: bool, n: int, fail=None,
 ):
     """Block-wide merge epilogue shared by the stripe kernels.
 
-    MergeMemberList semantics over post-tick lanes (core/rounds.py
-    ``_membership_update``'s int32+clip formulation), plus per-subject
-    reductions accumulated across the consecutive receiver blocks that
-    revisit the same output block (grid: j outer, i inner):
+    MergeMemberList semantics over post-tick values (core/rounds.py
+    ``_membership_update``'s int32+clip formulation; ``hb``/``age``/``st``
+    arrive widened to int32, ``recv`` is the receiver-liveness mask), plus
+    per-subject reductions accumulated across the consecutive receiver
+    blocks that revisit the same output block (grid: j outer, i inner):
 
     * ``cnt_out`` — live observers holding the entry (self included — the
       caller subtracts the diagonal);
     * ``ndet_out`` / ``fobs_out`` (only when ``detect_stats``) — this
-      round's detector firings per subject and the lowest firing observer.
-      Valid under the crash-only + fresh_cooldown + no-remove-broadcast
-      fault model, where "detected this round" is exactly
-      ``status == FAILED and age == 0`` on the post-tick input lanes
-      (the detector is the only writer of FAILED, it stamps age 0, and
-      every older FAILED entry has aged at least once).  ``fobs_out`` is
-      ``n`` where no observer fired.
+      round's detector firings per subject and the lowest firing observer
+      (``n`` where no observer fired).  ``fail`` is the exact in-kernel
+      fail mask when the tick ran in-kernel; otherwise the stats fall back
+      to the ``status == FAILED and age == 0`` identity, valid under the
+      crash-only + fresh_cooldown + no-remove-broadcast fault model (the
+      detector is the only writer of FAILED, it stamps age 0, and every
+      older FAILED entry has aged at least once).
 
     These replace full-matrix major-axis reductions in XLA, which measured
     ~6x slower than minor-axis reductions.
     """
-    best_rel = best_scratch[...]
     any_member = best_rel >= 0
-    hb = hb_vmem[...].astype(jnp.int32)
-    st = status_vmem[...].astype(jnp.int32)
-    age = age_vmem[...].astype(jnp.int32)
-    sa = sa_ref[0][None]
-    sb = sb_ref[0][None]
-    # receiver liveness, replicated across lanes by the wrapper so it
-    # broadcasts over the subject dims without sublane shuffles
-    recv = alive_ref[...].reshape(alive_ref.shape[0], 1, LANE) != 0
     advance = recv & any_member & (st == member) & (best_rel > hb - sa)
     add = recv & any_member & (st == unknown)
     upd = advance | add
@@ -530,7 +522,7 @@ def _epilogue_and_count(
 
     part = jnp.sum((recv & (st_new == member)).astype(jnp.int32), axis=0)[None]
     if detect_stats:
-        fresh = (st == failed) & (age == 0)
+        fresh = fail if fail is not None else (st == failed) & (age == 0)
         ndet_part = jnp.sum(fresh.astype(jnp.int32), axis=0)[None]
         rows = lax.broadcasted_iota(jnp.int32, st.shape, 0) + i * r_blk
         fobs_part = jnp.min(jnp.where(fresh, rows, n), axis=0)[None]
@@ -601,10 +593,16 @@ def _stripe_kernel(
             c.wait()
 
         # Phase 2 — block-wide epilogue + per-subject reductions.
+        # receiver liveness, replicated across lanes by the wrapper so it
+        # broadcasts over the subject dims without sublane shuffles
+        recv = alive_ref[...].reshape(r_blk, 1, LANE) != 0
         _epilogue_and_count(
-            best_scratch, hb_vmem, age_vmem, status_vmem, alive_ref,
-            sa_ref, sb_ref, hb_out, age_out, status_out, cnt_out,
-            ndet_out, fobs_out,
+            best_scratch[...],
+            hb_vmem[...].astype(jnp.int32),
+            age_vmem[...].astype(jnp.int32),
+            status_vmem[...].astype(jnp.int32),
+            recv, sa_ref[0][None], sb_ref[0][None],
+            hb_out, age_out, status_out, cnt_out, ndet_out, fobs_out,
             i, r_blk, member, unknown, age_clamp, failed, detect_stats, n,
         )
 
@@ -806,32 +804,6 @@ def _windowmax_inplace(stripe, bufa, bufb, halo, fanout: int, nchunks: int):
     lax.fori_loop(0, nchunks, chunk_body, 0, unroll=False)
 
 
-def _arc_window_kernel(n: int, fanout: int, r_blk: int):
-    nchunks = n // ARC_CHUNK
-
-    def kernel(bases_ref, view_ref, best_out, stripe, bufa, bufb, halo, stripe_sem):
-        j = pl.program_id(0)
-        i = pl.program_id(1)
-
-        @pl.when(i == 0)
-        def _():
-            cp = pltpu.make_async_copy(view_ref.at[:, j], stripe, stripe_sem)
-            cp.start()
-            cp.wait()
-            _windowmax_inplace(stripe, bufa, bufb, halo, fanout, nchunks)
-
-        # one narrow vector load + store per receiver row — no F-way
-        # gather, no widening, no epilogue arithmetic (XLA fuses that into
-        # the round's elementwise chain at streaming efficiency)
-        def body(r, _):
-            best_out[r, 0] = stripe[bases_ref[r, 0]]
-            return 0
-
-        lax.fori_loop(0, r_blk, body, 0, unroll=False)
-
-    return kernel
-
-
 def _arc_update_kernel(
     n: int, fanout: int, r_blk: int, member: int, unknown: int,
     age_clamp: int, failed: int, detect_stats: bool,
@@ -839,7 +811,8 @@ def _arc_update_kernel(
     nchunks = n // ARC_CHUNK
 
     def kernel(
-        bases_ref, view_ref, hb_hbm, age_hbm, status_hbm, alive_ref, sa_ref, sb_ref,
+        bases_ref, view_ref, hb_hbm, age_hbm, status_hbm, alive_ref,
+        sa_ref, sb_ref,
         hb_out, age_out, status_out, cnt_out, ndet_out, fobs_out,
         stripe, bufa, bufb, halo, best_scratch,
         hb_vmem, age_vmem, status_vmem, stripe_sem, row_sems,
@@ -872,14 +845,18 @@ def _arc_update_kernel(
         for c in row_copies:
             c.wait()
 
-        # Phase 2 — block-wide epilogue + per-subject reductions.
-        # The receiver-liveness gate is load-bearing here: arc bases cannot
-        # be remapped to a "blank" row (every window-maxed stripe row holds
+        # Phase 2 — block-wide epilogue + per-subject reductions.  The
+        # receiver-liveness gate is load-bearing here: arc bases cannot be
+        # remapped to a "blank" row (every window-maxed stripe row holds
         # real values), so dead receivers are masked in the epilogue.
+        recv = alive_ref[...].reshape(r_blk, 1, LANE) != 0
         _epilogue_and_count(
-            best_scratch, hb_vmem, age_vmem, status_vmem, alive_ref,
-            sa_ref, sb_ref, hb_out, age_out, status_out, cnt_out,
-            ndet_out, fobs_out,
+            best_scratch[...],
+            hb_vmem[...].astype(jnp.int32),
+            age_vmem[...].astype(jnp.int32),
+            status_vmem[...].astype(jnp.int32),
+            recv, sa_ref[0][None], sb_ref[0][None],
+            hb_out, age_out, status_out, cnt_out, ndet_out, fobs_out,
             i, r_blk, member, unknown, age_clamp, failed, detect_stats, n,
         )
 
@@ -914,14 +891,17 @@ def arc_merge_update_blocked(
 ) -> tuple[jax.Array, ...]:
     """Arc merge + membership update + age advance + member count, fused.
 
-    The ``random_arc`` production kernel: combines
-    :func:`arc_window_max_blocked`'s O(log F) windowed row-max (senders are
-    F consecutive rows) with :func:`stripe_merge_update_blocked`'s
-    block-wide epilogue, so the hb/age/status lanes are read and written
+    The ``random_arc`` production kernel: combines the O(log F) windowed
+    row-max (:func:`_windowmax_inplace` — senders are F consecutive rows)
+    with :func:`stripe_merge_update_blocked`'s block-wide epilogue, so the hb/age/status lanes are read and written
     exactly once per round AND the per-receiver merge work is one vector
     load instead of an F-way max — the cheapest per-element round this
     module has.  Same contract as ``stripe_merge_update_blocked`` except
     senders come as arc ``bases`` int32 [N].
+
+    (An in-kernel-tick variant of this kernel was measured and rejected:
+    Mosaic's widened elementwise ran ~3x slower than the XLA tick pass it
+    replaced — see BASELINE.md's round-profile notes.)
     """
     n, nc, cs, _ = view.shape
     if not stripe_supported(n, fanout, nc * cs * LANE):
@@ -937,7 +917,6 @@ def arc_merge_update_blocked(
     r_blk = max(min(block_r, n), _FUSED_BLOCK_R_MIN)
     while n % r_blk:
         r_blk //= 2
-
     alive_lanes = jnp.broadcast_to(alive.astype(jnp.int32)[:, None], (n, LANE))
     ext = ARC_CHUNK + fanout - 1
     row_spec = lambda j, i: (i, j, 0, 0)  # noqa: E731
@@ -994,76 +973,9 @@ def arc_merge_update_blocked(
         ],
         compiler_params=pltpu.CompilerParams(vmem_limit_bytes=110 * 1024 * 1024),
         interpret=interpret,
-    )(bases.reshape(n, 1), view, hb5, age5, status5, alive_lanes, shift_a, shift_b)
+    )(bases.reshape(n, 1), view, hb5, age5, status5, alive_lanes,
+      shift_a, shift_b)
     return tuple(out)
-
-
-@functools.partial(jax.jit, static_argnames=("fanout", "block_r", "interpret"))
-def arc_window_max_blocked(
-    view: jax.Array,
-    bases: jax.Array,
-    *,
-    fanout: int,
-    block_r: int = _FUSED_BLOCK_R,
-    interpret: bool = False,
-) -> jax.Array:
-    """``best[i, :] = max over view rows bases[i]..bases[i]+F-1 (mod N)``.
-
-    The ``random_arc`` merge core: senders are F *consecutive* rows, so the
-    F-way max factors into one windowed row-max over the VMEM-resident
-    stripe (O(log F) in-VMEM passes per stripe) plus a single vector load
-    per receiver.  Unlike the fused gather kernels this returns only the
-    merged view row — the membership update stays in XLA, whose fusion
-    runs the widened elementwise arithmetic at streaming efficiency
-    (measured faster than a hand-written in-kernel epilogue, which was
-    VPU-bound).
-
-    ``view``: blocked [N, nc, cs, LANE] with cs*LANE == STRIPE_BLOCK_C;
-    ``bases``: int32 [N].  Returns best in the same blocked shape/dtype
-    (-1 lanes = no sender carried the entry).
-    """
-    n, nc, cs, _ = view.shape
-    if not stripe_supported(n, fanout, nc * cs * LANE):
-        raise ValueError(
-            f"arc window max needs lane-aligned N, cs*LANE == "
-            f"{STRIPE_BLOCK_C} and N*{STRIPE_BLOCK_C} <= {STRIPE_MAX_BYTES} B "
-            f"(N={n}, blocked cols={cs * LANE}); use the XLA path"
-        )
-    if n % ARC_CHUNK:
-        raise ValueError(f"arc window max needs N % {ARC_CHUNK} == 0, got {n}")
-    if not 1 < fanout <= ARC_CHUNK:
-        raise ValueError(f"arc fanout must be in (1, {ARC_CHUNK}], got {fanout}")
-    r_blk = max(min(block_r, n), _FUSED_BLOCK_R_MIN)
-    while n % r_blk:
-        r_blk //= 2
-
-    ext = ARC_CHUNK + fanout - 1
-    return pl.pallas_call(
-        _arc_window_kernel(n, fanout, r_blk),
-        grid=(nc, n // r_blk),
-        in_specs=[
-            pl.BlockSpec(
-                (r_blk, 1), lambda j, i: (i, 0), memory_space=pltpu.SMEM
-            ),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
-        out_specs=pl.BlockSpec(
-            (r_blk, 1, cs, LANE), lambda j, i: (i, j, 0, 0),
-            memory_space=pltpu.VMEM,
-        ),
-        out_shape=jax.ShapeDtypeStruct((n, nc, cs, LANE), view.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((n, cs, LANE), view.dtype),
-            # window-max ping-pong runs in bf16: v5e Mosaic cannot legalize
-            # int8 vector max, and bf16 is exact over the int8 view range
-            pltpu.VMEM((ext, cs, LANE), jnp.bfloat16),
-            pltpu.VMEM((ext, cs, LANE), jnp.bfloat16),
-            pltpu.VMEM((fanout - 1, cs, LANE), view.dtype),
-            pltpu.SemaphoreType.DMA,
-        ],
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=110 * 1024 * 1024),
-        interpret=interpret,
-    )(bases.reshape(n, 1), view)
 
 
 def fanout_max_merge_xla(view: jax.Array, edges: jax.Array) -> jax.Array:

@@ -11,7 +11,8 @@ Command surface matches README.md:8-29 plus fault/time controls the sim adds:
   show_metadata | check              master's file->replica map
   advance <r>                        advance simulated time by r rounds
   events                             detection events so far
-  grep <regex>                       search the event log (MP1 legacy verb)
+  grep [--node <k>] <regex>          search the event log (MP1 legacy verb);
+                                     --node scopes to one machine's log view
 
 Run: ``python -m gossipfs_tpu.shim.cli [--n 16] [--topology ring]``
 """
@@ -21,10 +22,48 @@ from __future__ import annotations
 import argparse
 import pathlib
 import re
+import select
 import sys
 
 from gossipfs_tpu.config import SimConfig
 from gossipfs_tpu.cosim import CoSim
+from gossipfs_tpu.sdfs.types import CONFIRM_TIMEOUT
+
+
+def stdin_confirm(
+    name: str,
+    timeout: float = float(CONFIRM_TIMEOUT),
+    stream=None,
+    out=sys.stdout,
+) -> bool:
+    """Interactive write-conflict prompt (reference: server.go:144-153).
+
+    The reference's master, on a put within the 60 s conflict window, asks
+    the requester's human a yes/no question on stdin with a 30 s timeout
+    defaulting to reject (server.go:172).  Reads one line from ``stream``
+    (the REPL's own input) under ``select`` so a silent terminal rejects
+    after the timeout instead of hanging the session.
+    """
+    stream = stream if stream is not None else sys.stdin
+    print(
+        f"{name} was updated in the last 60 rounds. Overwrite? "
+        f"[y/N, {int(timeout)} s timeout rejects]",
+        file=out,
+        flush=True,
+    )
+    try:
+        ready, _, _ = select.select([stream], [], [], timeout)
+    except (ValueError, OSError, TypeError):
+        # stream without a selectable fd (in-memory test streams): read
+        # directly — the caller controls pacing there
+        ready = [stream]
+    if not ready:
+        print("confirmation timed out: rejecting write", file=out)
+        return False
+    line = stream.readline()
+    if isinstance(line, bytes):
+        line = line.decode(errors="replace")
+    return line.strip().lower() in ("y", "yes")
 
 
 def make_parser() -> argparse.ArgumentParser:
@@ -33,11 +72,26 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--topology", choices=["ring", "random"], default="ring")
     p.add_argument("--fanout", type=int, default=3)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--confirm-timeout", type=float, default=float(CONFIRM_TIMEOUT),
+        help="seconds to wait for the write-conflict yes/no before "
+             "rejecting (reference: server.go:172)",
+    )
     return p
 
 
-def dispatch(sim: CoSim, line: str, out=sys.stdout) -> bool:
-    """Execute one REPL command; returns False on quit."""
+def dispatch(
+    sim: CoSim,
+    line: str,
+    out=sys.stdout,
+    in_stream=None,
+    confirm_timeout: float = float(CONFIRM_TIMEOUT),
+) -> bool:
+    """Execute one REPL command; returns False on quit.
+
+    ``in_stream`` is where the write-conflict confirmation prompt reads its
+    yes/no answer (the REPL's own stdin) — see :func:`stdin_confirm`.
+    """
     parts = line.strip().split()
     if not parts:
         return True
@@ -60,7 +114,14 @@ def dispatch(sim: CoSim, line: str, out=sys.stdout) -> bool:
             print(f"round={sim.round}", file=out)
         elif cmd == "put":
             data = pathlib.Path(args[0]).read_bytes()
-            ok = sim.put(args[1], data)
+            name = args[1]
+            ok = sim.put(
+                name,
+                data,
+                confirm=lambda: stdin_confirm(
+                    name, timeout=confirm_timeout, stream=in_stream, out=out
+                ),
+            )
             print("ok" if ok else "Write-Write conflicts!", file=out)
         elif cmd == "get":
             blob = sim.get(args[0])
@@ -83,7 +144,13 @@ def dispatch(sim: CoSim, line: str, out=sys.stdout) -> bool:
             for ev in sim.events:
                 print(ev, file=out)
         elif cmd == "grep":
-            for entry in sim.log.grep(" ".join(args)):
+            # ``grep [--node <k>] <pattern>``: the explicit flag scopes the
+            # search to node k's own log view (distributed-grep analog);
+            # without it the pattern is searched verbatim, digits included
+            node = None
+            if len(args) >= 2 and args[0] == "--node":
+                node, args = int(args[1]), args[2:]
+            for entry in sim.log.grep(" ".join(args), node=node):
                 print(entry, file=out)
         else:
             print(f"unknown command: {cmd}", file=out)
@@ -101,8 +168,16 @@ def main(argv=None) -> None:
         parser.error(str(e))
     sim = CoSim(cfg, seed=args.seed)
     print(f"gossipfs sim: {args.n} nodes, {args.topology} topology. 'quit' to exit.")
-    for line in sys.stdin:
-        if not dispatch(sim, line):
+    # Read stdin UNBUFFERED (byte-at-a-time lines): any buffered layer
+    # (the ``for line in sys.stdin`` iterator's read-ahead, or even
+    # TextIOWrapper.readline's internal chunking) would slurp pending
+    # lines into user space, where the confirmation prompt's select() on
+    # the raw fd cannot see them — a piped-in 'y' answer would look like
+    # silence and falsely time out.
+    stdin = open(sys.stdin.fileno(), "rb", buffering=0, closefd=False)
+    for raw in iter(stdin.readline, b""):
+        if not dispatch(sim, raw.decode(errors="replace"), in_stream=stdin,
+                        confirm_timeout=args.confirm_timeout):
             break
 
 

@@ -11,6 +11,7 @@ dict out — the json_format transcoding lives in wire.py).
 from __future__ import annotations
 
 import base64
+import time
 
 import grpc
 
@@ -42,6 +43,19 @@ class ShimClient:
                 request_serializer=wire.request_serializer(method),
                 response_deserializer=wire.response_deserializer(method),
             )
+        # RESOURCE_EXHAUSTED is the server's explicit backpressure (its
+        # Advance handlers fail fast instead of holding workers parked on
+        # the election lock — service.py ShimServicer._advance_slots):
+        # retry with backoff rather than surfacing it to every caller
+        delay = 0.05
+        for _ in range(6):
+            try:
+                return fn(request, timeout=self.timeout)
+            except grpc.RpcError as e:
+                if e.code() is not grpc.StatusCode.RESOURCE_EXHAUSTED:
+                    raise
+                time.sleep(delay)
+                delay = min(delay * 2, 1.0)
         return fn(request, timeout=self.timeout)
 
     # -- convenience wrappers for the common verbs -------------------------

@@ -7,10 +7,12 @@ mirrors all 12 RPCs one-for-one, backed by the simulated detector + SDFS
 control plane (``CoSim``), plus the membership verbs (join/leave/lsm) the
 north star says external consumers keep using across the shim.
 
-No ``.proto`` codegen is required: messages are JSON dicts over gRPC's
-generic-handler API (``grpc.method_handlers_generic_handler``) — the wire is
-still HTTP/2 gRPC, so any language with a gRPC runtime can call it by method
-path ``/gossipfs.Shim/<Method>`` with a JSON body.
+The wire is protobuf per ``shim/gossipfs.proto`` (see ``shim/wire.py``):
+messages are real proto structs encoded/decoded at this server's boundary
+through gRPC's generic-handler API, so any language's gRPC toolchain can
+generate a full client from the ``.proto`` — tools/gossipfs_sh_client.sh
+drives the server with protoc + curl alone (no Python, no gRPC runtime;
+tests/test_sh_client.py runs it in CI).
 
 Method map (reference server/server.go -> here):
 
@@ -78,6 +80,12 @@ class ShimServicer:
         # serializes tick+election pairs: a concurrent Advance must not
         # mutate detector state while an election reads per-node views
         self._election_lock = threading.Lock()
+        # set by ShimServer: caps concurrent Advance handlers below the
+        # worker-pool size so the election's self-dialed Vote /
+        # AssignNewMaster RPCs always find a free worker (otherwise
+        # Advances parked on _election_lock could hold every worker and
+        # starve the self-call until its deadline — a livelock)
+        self._advance_slots: threading.BoundedSemaphore | None = None
         # Vote tallies: candidate -> set of voters (Receive_vote state,
         # reference: slave/slave.go:53-57, 968-984)
         self._votes: dict[int, set[int]] = {}
@@ -122,18 +130,34 @@ class ShimServicer:
             return {"nodes": self.sim.detector.alive_nodes()}
 
     def Advance(self, req, ctx):
-        # the election lock (taken OUTSIDE the sim lock) serializes whole
-        # tick+election sequences: no other Advance can mutate detector
-        # state while run_pending_election reads per-node views
-        with self._election_lock:
-            with self._lock:
-                self._snapshots = None  # synchronous path resolves bulk scans
-                self.sim.tick(int(req.get("rounds", 1)))
-                out = {"round": self.sim.round}
-            # sim lock released: the distributed election self-dials Vote /
-            # AssignNewMaster on this server, whose handlers take it
-            self.run_pending_election()
-        return out
+        # fail fast when the worker pool is saturated with Advances rather
+        # than park on _election_lock holding a worker thread — the
+        # reserved headroom keeps the election's self-dialed RPCs
+        # schedulable (see _advance_slots); ShimClient retries RESOURCE_
+        # EXHAUSTED with backoff
+        slots = self._advance_slots
+        if slots is not None and not slots.acquire(blocking=False):
+            ctx.abort(
+                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                "advance workers saturated; retry",
+            )
+        try:
+            # the election lock (taken OUTSIDE the sim lock) serializes
+            # whole tick+election sequences: no other Advance can mutate
+            # detector state while run_pending_election reads per-node views
+            with self._election_lock:
+                with self._lock:
+                    self._snapshots = None  # synchronous path resolves bulk scans
+                    self.sim.tick(int(req.get("rounds", 1)))
+                    out = {"round": self.sim.round}
+                # sim lock released: the distributed election self-dials
+                # Vote / AssignNewMaster on this server, whose handlers
+                # take it
+                self.run_pending_election()
+            return out
+        finally:
+            if slots is not None:
+                slots.release()
 
     # -- distributed election (reference: slave.go:930-1051) ---------------
     def _self_call(self, method: str, **req):
@@ -200,6 +224,7 @@ class ShimServicer:
                 f"(was {old_master})",
                 round=now,
                 kind="election",
+                node=winner,  # the winner announces (slave.go:968-984)
             )
         return True
 
@@ -248,9 +273,19 @@ class ShimServicer:
 
     # -- the 12 reference RPCs --------------------------------------------
     def Grep(self, req, ctx):
-        """TCPServer.Response — distributed log grep (server.go:55-72)."""
+        """TCPServer.Response — distributed log grep (server.go:55-72).
+
+        An optional ``node`` restricts the search to that machine's own log
+        view, matching the reference's grep-one-machine's-Machine.log
+        semantics; without it the whole cluster's stream is searched.
+        """
         with self._lock:
-            return {"lines": self.sim.log.grep(req["pattern"])}
+            node = req.get("node")
+            return {
+                "lines": self.sim.log.grep(
+                    req["pattern"], node=None if node is None else int(node)
+                )
+            }
 
     def _ask_confirmation(self, callback: str, name: str) -> bool:
         """Master -> requester confirmation round-trip (server.go:155-177).
@@ -299,14 +334,24 @@ class ShimServicer:
         name = req["file"]
         with self._lock:
             now = self.sim.round
-            conflict = self.sim.cluster.master.updated_recently(name, now)
+            master = self.sim.cluster.master
+            conflict = master.updated_recently(name, now)
+            # version observed when the confirmation was asked: the answer
+            # covers overwriting THIS write, not one that races in later
+            _, seen_version = master.file_info(name)
         confirmed = self._resolve_conflict(req, name) if conflict else False
         if conflict and not confirmed:
             return {"ok": False, "conflict": True}
         with self._lock:
             master = self.sim.cluster.master
-            if master.updated_recently(name, self.sim.round) and not confirmed:
-                # a concurrent put landed while we were outside the lock
+            _, cur_version = master.file_info(name)
+            if master.updated_recently(name, self.sim.round) and (
+                not confirmed or cur_version != seen_version
+            ):
+                # a concurrent put landed while the lock was released (e.g.
+                # during the confirmation callback): it needs its own
+                # confirmation — any earlier answer was about the version
+                # observed then, so re-reject and let the client retry
                 return {"ok": False, "conflict": True}
             replicas, version = master.handle_put(name, self.sim.round)
             return {"ok": bool(replicas), "replicas": replicas, "version": version}
@@ -513,6 +558,11 @@ class ShimServer:
         opts = wire.message_size_options(max_message_mb)
         self.server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers), options=opts
+        )
+        # leave >= 2 workers free for the election's self-dialed Vote /
+        # AssignNewMaster RPCs (see ShimServicer._advance_slots)
+        self.servicer._advance_slots = threading.BoundedSemaphore(
+            max(1, max_workers - 2)
         )
         self.server.add_generic_rpc_handlers((self.servicer.generic_handler(),))
         self.port = self.server.add_insecure_port(f"{host}:{port}")

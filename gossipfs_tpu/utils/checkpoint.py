@@ -30,10 +30,25 @@ from gossipfs_tpu.core.state import SimState
 def save_checkpoint(
     path: str | pathlib.Path, state: SimState, key: jax.Array
 ) -> None:
-    """Write (state, key) under ``path`` (a directory, created fresh)."""
+    """Write (state, key) under ``path`` (a directory, created fresh).
+
+    ``hb_floor`` records the storage dtype's floor-sentinel value (0 for
+    absolute int32 storage, which has no sentinels) IN the payload, so
+    restore never has to infer the saved era from best-effort metadata —
+    re-encoding a missed sentinel as an ordinary counter would fabricate
+    heartbeat values (the zombie corner the rebase excludes).
+    """
     path = pathlib.Path(path).resolve()
+    floor = (
+        0 if state.hb.dtype == jnp.int32 else int(jnp.iinfo(state.hb.dtype).min)
+    )
+    payload = {
+        "state": state._asdict(),
+        "key": key,
+        "hb_floor": jnp.asarray(floor, jnp.int32),
+    }
     with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(path, {"state": state._asdict(), "key": key}, force=True)
+        ckptr.save(path, payload, force=True)
 
 
 def _abstract_like(config: SimConfig, mesh: Mesh | None) -> dict:
@@ -108,12 +123,21 @@ def restore_checkpoint(
             return out
 
         legacy_no_base = False
+        has_floor = False
+        probed_min: int | None = None
         try:
             meta = ckptr.metadata(path)
             tree = meta.item_metadata if hasattr(meta, "item_metadata") else meta
-            legacy_no_base = "hb_base" not in getattr(tree, "tree", tree)["state"]
+            tree = getattr(tree, "tree", tree)
+            legacy_no_base = "hb_base" not in tree["state"]
+            has_floor = "hb_floor" in tree
+            probed_min = int(jnp.iinfo(tree["state"]["hb"].dtype).min)
         except Exception:
-            pass  # metadata probe is best-effort; fall through to restore
+            pass  # metadata probe is best-effort; the payload field and the
+            #       loud check below make sentinel decoding never guess
+        if has_floor:
+            abstract = dict(abstract)
+            abstract["hb_floor"] = jax.ShapeDtypeStruct((), jnp.int32)
         if legacy_no_base:
             restored = restore_legacy()
         else:
@@ -133,6 +157,33 @@ def restore_checkpoint(
     # requested mode.  Counters above int16 range renormalize against a
     # fresh base instead of silently wrapping.
     true_hb = restored["state"]["hb"] + restored["state"]["hb_base"][None, :]
+    # Floor sentinels from narrow-era checkpoints (stored == the saved
+    # dtype's minimum under a positive base) carry NO counter value —
+    # decoding them as ordinary counters would fabricate heartbeats
+    # (suppressing detection for that lane).  Identify them up front for
+    # BOTH re-encode targets; the floor value comes from the checkpoint
+    # payload itself (save_checkpoint's ``hb_floor``) or, for pre-hb_floor
+    # checkpoints, the metadata probe above.  A provably narrow-era
+    # checkpoint with no identifiable floor is refused loudly.
+    if has_floor:
+        saved_min = int(restored.pop("hb_floor"))
+        saved_min = saved_min if saved_min != 0 else None
+    else:
+        saved_min = probed_min
+    narrow_era = bool(jnp.any(restored["state"]["hb_base"] > 0))
+    if narrow_era and saved_min is None:
+        raise ValueError(
+            f"checkpoint at {path} uses narrow (rebased) heartbeat "
+            "storage but carries no hb_floor field and its metadata "
+            "dtype could not be read — cannot identify floor sentinels; "
+            "refusing to fabricate counters"
+        )
+    if saved_min is None:  # absolute int32-era storage: no sentinels
+        sentinel = jnp.zeros(restored["state"]["hb"].shape, dtype=bool)
+    else:
+        sentinel = (restored["state"]["hb"] == saved_min) & (
+            restored["state"]["hb_base"][None, :] > 0
+        )
     if config.hb_dtype != "int32":
         # Anchor the restore base exactly like the in-round rebase
         # (core/rounds._pre_tick): on the subject's own DIAGONAL counter —
@@ -148,20 +199,6 @@ def restore_checkpoint(
         tgt = jnp.int16 if config.hb_dtype == "int16" else jnp.int8
         info = jnp.iinfo(tgt)
         window = REBASE_WINDOW if config.hb_dtype == "int16" else INT8_REBASE_WINDOW
-        # a narrow-era checkpoint's floor sentinels are stored at the SAVED
-        # dtype's minimum under a positive base (probe the saved dtype from
-        # the checkpoint metadata; default to int16-era)
-        saved_min = -32768
-        try:
-            meta = ocp.StandardCheckpointer().metadata(path)
-            tree = meta.item_metadata if hasattr(meta, "item_metadata") else meta
-            saved_dtype = getattr(tree, "tree", tree)["state"]["hb"].dtype
-            saved_min = jnp.iinfo(saved_dtype).min
-        except Exception:
-            pass
-        sentinel = (restored["state"]["hb"] == saved_min) & (
-            restored["state"]["hb_base"][None, :] > 0
-        )
         n_ck = true_hb.shape[0]
         diag = true_hb[jnp.arange(n_ck), jnp.arange(n_ck)]
         new_base = jnp.maximum(diag + 1 - window, 0)
@@ -172,6 +209,15 @@ def restore_checkpoint(
         )
         restored["state"]["hb_base"] = new_base
     else:
-        restored["state"]["hb"] = true_hb
+        # int32 target: sentinels have no storage-floor representation, so
+        # quarantine them FAR above any reachable counter (rounds are the
+        # only source of increments, so legitimate counters stay tiny).
+        # The view rebase clamp excludes values more than a window above
+        # the subject's diagonal from gossip, so quarantined lanes never
+        # spread, age out at their holders, and stay detectable — exactly
+        # the narrow modes' zombie semantics.
+        restored["state"]["hb"] = jnp.where(
+            sentinel, jnp.int32(2 ** 30), true_hb
+        )
         restored["state"]["hb_base"] = jnp.zeros_like(restored["state"]["hb_base"])
     return SimState(**restored["state"]), restored["key"]
