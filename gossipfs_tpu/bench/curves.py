@@ -39,12 +39,20 @@ TRACK = 8
 
 
 def sweep(ns=DEFAULT_NS, rounds=ROUNDS, crash_rate=0.01, seed=0,
-          topology="random", donate=False) -> dict:
+          topology="random", donate=False, hb_dtype="int16",
+          time_rounds=False) -> dict:
     """``topology`` sweeps "random" (iid fanout) or "random_arc" (windowed
     arc senders) — the arc rows must match the iid rows within noise, which
     is the protocol-equivalence evidence for the fast arc merge kernel.
     ``donate=True`` runs the buffer-donating scan — required for the
-    N=32,768 single-chip point, whose state would not otherwise fit."""
+    single-chip capacity points (N >= 32,768), whose state would not
+    otherwise fit.  ``hb_dtype="int8"`` is the all-int8 state (3 B per
+    tracked membership entry) that pushes the frontier to N=49,152.
+    ``time_rounds=True`` adds a measured rounds/s per row (a second run on
+    a fresh state, so compile time and the donated first state are
+    excluded)."""
+    import time as _time
+
     from gossipfs_tpu.core.rounds import run_rounds_donate
 
     runner = run_rounds_donate if donate else run_rounds
@@ -59,23 +67,47 @@ def sweep(ns=DEFAULT_NS, rounds=ROUNDS, crash_rate=0.01, seed=0,
             t_cooldown=12,
             merge_kernel="pallas",
             view_dtype="int8",
-            hb_dtype="int16",
+            hb_dtype=hb_dtype,
             merge_block_c=16_384,
         )
         events, crash_rounds, churn_ok = tracked_crash_events(
             cfg, rounds, TRACK, CRASH_AT
         )
+        # tracked_crash_events schedules crashes only: the static promise
+        # keeps the lean event path (no [N, N] fail matrix, in-kernel
+        # detection stats) — required headroom at the capacity points
         final, carry, per_round = runner(
             init_state(cfg), cfg, rounds, jax.random.PRNGKey(seed),
             events=events, crash_rate=crash_rate, churn_ok=churn_ok,
+            crash_only_events=True,
         )
         report = summarize(carry, per_round, crash_rounds)
+        rps = None
+        if time_rounds:
+            # free the measurement run's final state before allocating the
+            # timing run's — at the capacity points only one full state
+            # (plus the round's working set) fits in HBM
+            jax.block_until_ready(final)
+            del final, carry, per_round
+            st2 = init_state(cfg)
+            jax.block_until_ready(st2)
+            t0 = _time.perf_counter()
+            out2, _, _ = runner(
+                st2, cfg, rounds, jax.random.PRNGKey(seed),
+                events=events, crash_rate=crash_rate, churn_ok=churn_ok,
+                crash_only_events=True,
+            )
+            jax.block_until_ready(out2)
+            rps = round(rounds / (_time.perf_counter() - t0), 2)
+            del out2
         ttd_f = [v for v in report.ttd_first.values() if v >= 0]
         ttd_c = [v for v in report.ttd_converged.values() if v >= 0]
         rows.append(
             {
                 "n": n,
                 "fanout": cfg.fanout,
+                "hb_dtype": hb_dtype,
+                "rounds_per_sec": rps,
                 "tracked_crashes": len(crash_rounds),
                 "detected": len(ttd_f),
                 "ttd_first_median": statistics.median(ttd_f) if ttd_f else None,
@@ -141,6 +173,10 @@ def main(argv=None) -> None:
     p.add_argument("--rounds", type=int, default=ROUNDS)
     p.add_argument("--topology", choices=["random", "random_arc"],
                    default="random")
+    p.add_argument("--hb-dtype", choices=["int32", "int16", "int8"],
+                   default="int16")
+    p.add_argument("--time-rounds", action="store_true",
+                   help="add measured rounds/s per row (second run)")
     p.add_argument("--donate", action="store_true",
                    help="buffer-donating scan (needed for N=32768 single-chip)")
     p.add_argument("--t-fail-sweep", action="store_true",
@@ -151,7 +187,9 @@ def main(argv=None) -> None:
         doc = json.dumps(sweep_t_fail(rounds=args.rounds))
     else:
         doc = json.dumps(sweep(ns=tuple(args.ns), rounds=args.rounds,
-                               topology=args.topology, donate=args.donate))
+                               topology=args.topology, donate=args.donate,
+                               hb_dtype=args.hb_dtype,
+                               time_rounds=args.time_rounds))
     print(doc)
     if args.out:
         with open(args.out, "w") as f:

@@ -769,9 +769,12 @@ def _merge(
             # blocked layout (see module header): view/hb/age/status arrive
             # in the kernel-native 4-D shape, so the fused kernel runs with
             # no relayout at all
-            hb, age, status = merge_pallas.fused_merge_update_blocked(
-                view, edges, hb, age, status, shift_a, shift_b, alive32,
-                **kernel_kwargs
+            hb, age, status, cnt_incl, k_ndet, k_fobs = (
+                merge_pallas.fused_merge_update_blocked(
+                    view, edges, hb, age, status, shift_a, shift_b, alive32,
+                    failed=int(FAILED), detect_stats=detect_stats,
+                    **kernel_kwargs
+                )
             )
         else:
             # ring mode stays 2-D (see _use_blocked) and pays the wrapper's
@@ -968,13 +971,18 @@ def gossip_round(
     events: RoundEvents,
     edges: jax.Array | None,
     config: SimConfig,
-) -> tuple[SimState, RoundMetrics, jax.Array]:
+) -> tuple[SimState, RoundMetrics, jax.Array, jax.Array]:
     """Advance the whole cluster by one heartbeat period.
 
     ``edges`` is the random-topology in-edge array; pass None for ring mode,
     where edges are derived from the post-tick membership tables (the
     reference computes push targets after updateMemberList, slave.go:510-524).
-    Returns (next_state, per-round metrics, fail_events [N,N]).
+    Returns (next_state, per-round metrics, any_fail [N], first_obs [N]):
+    the per-subject detection vectors, NOT the [N, N] fail matrix — the
+    interactive driver (detector/sim.py ``advance``) reads them to the host
+    every eventful round, so the transfer is O(N) instead of O(N^2)
+    (``first_obs[j]`` is the lowest-index observer whose detector fired on
+    j this round; meaningful only where ``any_fail``).
 
     Single-round calls pay the blocked-layout relayout on the pallas path;
     the scan in :func:`run_rounds` converts once for the whole horizon.
@@ -983,10 +991,12 @@ def gossip_round(
     blocked = _use_blocked(config, config.fanout, n)
     if blocked:
         state = _to_blocked(state, config)
-    state, metrics, fail, _, _, _ = _round_core(state, events, edges, config)
+    state, metrics, _fail, any_fail, first_obs, _ = _round_core(
+        state, events, edges, config
+    )
     if blocked:
         state = _from_blocked(state)
-    return state, metrics, fail.reshape(n, n)
+    return state, metrics, any_fail, first_obs
 
 
 def _update_carry(
@@ -1117,6 +1127,7 @@ def _run_rounds_impl(
     rejoin_rate: float = 0.0,
     churn_ok: jax.Array | None = None,
     mcarry0: MetricsCarry | None = None,
+    crash_only_events: bool = False,
 ) -> tuple[SimState, MetricsCarry, RoundMetrics]:
     """Scan ``num_rounds`` gossip rounds.
 
@@ -1141,8 +1152,16 @@ def _run_rounds_impl(
     """
     n = config.n
     # static: no scheduled events + no random rejoins => the leave/join
-    # matrix rewrites drop out of the compiled round entirely
-    matrix_events = events is not None or rejoin_rate > 0.0
+    # matrix rewrites drop out of the compiled round entirely.
+    # ``crash_only_events`` is the caller's static promise that scheduled
+    # events carry no leave/join bits (e.g. bench.tracked_crash_events),
+    # which keeps the lean event path — and, with it, the in-kernel
+    # detection stats and the fail matrix never materializing — even with
+    # a tracked-crash schedule.  Leave bits are still honored as silent
+    # death (same liveness effect), join bits would be IGNORED.
+    matrix_events = (
+        events is not None and not crash_only_events
+    ) or rejoin_rate > 0.0
     if events is None:
         zeros = jnp.zeros((num_rounds, n), dtype=bool)
         events = RoundEvents(crash=zeros, leave=zeros, join=zeros)
@@ -1160,7 +1179,9 @@ def _run_rounds_impl(
     return state, mcarry, per_round
 
 
-_RUN_ROUNDS_STATIC = ("config", "num_rounds", "crash_rate", "rejoin_rate")
+_RUN_ROUNDS_STATIC = (
+    "config", "num_rounds", "crash_rate", "rejoin_rate", "crash_only_events"
+)
 run_rounds = partial(jax.jit, static_argnames=_RUN_ROUNDS_STATIC)(_run_rounds_impl)
 # in-place variant: XLA reuses the input state's HBM for the output (the
 # caller's ``state`` is consumed).  At N=32k the scan needs ~13 GiB without

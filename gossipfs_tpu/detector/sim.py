@@ -106,18 +106,27 @@ class SimDetector:
 
                 edges = topology.in_edges(self.config, k, None)
             round_idx = int(self.state.round)
-            self.state, _, fail = gossip_round(self.state, ev, edges, self.config)
-            if not bool(jnp.any(fail)):
-                # quiet round: one scalar transfer instead of the [N, N]
-                # fail matrix (the O(N^2)-per-round host traffic the round-1
-                # review flagged)
+            self.state, _, any_fail, first_obs = gossip_round(
+                self.state, ev, edges, self.config
+            )
+            if not bool(jnp.any(any_fail)):
+                # quiet round: one scalar transfer
                 continue
+            # eventful round: the per-subject vectors the round computes
+            # anyway — O(N) host bytes instead of the [N, N] fail matrix
+            # (the round-2 review's last interactive-path flag).  One event
+            # per newly-detected subject, attributed to the lowest-index
+            # firing observer — the same first-observer semantics as bulk
+            # advancement (and effectively the reference's, whose first
+            # detector's REMOVE broadcast preempts the others).
+            af = np.asarray(any_fail)
+            fo = np.asarray(first_obs)
             alive = np.asarray(self.state.alive)
-            for obs, subj in np.argwhere(np.asarray(fail)):
+            for subj in np.nonzero(af)[0]:
                 self._events.append(
                     DetectionEvent(
                         round=round_idx,
-                        observer=int(obs),
+                        observer=int(fo[subj]),
                         subject=int(subj),
                         false_positive=bool(alive[subj]),
                     )

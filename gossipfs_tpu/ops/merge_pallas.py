@@ -48,7 +48,8 @@ LANE = 128
 MIN_COMPILED_BLOCK_C = 32 * LANE
 
 
-def _gather_max_rows(edges_ref, view_ref, scratch, sems, n_fanout, r_blk, slots, sink):
+def _gather_max_rows(edges_ref, view_ref, scratch, sems, n_fanout, r_blk, slots, sink,
+                     jdim: int = 1):
     """The slotted gather pipeline shared by both kernels.
 
     For each receiver row r in the block: async-DMA the ``F`` sender view
@@ -56,8 +57,9 @@ def _gather_max_rows(edges_ref, view_ref, scratch, sems, n_fanout, r_blk, slots,
     memory), widen to int32 for the F-way max (v5e Mosaic has no narrow-int
     vector compare/max — the DMAs still move the narrow dtype, which is
     what the kernel is bound by), and hand the per-row maximum to ``sink``.
+    ``jdim``: which grid dimension indexes the column block.
     """
-    j = pl.program_id(1)
+    j = pl.program_id(jdim)
 
     def issue(r, slot):
         for f in range(n_fanout):
@@ -195,34 +197,43 @@ def fanout_max_merge(
     return out4.reshape(n, n)
 
 
-def _fused_kernel(n_fanout: int, r_blk: int, slots: int, member: int, unknown: int, age_clamp: int):
+def _fused_kernel(
+    n: int, n_fanout: int, r_blk: int, slots: int,
+    member: int, unknown: int, age_clamp: int, failed: int, detect_stats: bool,
+):
     def kernel(
-        edges_ref, view_ref, hb_hbm, age_hbm, status_hbm, sa_ref, sb_ref,
-        hb_out, age_out, status_out,
+        edges_ref, view_ref, hb_hbm, age_hbm, status_hbm, alive_ref, sa_ref, sb_ref,
+        hb_out, age_out, status_out, cnt_out, ndet_out, fobs_out,
         best_scratch, hb_vmem, age_vmem, status_vmem, scratch, sems, row_sems,
     ):
         # edges_ref: [r_blk, F] int32 SMEM — dead receivers' edges are
         #            remapped to self by the wrapper (their own view row is
         #            all -1, making the merge a no-op for them while the
-        #            age advance still applies — the alive gate with no
-        #            per-row vector operand)
+        #            age advance still applies)
         # view_ref / hb/age/status_hbm: [N/R or N, ..., C/128, 128] in HBM.
         #            The receiver-row lanes are copied block-at-a-time with
         #            explicit DMAs that overlap the gather loop — VMEM-block
         #            inputs measured 5x slower here (Mosaic serialized their
         #            per-grid-step copies against the manual gather DMAs).
-        # outs:      [r_blk, 1, C/128, 128] VMEM blocks (auto-pipelined,
-        #            same as fanout_max_merge's single output — cheap).
-        i = pl.program_id(0)
-        j = pl.program_id(1)
+        # Grid (nc, n // r_blk): column block j OUTER, receiver block i
+        # inner, so the per-subject reduction outputs (indexed by j only)
+        # accumulate across consecutive i steps while resident in VMEM —
+        # same pattern as the stripe kernels.
+        j = pl.program_id(0)
+        i = pl.program_id(1)
 
         # block-input DMAs for the receiver lanes: issued before the gather
         # loop, awaited after it — their ~3 MB fully hides under the
-        # gather's F x r_blk row copies
+        # gather's F x r_blk row copies.  The lane refs stay 4-D (dynamic
+        # row-block slices) so the OUTPUT lanes can alias them: each block
+        # is read exactly once, strictly before its own step writes it, so
+        # in-place update is safe — and drops three [N, N]-lane buffers
+        # from the round's peak HBM (what bounds single-chip capacity).
+        rows = pl.ds(i * r_blk, r_blk)
         row_copies = [
-            pltpu.make_async_copy(hb_hbm.at[i, :, j], hb_vmem, row_sems.at[0]),
-            pltpu.make_async_copy(age_hbm.at[i, :, j], age_vmem, row_sems.at[1]),
-            pltpu.make_async_copy(status_hbm.at[i, :, j], status_vmem, row_sems.at[2]),
+            pltpu.make_async_copy(hb_hbm.at[rows, j], hb_vmem, row_sems.at[0]),
+            pltpu.make_async_copy(age_hbm.at[rows, j], age_vmem, row_sems.at[1]),
+            pltpu.make_async_copy(status_hbm.at[rows, j], status_vmem, row_sems.at[2]),
         ]
         for c in row_copies:
             c.start()
@@ -235,39 +246,22 @@ def _fused_kernel(n_fanout: int, r_blk: int, slots: int, member: int, unknown: i
         def sink(r, acc):
             best_scratch[r] = acc
 
-        _gather_max_rows(edges_ref, view_ref, scratch, sems, n_fanout, r_blk, slots, sink)
+        _gather_max_rows(edges_ref, view_ref, scratch, sems, n_fanout, r_blk,
+                         slots, sink, jdim=0)
         for c in row_copies:
             c.wait()
 
-        # Phase 2 — block-wide epilogue on [r_blk, cs, 128] operands.
-        # MergeMemberList semantics (core/rounds.py _merge): shared members
-        # take the max count + a fresh local stamp; UNKNOWN subjects present
-        # in some peer's message are added; FAILED (fail-list) entries
-        # ignore gossip entirely.
-        best_rel = best_scratch[...]
-        any_member = best_rel >= 0
-        hb = hb_vmem[...].astype(jnp.int32)
-        st = status_vmem[...].astype(jnp.int32)
-        age = age_vmem[...].astype(jnp.int32)
-        # sa: stored -> view-encoding shift; sb: old -> new stored-base
-        # shift (every write renormalizes to this round's base — how int16
-        # storage stays in range; both reduce to the old "+ base" in int32
-        # mode, where sb == 0).  See core/rounds.py _merge.
-        sa = sa_ref[0][None]
-        sb = sb_ref[0][None]
-        advance = any_member & (st == member) & (best_rel > hb - sa)
-        add = any_member & (st == unknown)
-        upd = advance | add
-        new_hb = jnp.where(upd, best_rel + (sa - sb), hb - sb)
-        if hb_out.dtype != jnp.int32:
-            info = jnp.iinfo(hb_out.dtype)
-            new_hb = jnp.clip(new_hb, info.min, info.max)
-        hb_out[:, 0] = new_hb.astype(hb_out.dtype)
-        # the post-merge global age advance (everything not refreshed this
-        # round ages by one, saturating) folds in here
-        new_age = jnp.minimum(jnp.where(upd, 0, age) + 1, age_clamp)
-        age_out[:, 0] = new_age.astype(age_out.dtype)
-        status_out[:, 0] = jnp.where(add, member, st).astype(status_out.dtype)
+        # Phase 2 — block-wide epilogue + per-subject reductions.
+        recv = alive_ref[...].reshape(r_blk, 1, LANE) != 0
+        _epilogue_and_count(
+            best_scratch[...],
+            hb_vmem[...].astype(jnp.int32),
+            age_vmem[...].astype(jnp.int32),
+            status_vmem[...].astype(jnp.int32),
+            recv, sa_ref[0][None], sb_ref[0][None],
+            hb_out, age_out, status_out, cnt_out, ndet_out, fobs_out,
+            i, r_blk, member, unknown, age_clamp, failed, detect_stats, n,
+        )
 
     return kernel
 
@@ -345,7 +339,7 @@ def fused_merge_update(
     """
     n = view.shape[0]
     shp = blocked_shape(n, block_c)
-    h4, a4, s4 = fused_merge_update_blocked(
+    h4, a4, s4, _cnt, _nd, _fo = fused_merge_update_blocked(
         view.reshape(shp),
         edges,
         hb.reshape(shp),
@@ -367,7 +361,8 @@ def fused_merge_update(
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "member", "unknown", "age_clamp", "block_r", "slots", "interpret"
+        "member", "unknown", "age_clamp", "failed", "detect_stats",
+        "block_r", "slots", "interpret"
     ),
 )
 def fused_merge_update_blocked(
@@ -383,10 +378,12 @@ def fused_merge_update_blocked(
     member: int,
     unknown: int,
     age_clamp: int,
+    failed: int = 2,
+    detect_stats: bool = False,
     block_r: int = _FUSED_BLOCK_R,
     slots: int = 4,
     interpret: bool = False,
-) -> tuple[jax.Array, jax.Array, jax.Array]:
+) -> tuple[jax.Array, ...]:
     """Gossip merge + membership update + age advance in one pass.
 
     Fuses the tail of core/rounds.py ``_merge`` (un-rebase, max-merge
@@ -402,7 +399,10 @@ def fused_merge_update_blocked(
     stored-base shift (core/rounds.py ``_merge`` derives both; in int32
     mode shift_a is the view rebase base and shift_b is zero).  ``edges``
     int32 [N, F]; ``alive`` int32 [N] (receiver liveness).  Returns the
-    updated (hb, age, status), blocked.
+    updated (hb, age, status, member_cnt, n_det, first_obs) — the last
+    three as in :func:`stripe_merge_update_blocked` (counts/stats are
+    accumulated in-kernel; the stat lanes are zeros unless
+    ``detect_stats``).
     """
     n, nc, cs, _ = view.shape
     fanout = edges.shape[1]
@@ -427,37 +427,49 @@ def fused_merge_update_blocked(
     # applies), exactly the reference semantics for a crashed process
     self_idx = jnp.arange(n, dtype=edges.dtype)[:, None]
     edges = jnp.where((alive != 0)[:, None], edges, self_idx)
+    # liveness replicated across the lane dim for clean vector broadcast
+    alive_lanes = jnp.broadcast_to(alive.astype(jnp.int32)[:, None], (n, LANE))
 
-    row_spec = lambda i, j: (i, j, 0, 0)  # noqa: E731
+    row_spec = lambda j, i: (i, j, 0, 0)  # noqa: E731
     lane_blk = lambda dt: pl.BlockSpec(  # noqa: E731
         (r_blk, 1, cs, LANE), row_spec, memory_space=pltpu.VMEM
     )
+    subj_spec = pl.BlockSpec(
+        (1, cs, LANE), lambda j, i: (j, 0, 0), memory_space=pltpu.VMEM
+    )
     view4 = view
-    # receiver lanes indexed [row_block, row_in_block, col_block, ...] so a
-    # single DMA moves one (r_blk, cs, LANE) block; splitting the leading
-    # (untiled) axis is layout-free, unlike the [N, N] -> blocked reshape
-    hb5 = hb.reshape(n // r_blk, r_blk, nc, cs, LANE)
-    age5 = age.reshape(n // r_blk, r_blk, nc, cs, LANE)
-    status5 = status.reshape(n // r_blk, r_blk, nc, cs, LANE)
     out = pl.pallas_call(
-        _fused_kernel(fanout, r_blk, n_slots, member, unknown, age_clamp),
-        grid=(n // r_blk, nc),
+        _fused_kernel(n, fanout, r_blk, n_slots, member, unknown, age_clamp,
+                      failed, detect_stats),
+        grid=(nc, n // r_blk),
+        # in-place lane update: outputs 0-2 reuse the (post-tick) input
+        # lane buffers — see the kernel's DMA comment for why it's safe
+        input_output_aliases={2: 0, 3: 1, 4: 2},
         in_specs=[
             pl.BlockSpec(
-                (r_blk, fanout), lambda i, j: (i, 0), memory_space=pltpu.SMEM
+                (r_blk, fanout), lambda j, i: (i, 0), memory_space=pltpu.SMEM
             ),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec((1, cs, LANE), lambda i, j: (j, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, cs, LANE), lambda i, j: (j, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (r_blk, LANE), lambda j, i: (i, 0), memory_space=pltpu.VMEM
+            ),
+            subj_spec,
+            subj_spec,
         ],
-        out_specs=[lane_blk(hb.dtype), lane_blk(age.dtype), lane_blk(status.dtype)],
+        out_specs=[
+            lane_blk(hb.dtype), lane_blk(age.dtype), lane_blk(status.dtype),
+            subj_spec, subj_spec, subj_spec,
+        ],
         out_shape=[
             jax.ShapeDtypeStruct((n, nc, cs, LANE), hb.dtype),
             jax.ShapeDtypeStruct((n, nc, cs, LANE), age.dtype),
             jax.ShapeDtypeStruct((n, nc, cs, LANE), status.dtype),
+            jax.ShapeDtypeStruct((nc, cs, LANE), jnp.int32),
+            jax.ShapeDtypeStruct((nc, cs, LANE), jnp.int32),
+            jax.ShapeDtypeStruct((nc, cs, LANE), jnp.int32),
         ],
         scratch_shapes=[
             pltpu.VMEM((r_blk, cs, LANE), jnp.int32),
@@ -474,7 +486,7 @@ def fused_merge_update_blocked(
         # physical VMEM
         compiler_params=pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
-    )(edges, view4, hb5, age5, status5, shift_a, shift_b)
+    )(edges, view4, hb, age, status, alive_lanes, shift_a, shift_b)
     return tuple(out)
 
 

@@ -118,3 +118,19 @@ def test_curves_sweep_smoke():
     assert row["detected"] == row["tracked_crashes"]
     assert row["ttd_first_median"] == 5
     assert row["false_positive_rate"] < 1e-4
+
+
+def test_wire_ops_real_payload_shape(tmp_path):
+    """bench/wire_ops drives Put/Get + crash-repair over a live gRPC server
+    and verifies byte identity; CI runs it with small payloads (the
+    recorded benchmark uses the reference's 4 MB shards)."""
+    from gossipfs_tpu.bench.wire_ops import run
+
+    a = tmp_path / "a.bin"
+    b = tmp_path / "b.bin"
+    a.write_bytes(b"A" * 200_000)
+    b.write_bytes(b"B" * 100_000)
+    out = run(files=(str(a), str(b)), n=8, reps=2)
+    assert {r["file"] for r in out["rows"]} == {"a.bin", "b.bin"}
+    assert out["repair"]["healed"]
+    assert out["repair"]["bytes_identical_after_repair"]

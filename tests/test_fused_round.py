@@ -129,3 +129,31 @@ def test_arc_kernel_round_matches_xla_fused():
         cfg = dataclasses.replace(base, merge_kernel=kernel)
         out[kernel] = run_rounds(init_state(cfg), cfg, 8, key, crash_rate=0.01)
     _assert_same(out["pallas_stripe_interpret"], out["xla"])
+
+
+def test_crash_only_events_static_is_bit_identical():
+    """``crash_only_events=True`` with a crash-only schedule must reproduce
+    the default event path exactly — it only switches the compiled round to
+    the lean (no leave/join rewrites, stats-capable) form."""
+    import numpy as np
+
+    cfg = SimConfig(
+        n=128, topology="random", fanout=5,
+        remove_broadcast=False, fresh_cooldown=True,
+        view_dtype="int8", hb_dtype="int8",
+    )
+    n, rounds = cfg.n, 30
+    crash = np.zeros((rounds, n), dtype=bool)
+    crash[8, [3, 77]] = True
+    zeros = jnp.zeros((rounds, n), dtype=bool)
+    from gossipfs_tpu.core.state import RoundEvents
+
+    events = RoundEvents(crash=jnp.asarray(crash), leave=zeros, join=zeros)
+    key = jax.random.PRNGKey(11)
+    out = {}
+    for lean in (False, True):
+        out[lean] = run_rounds(
+            init_state(cfg), cfg, rounds, key, events=events,
+            crash_rate=0.01, crash_only_events=lean,
+        )
+    _assert_same(out[True], out[False])

@@ -141,7 +141,7 @@ def test_fuzz_matches_oracle(name, kwargs, introkill, seed):
                 if cfg.topology == "random_arc"
                 else np.array(edges)
             )
-        state, _, _ = gossip_round(state, events, edges, cfg)
+        state, _, _, _ = gossip_round(state, events, edges, cfg)
         naive.step(oracle_edges, crash=ev.get("crash", []),
                    leave=ev.get("leave", []), join=ev.get("join", []))
         # compare every 5 rounds (and right after event rounds) — full

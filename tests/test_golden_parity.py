@@ -35,10 +35,10 @@ def run_both(cfg, rounds, events_by_round, member_mask=None, seed=0):
         k = jax.random.fold_in(key, r)
         if cfg.topology == "random":
             edges = np.array(random_in_edges(k, cfg.n, cfg.fanout))
-            state, _, _ = gossip_round(state, ev, jnp.asarray(edges), cfg)
+            state, _, _, _ = gossip_round(state, ev, jnp.asarray(edges), cfg)
         else:
             edges = None
-            state, _, _ = gossip_round(state, ev, None, cfg)
+            state, _, _, _ = gossip_round(state, ev, None, cfg)
         crash, leave, join = masks_to_lists(ev)
         naive.step(edges, crash=crash, leave=leave, join=join)
         compare(state, naive, where=f"round {r}")

@@ -89,7 +89,7 @@ class TestMergeSemilattice:
         live receiver (max-merge only raises; stamps only refresh)."""
         state = _mid_run_state(cfg)
         edges = random_in_edges(KEY, cfg.n, cfg.fanout)
-        out, _, _ = _round(state, cfg, edges)
+        out, _, _, _ = _round(state, cfg, edges)
         stays = (
             state.alive[:, None]
             & out.alive[:, None]

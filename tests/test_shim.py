@@ -180,10 +180,14 @@ class TestPerNodeLogs:
     methodology."""
 
     def _detected_sim(self):
+        # two crashes far apart on the ring: their first-detecting
+        # observers differ, so per-node views genuinely diverge
         sim = CoSim(SimConfig(n=10))
-        run(sim, "advance 2", "crash 6", "advance 12")
+        run(sim, "advance 2", "crash 6", "crash 2", "advance 12")
         detections = sim.log.grep("Failure Detected")
-        assert detections, "scenario must produce detections"
+        assert len({e["node"] for e in detections}) >= 2, (
+            "scenario must produce detections from distinct observers"
+        )
         return sim, detections
 
     def test_node_scoped_grep_differs_per_observer(self):
@@ -191,7 +195,8 @@ class TestPerNodeLogs:
         observers = {e["node"] for e in detections}
         # ring detection: specific neighbors fire, others never do
         non_observer = next(
-            k for k in range(10) if k not in observers and k != 6
+            k for k in range(10)
+            if k not in observers and k not in (6, 2)
         )
         some_observer = next(iter(observers))
         seen = sim.log.grep("Failure Detected", node=some_observer)
