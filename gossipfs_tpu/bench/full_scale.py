@@ -59,7 +59,10 @@ def run(n: int, rounds: int, crash_at: int, track: int, crash_rate: float,
         t_cooldown=12,
         merge_kernel="xla",   # virtual CPU mesh: the XLA arc window path
         view_dtype="int8",
-        hb_dtype="int16",
+        # all-int8 state (3 B/entry): at the full N=131,072 the int16-era
+        # state was 69 GB and the run's host working set exceeded the
+        # 125 GB box; int8 is also what the single-chip headline ships
+        hb_dtype="int8",
     )
     mesh = make_mesh(devices)
     # build the state directly onto its shards — a host-staged [N, N] copy
@@ -74,6 +77,7 @@ def run(n: int, rounds: int, crash_at: int, track: int, crash_rate: float,
     final, carry, per_round = run_rounds_sharded(
         state, cfg, rounds, jax.random.PRNGKey(seed), mesh,
         events=events, crash_rate=crash_rate, churn_ok=churn_ok, donate=True,
+        crash_only_events=True,  # tracked_crash_events schedules crashes only
     )
     jax.block_until_ready(carry)
     elapsed = time.perf_counter() - t0

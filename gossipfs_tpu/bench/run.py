@@ -112,6 +112,9 @@ def _timed_run(
         crash_rate=sc.crash_rate,
         rejoin_rate=sc.rejoin_rate,
         churn_ok=churn_ok,
+        # tracked_crash_events schedules crashes only: keep the lean event
+        # path (no leave/join rewrites, no fail-matrix materialization)
+        crash_only_events=True,
     )
     jax.block_until_ready(run())  # compile + warm caches
     t0 = time.perf_counter()

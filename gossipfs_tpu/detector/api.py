@@ -48,4 +48,12 @@ class FailureDetector(Protocol):
         """Ground-truth live set (what the SDFS master consumes)."""
 
     def drain_events(self) -> list[DetectionEvent]:
-        """Detection events since the last drain."""
+        """Detection events since the last drain.
+
+        The sim reports one event per newly-detected subject, attributed
+        to the lowest-index observer that fired that round (bulk and
+        interactive paths agree; effectively the reference's semantics,
+        where the first detector's REMOVE broadcast preempts the rest).
+        The socket engines report whichever of their detectors actually
+        fired first in real time.
+        """

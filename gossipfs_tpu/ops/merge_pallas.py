@@ -443,7 +443,11 @@ def fused_merge_update_blocked(
                       failed, detect_stats),
         grid=(nc, n // r_blk),
         # in-place lane update: outputs 0-2 reuse the (post-tick) input
-        # lane buffers — see the kernel's DMA comment for why it's safe
+        # lane buffers — see the kernel's DMA comment for why it's safe.
+        # Costs ~2 ms/round at N=16k (Mosaic pipelines aliased writes more
+        # conservatively), so the stripe/arc kernels — whose sizes fit HBM
+        # comfortably — stay non-aliased; HERE the three reclaimed lane
+        # buffers are what fits N=49,152 on one chip at all
         input_output_aliases={2: 0, 3: 1, 4: 2},
         in_specs=[
             pl.BlockSpec(
@@ -578,10 +582,18 @@ def _stripe_kernel(
         def _():
             pltpu.make_async_copy(view_ref.at[:, j], stripe, stripe_sem).start()
 
+        # 4-D lane refs with dynamic row-block slices — the layout that
+        # WOULD let output lanes alias the inputs (each block is read
+        # exactly once, before its own step writes it; cross-row data
+        # comes only from the separate view stripe).  This kernel's sizes
+        # fit HBM comfortably and aliasing measured ~2 ms/round slower
+        # (Mosaic pipelines aliased writes conservatively), so only the
+        # capacity-bound gather kernel passes input_output_aliases.
+        rows = pl.ds(i * r_blk, r_blk)
         row_copies = [
-            pltpu.make_async_copy(hb_hbm.at[i, :, j], hb_vmem, row_sems.at[0]),
-            pltpu.make_async_copy(age_hbm.at[i, :, j], age_vmem, row_sems.at[1]),
-            pltpu.make_async_copy(status_hbm.at[i, :, j], status_vmem, row_sems.at[2]),
+            pltpu.make_async_copy(hb_hbm.at[rows, j], hb_vmem, row_sems.at[0]),
+            pltpu.make_async_copy(age_hbm.at[rows, j], age_vmem, row_sems.at[1]),
+            pltpu.make_async_copy(status_hbm.at[rows, j], status_vmem, row_sems.at[2]),
         ]
         for c in row_copies:
             c.start()
@@ -708,9 +720,6 @@ def stripe_merge_update_blocked(
     subj_spec = pl.BlockSpec(
         (1, cs, LANE), lambda j, i: (j, 0, 0), memory_space=pltpu.VMEM
     )
-    hb5 = hb.reshape(n // r_blk, r_blk, nc, cs, LANE)
-    age5 = age.reshape(n // r_blk, r_blk, nc, cs, LANE)
-    status5 = status.reshape(n // r_blk, r_blk, nc, cs, LANE)
     out = pl.pallas_call(
         _stripe_kernel(n, fanout, r_blk, member, unknown, age_clamp,
                        failed, detect_stats),
@@ -752,7 +761,7 @@ def stripe_merge_update_blocked(
         ],
         compiler_params=pltpu.CompilerParams(vmem_limit_bytes=110 * 1024 * 1024),
         interpret=interpret,
-    )(edges, view, hb5, age5, status5, alive_lanes, shift_a, shift_b)
+    )(edges, view, hb, age, status, alive_lanes, shift_a, shift_b)
     return tuple(out)
 
 
@@ -832,10 +841,15 @@ def _arc_update_kernel(
         j = pl.program_id(0)
         i = pl.program_id(1)
 
+        # 4-D lane refs with dynamic row-block slices — aliasable layout,
+        # deliberately NOT aliased (see the stripe kernel's comment: only
+        # the capacity-bound gather kernel trades the ~2 ms/round aliasing
+        # cost for the three reclaimed lane buffers)
+        rows = pl.ds(i * r_blk, r_blk)
         row_copies = [
-            pltpu.make_async_copy(hb_hbm.at[i, :, j], hb_vmem, row_sems.at[0]),
-            pltpu.make_async_copy(age_hbm.at[i, :, j], age_vmem, row_sems.at[1]),
-            pltpu.make_async_copy(status_hbm.at[i, :, j], status_vmem, row_sems.at[2]),
+            pltpu.make_async_copy(hb_hbm.at[rows, j], hb_vmem, row_sems.at[0]),
+            pltpu.make_async_copy(age_hbm.at[rows, j], age_vmem, row_sems.at[1]),
+            pltpu.make_async_copy(status_hbm.at[rows, j], status_vmem, row_sems.at[2]),
         ]
         for c in row_copies:
             c.start()
@@ -938,9 +952,6 @@ def arc_merge_update_blocked(
     subj_spec = pl.BlockSpec(
         (1, cs, LANE), lambda j, i: (j, 0, 0), memory_space=pltpu.VMEM
     )
-    hb5 = hb.reshape(n // r_blk, r_blk, nc, cs, LANE)
-    age5 = age.reshape(n // r_blk, r_blk, nc, cs, LANE)
-    status5 = status.reshape(n // r_blk, r_blk, nc, cs, LANE)
     out = pl.pallas_call(
         _arc_update_kernel(n, fanout, r_blk, member, unknown, age_clamp,
                            failed, detect_stats),
@@ -985,7 +996,7 @@ def arc_merge_update_blocked(
         ],
         compiler_params=pltpu.CompilerParams(vmem_limit_bytes=110 * 1024 * 1024),
         interpret=interpret,
-    )(bases.reshape(n, 1), view, hb5, age5, status5, alive_lanes,
+    )(bases.reshape(n, 1), view, hb, age, status, alive_lanes,
       shift_a, shift_b)
     return tuple(out)
 

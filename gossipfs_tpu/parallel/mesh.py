@@ -120,6 +120,7 @@ def run_rounds_sharded(
     rejoin_rate: float = 0.0,
     churn_ok: jax.Array | None = None,
     donate: bool = False,
+    crash_only_events: bool = False,
 ):
     """``core.rounds.run_rounds`` over an explicit subject-axis shard_map.
 
@@ -147,7 +148,13 @@ def run_rounds_sharded(
                          "use run_rounds (GSPMD) instead")
     if n % d:
         raise ValueError(f"n={n} must divide over {d} devices")
-    matrix_events = events is not None or rejoin_rate > 0.0
+    # crash_only_events: the caller's static promise that scheduled events
+    # carry no leave/join bits — keeps the lean event path (see
+    # core.rounds._run_rounds_impl), which matters for peak memory at the
+    # 100k-class capacity points
+    matrix_events = (
+        events is not None and not crash_only_events
+    ) or rejoin_rate > 0.0
     if events is None:
         zeros = jnp.zeros((num_rounds, n), dtype=bool)
         events = RoundEvents(crash=zeros, leave=zeros, join=zeros)

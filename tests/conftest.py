@@ -26,3 +26,13 @@ from jax._src import xla_bridge as _xb  # noqa: E402
 _xb._backend_factories.pop("axon", None)
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: deep/redundant coverage (fuzz sweeps, interpret-mode e2e, "
+        "multi-process runs).  The default CI lane is `pytest -m 'not "
+        "slow'` (< 5 min, every component covered at least once); run the "
+        "full suite before shipping protocol-arithmetic changes.",
+    )

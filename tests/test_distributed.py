@@ -11,9 +11,13 @@ shard bit-matches the single-device reference run.
 from __future__ import annotations
 
 import os
+import pytest
 import socket
 import subprocess
 import sys
+
+# spawns real multi-process DCN rendezvous runs
+pytestmark = pytest.mark.slow
 
 WORKER = r"""
 import os
@@ -35,6 +39,7 @@ from gossipfs_tpu.config import SimConfig
 from gossipfs_tpu.core.rounds import run_rounds
 from gossipfs_tpu.core.state import RoundEvents, init_state
 from gossipfs_tpu.parallel.mesh import run_rounds_sharded, state_shardings
+
 
 assert jax.process_count() == 2
 mesh = distributed.global_mesh()

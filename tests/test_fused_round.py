@@ -91,6 +91,7 @@ def test_fused_small_group_refresh_parity():
     _assert_same(out["auto"], out["off"])
 
 
+@pytest.mark.slow  # N=4096 interpreter-mode kernel run
 def test_stripe_kernel_round_matches_xla_fused():
     """Unfused stripe-kernel round (interpret) == barrier-fused XLA round."""
     base = SimConfig(
@@ -111,6 +112,7 @@ def test_stripe_kernel_round_matches_xla_fused():
     _assert_same(out["pallas_stripe_interpret"], out["xla"])
 
 
+@pytest.mark.slow  # N=4096 interpreter-mode kernel run
 def test_arc_kernel_round_matches_xla_fused():
     """Unfused arc-kernel round (interpret) == barrier-fused XLA round."""
     base = SimConfig(

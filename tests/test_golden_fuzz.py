@@ -23,6 +23,9 @@ from gossipfs_tpu.core.state import RoundEvents, init_state
 from gossipfs_tpu.core import topology
 from reference_model import NaiveSim
 
+# randomized 24-config x 200-round sweep with O(N^2) Python comparisons (~16 min); test_golden_parity covers the same oracle deterministically in the fast lane
+pytestmark = pytest.mark.slow
+
 
 def random_schedule(rng: pyrandom.Random, n: int, rounds: int,
                     kill_introducer: bool) -> dict[int, dict]:

@@ -25,7 +25,10 @@ from gossipfs_tpu.ops.merge_pallas import (
 
 
 @pytest.mark.parametrize("dtype", [jnp.int32, jnp.int16, jnp.int8])
-@pytest.mark.parametrize("n,fanout", [(128, 3), (256, 8), (384, 17)])
+@pytest.mark.parametrize("n,fanout", [
+    (128, 3), (256, 8),
+    pytest.param(384, 17, marks=pytest.mark.slow),  # biggest interpret run
+])
 def test_kernel_matches_oracle(n, fanout, dtype):
     key = jax.random.PRNGKey(n + fanout)
     k1, k2 = jax.random.split(key)
@@ -91,6 +94,7 @@ def test_full_round_equivalence_xla_vs_pallas():
     assert jnp.array_equal(px.false_positives, pp.false_positives)
 
 
+@pytest.mark.slow  # N=4096 interpreter-mode kernel run
 def test_stripe_kernel_matches_oracle():
     """The VMEM-stripe kernel == XLA formulation, through the full epilogue.
 
@@ -159,6 +163,7 @@ def test_arc_edges_expand_to_consecutive_window():
     assert all(i not in edges[i] for i in range(n))
 
 
+@pytest.mark.slow  # N=4096 interpreter-mode kernel run
 def test_full_round_equivalence_xla_vs_arc_stripe():
     """random_arc: the windowed-stripe kernel == the XLA gather over the
     expanded [N, F] arc edges, bit-for-bit through full rounds."""
@@ -190,6 +195,7 @@ def test_full_round_equivalence_xla_vs_arc_stripe():
     assert jnp.array_equal(px.false_positives, pp.false_positives)
 
 
+@pytest.mark.slow  # N=4096 interpreter-mode kernel run
 def test_full_round_equivalence_xla_vs_stripe():
     """run_rounds with merge_kernel=pallas_stripe_interpret reproduces the
     XLA scan bit-for-bit at a stripe-eligible size."""
@@ -218,3 +224,26 @@ def test_full_round_equivalence_xla_vs_stripe():
     assert jnp.array_equal(cx.first_detect, cp.first_detect)
     assert jnp.array_equal(cx.first_observer, cp.first_observer)
     assert jnp.array_equal(px.true_detections, pp.true_detections)
+
+
+def test_stripe_and_arc_kernel_smoke():
+    """Fast-lane coverage for the stripe/arc production kernels: 3
+    interpret-mode rounds each against the XLA round (the slow lane runs
+    the deep 6-8 round versions above)."""
+    for topology in ("random", "random_arc"):
+        base = SimConfig(
+            n=4096, topology=topology, fanout=6,
+            remove_broadcast=False, fresh_cooldown=True,
+            view_dtype="int8", hb_dtype="int8", merge_block_c=4096,
+        )
+        key = jax.random.PRNGKey(13)
+        out = {}
+        for kernel in ("xla", "pallas_stripe_interpret"):
+            cfg = dataclasses.replace(base, merge_kernel=kernel)
+            out[kernel] = run_rounds(init_state(cfg), cfg, 2, key,
+                                     crash_rate=0.02)
+        fx, cx, _ = out["xla"]
+        fp, cp, _ = out["pallas_stripe_interpret"]
+        assert jnp.array_equal(fx.hb, fp.hb), topology
+        assert jnp.array_equal(fx.status, fp.status), topology
+        assert jnp.array_equal(cx.first_detect, cp.first_detect), topology

@@ -40,7 +40,11 @@ def _round(state, cfg, edges):
     return gossip_round(state, RoundEvents.none(cfg.n), edges, cfg)
 
 
-@pytest.fixture(params=["xla", "pallas_interpret"])
+@pytest.fixture(params=[
+    "xla",
+    pytest.param(  # interpreter-mode pallas: deep but slow; XLA param
+        "pallas_interpret", marks=pytest.mark.slow),  # covers the algebra
+])
 def cfg(request):
     n = 128 if request.param == "pallas_interpret" else 48
     return SimConfig(n=n, topology="random", fanout=5, merge_kernel=request.param)

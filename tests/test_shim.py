@@ -2,6 +2,8 @@
 
 import io
 
+import pytest
+
 from gossipfs_tpu.config import SimConfig
 from gossipfs_tpu.cosim import CoSim
 from gossipfs_tpu.shim.cli import dispatch
@@ -119,6 +121,8 @@ class TestConfirmPrompt:
         assert "Overwrite?" not in out.getvalue()
         assert "ok" in out.getvalue()
 
+    @pytest.mark.slow  # real-subprocess timeout wait; the in-process
+    # prompt tests cover the behavior
     def test_prompt_timeout_rejects_subprocess(self, tmp_path):
         """pexpect-style: a real CLI process with a silent stdin hits the
         timeout path and rejects (the reference's 30 s default-deny)."""
