@@ -141,3 +141,38 @@ def test_int16_hb_checkpoint_roundtrip(tmp_path):
     cont_b, _, _ = run_rounds(restored, cfg, 5, rkey)
     for a, b in zip(jax.tree.leaves(cont_a), jax.tree.leaves(cont_b)):
         assert jnp.array_equal(a, b)
+
+
+def test_narrow_checkpoint_sentinels_quarantined_on_int32_restore(tmp_path):
+    """A narrow-era checkpoint's floor sentinels (unknown counters) must not
+    decode into ordinary heartbeat values under an int32 restore target —
+    they are quarantined far above the gossip window, so they spread to
+    nobody, age out, and can never suppress detection (the fabricated-
+    counter corner the hb_floor payload field exists to close)."""
+    import dataclasses
+
+    from gossipfs_tpu.utils.checkpoint import save_checkpoint
+
+    cfg8 = SimConfig(
+        n=128, topology="random", fanout=6,
+        view_dtype="int8", hb_dtype="int8",
+    )
+    state = init_state(cfg8)
+    # hand-craft a narrow-era state: a positive base with one stored floor
+    # sentinel and one ordinary relative counter
+    floor = jnp.iinfo(jnp.int8).min
+    hb = state.hb.at[3, 5].set(floor).at[4, 5].set(7)
+    state = state._replace(
+        hb=hb, hb_base=state.hb_base.at[5].set(1000),
+    )
+    path = (tmp_path / "ck8").resolve()
+    save_checkpoint(path, state, jax.random.PRNGKey(0))
+
+    cfg32 = dataclasses.replace(cfg8, view_dtype="int16", hb_dtype="int32")
+    restored, _ = restore_checkpoint(path, cfg32)
+    assert restored.hb.dtype == jnp.int32
+    # the ordinary counter decodes to its true value...
+    assert int(restored.hb[4, 5]) == 1007
+    # ...while the sentinel becomes a quarantine value far above any
+    # reachable counter (not base + floor = 872, a plausible fabrication)
+    assert int(restored.hb[3, 5]) == 2 ** 30

@@ -227,7 +227,7 @@ def test_full_round_equivalence_xla_vs_stripe():
 
 
 def test_stripe_and_arc_kernel_smoke():
-    """Fast-lane coverage for the stripe/arc production kernels: 3
+    """Fast-lane coverage for the stripe/arc production kernels: 2
     interpret-mode rounds each against the XLA round (the slow lane runs
     the deep 6-8 round versions above)."""
     for topology in ("random", "random_arc"):
