@@ -8,7 +8,10 @@ BASELINE metrics (time-to-detect, convergence, FPR) for tracked crashes.
 Slow (one CPU core stands in for 8 chips) but it is the same compiled
 program structure the v5e-8 runs.
 
-    python -m gossipfs_tpu.bench.full_scale                  # N=98,304
+    python -m gossipfs_tpu.bench.full_scale                  # default N=98,304
+    # NOTE: one virtual round costs minutes of host CPU; FULLSCALE.json
+    # records the largest completed run (use --n 32768 --rounds 12 for a
+    # ~30 min validation pass)
     python -m gossipfs_tpu.bench.full_scale --n 65536 --rounds 18
 
 Memory notes (125 GB host): the all-int8 state (3 B/entry, the headline

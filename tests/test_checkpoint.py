@@ -151,8 +151,6 @@ def test_narrow_checkpoint_sentinels_quarantined_on_int32_restore(tmp_path):
     counter corner the hb_floor payload field exists to close)."""
     import dataclasses
 
-    from gossipfs_tpu.utils.checkpoint import save_checkpoint
-
     cfg8 = SimConfig(
         n=128, topology="random", fanout=6,
         view_dtype="int8", hb_dtype="int8",
