@@ -66,10 +66,11 @@ def main() -> None:
         remove_broadcast=False,
         fresh_cooldown=True,
         t_cooldown=12,
-        # the pallas stripe merge kernel (ops/merge_pallas.py) keeps each
-        # view column block resident in VMEM, so the view crosses HBM once
-        # per round instead of F times; CPU keeps the XLA path
-        merge_kernel="pallas_stripe" if use_tpu else "xla",
+        # the resident-round kernel (ops/merge_pallas.py) runs the whole
+        # round — tick, in-kernel gossip-view build, merge, reductions —
+        # in ONE pallas call with in-place lane update; CPU keeps the XLA
+        # path
+        merge_kernel="pallas_rr" if use_tpu else "xla",
         # int8 rebased view (required by the stripe kernel's VMEM budget)
         view_dtype="int8",
         merge_block_c=4_096 if use_tpu else 16_384,

@@ -141,19 +141,27 @@ class SimConfig:
         if self.merge_kernel not in (
             "xla", "pallas", "pallas_interpret",
             "pallas_stripe", "pallas_stripe_interpret",
+            "pallas_rr", "pallas_rr_interpret",
         ):
             raise ValueError(f"unknown merge_kernel: {self.merge_kernel!r}")
-        if self.merge_kernel.startswith("pallas_stripe"):
+        if self.merge_kernel.startswith("pallas_rr"):
+            # the resident-round kernel (whole round in one pallas call —
+            # ops/merge_pallas.resident_round_blocked) additionally needs
+            # the all-int8 state; shape constraints match the stripe kernel
+            if self.hb_dtype != "int8":
+                raise ValueError("merge_kernel='pallas_rr' requires "
+                                 "hb_dtype='int8'")
+        if self.merge_kernel.startswith(("pallas_stripe", "pallas_rr")):
             if self.topology == "ring":
                 # ring stays on the 2-D path; the stripe kernel is
                 # blocked-layout only
-                raise ValueError("merge_kernel='pallas_stripe' requires "
-                                 "topology='random'")
+                raise ValueError(f"merge_kernel={self.merge_kernel!r} "
+                                 "requires topology='random'")
             if self.view_dtype != "int8":
                 # the stripe VMEM budget is counted in bytes at 1 B/elem;
                 # a wider view would double the resident stripe past it
-                raise ValueError("merge_kernel='pallas_stripe' requires "
-                                 "view_dtype='int8'")
+                raise ValueError(f"merge_kernel={self.merge_kernel!r} "
+                                 "requires view_dtype='int8'")
             from gossipfs_tpu.ops.merge_pallas import (
                 STRIPE_BLOCK_C,
                 STRIPE_MAX_BYTES,
@@ -162,7 +170,7 @@ class SimConfig:
 
             if self.merge_block_c != STRIPE_BLOCK_C:
                 raise ValueError(
-                    f"merge_kernel='pallas_stripe' requires "
+                    f"merge_kernel={self.merge_kernel!r} requires "
                     f"merge_block_c={STRIPE_BLOCK_C} (the VMEM-resident "
                     f"stripe width), got {self.merge_block_c}"
                 )
@@ -171,7 +179,7 @@ class SimConfig:
                 # N must be lane-aligned, a multiple of the stripe width,
                 # and small enough that one stripe fits VMEM
                 raise ValueError(
-                    f"merge_kernel='pallas_stripe' unsupported at n={self.n}"
+                    f"merge_kernel={self.merge_kernel!r} unsupported at n={self.n}"
                     f" (needs n % {STRIPE_BLOCK_C} == 0 and "
                     f"n * {STRIPE_BLOCK_C} <= {STRIPE_MAX_BYTES} B of VMEM)"
                 )

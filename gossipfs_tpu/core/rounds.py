@@ -135,11 +135,14 @@ def _use_pallas(config: SimConfig, fanout: int, n: int, n_cols: int | None = Non
 
     if config.merge_kernel == "xla" or not merge_pallas.supported(n, fanout, n_cols):
         return False
-    if config.merge_kernel.startswith("pallas_stripe"):
+    if config.merge_kernel.startswith(("pallas_stripe", "pallas_rr")):
+        # "pallas_rr" rides the stripe dispatch everywhere except the lean
+        # crash-only scan, where _scan_rounds_rr runs the whole round in
+        # one kernel (see merge_pallas.resident_round_blocked)
         if not merge_pallas.stripe_supported(n, fanout, n_cols):
             return False
         return (
-            config.merge_kernel == "pallas_stripe_interpret"
+            config.merge_kernel.endswith("interpret")
             or jax.default_backend() == "tpu"
         )
     if config.merge_kernel == "pallas_interpret":
@@ -722,7 +725,7 @@ def _merge(
     # refreshed this round ages by one, saturating at AGE_CLAMP) so the
     # fused kernel can write each [N, N] lane exactly once.
     use_pallas = _use_pallas(config, fanout, state.n, _nsubj(hb.shape))
-    stripe_kernel = config.merge_kernel.startswith("pallas_stripe")
+    stripe_kernel = config.merge_kernel.startswith(("pallas_stripe", "pallas_rr"))
     best_rel = None  # set on the paths that share the XLA membership update
     cnt_incl = None  # per-subject live-member count (self included)
     k_ndet = k_fobs = None  # in-kernel detection stats (detect_stats only)
@@ -1041,6 +1044,134 @@ def _update_carry(
     )
 
 
+def _use_rr(config: SimConfig, n: int, nloc: int) -> bool:
+    """Whether the lean crash-only scan runs the resident-round kernel.
+
+    The rr kernel (merge_pallas.resident_round_blocked) folds the tick,
+    the gossip-view build, the merge epilogue and every per-round
+    reduction into ONE pallas call — the [N, N] view never exists in HBM
+    and the per-receiver member counts are carried round-to-round instead
+    of recomputed (round-4 redesign; see the kernel's module comment for
+    the traffic arithmetic).  Requirements beyond the stripe kernel's:
+    the lean fault model (callers: matrix_events == False), fresh
+    cooldown, gossip-only dissemination, random explicit-edge topology,
+    and all-int8 lanes.
+    """
+    from gossipfs_tpu.ops import merge_pallas
+
+    if not config.merge_kernel.startswith("pallas_rr"):
+        return False
+    if (
+        config.remove_broadcast
+        or not config.fresh_cooldown
+        or config.topology != "random"
+        or config.hb_dtype != "int8"
+        # honor the debug knob: 'off' means the separate-pass round
+        or config.fused_tick != "auto"
+    ):
+        return False
+    if not merge_pallas.stripe_supported(n, config.fanout, nloc):
+        return False
+    return (
+        config.merge_kernel.endswith("interpret")
+        or jax.default_backend() == "tpu"
+    )
+
+
+def _scan_rounds_rr(
+    state: SimState,
+    config: SimConfig,
+    key: jax.Array,
+    events: RoundEvents,
+    crash_rate: float,
+    churn_ok: jax.Array | None,
+    mcarry0: MetricsCarry | None = None,
+) -> tuple[SimState, MetricsCarry, RoundMetrics]:
+    """The lean crash-only scan over the resident-round kernel.
+
+    Semantically identical to :func:`_scan_rounds` under
+    ``matrix_events=False`` (pinned by tests/test_merge_pallas.py's rr
+    parity tests): scheduled leave bits mean silent death, join bits are
+    ignored, and the per-receiver member counts feeding the small-group
+    split are carried across rounds (post-merge status is next round's
+    post-events status on this path, so the carried count is exact).
+    """
+    from gossipfs_tpu.ops import merge_pallas
+
+    n = state.n
+    shp = state.hb.shape
+    nloc = _nsubj(shp)
+    interp = config.merge_kernel.endswith("interpret")
+    lane = merge_pallas.LANE
+    counts0 = jnp.sum(
+        (state.status == MEMBER).astype(jnp.int32), axis=_subj_axes(state.status)
+    )
+
+    def step(carry, ev: RoundEvents):
+        st, mc, counts = carry
+        k = jax.random.fold_in(key, st.round)
+        k_edge, k_churn = jax.random.split(k)
+        crash = ev.crash | ev.leave
+        if crash_rate > 0.0:
+            c2, _ = topology.churn_masks(k_churn, st.alive, crash_rate, 0.0)
+            if churn_ok is not None:
+                c2 = c2 & churn_ok
+            crash = crash | c2
+        alive = st.alive & ~crash
+        small = counts < config.min_group
+        active = alive & ~small
+        refresher = alive & small
+        # per-subject rebase vectors (_pre_tick's diagonal anchor + the
+        # shared _rebase_shifts; int8 mode: view and storage windows
+        # coincide, so sa == sb)
+        basec = st.hb_base
+        colmax_est = _diag(st.hb).astype(jnp.int32) + basec + 1
+        sa_s, sb_s, store_base_s = _rebase_shifts(
+            st, config, colmax_est.reshape(shp[1:])
+        )
+        store_base = store_base_s.reshape(-1)
+        g = config.hb_grace - basec
+        flags = (
+            active.astype(jnp.int32)
+            + refresher.astype(jnp.int32) * 2
+            + alive.astype(jnp.int32) * 4
+        ).astype(jnp.int8)
+        flags = jnp.broadcast_to(flags[:, None], (n, lane))
+        edges = topology.in_edges(config, k_edge, None)
+        hb, age, status, cnt_incl, ndet, fobs, rcnt = (
+            merge_pallas.resident_round_blocked(
+                edges, st.hb, st.age, st.status, flags,
+                sa_s, sb_s, g.reshape(shp[1:]),
+                member=int(MEMBER), unknown=int(UNKNOWN), failed=int(FAILED),
+                age_clamp=AGE_CLAMP, window=config.rebase_window,
+                t_fail=config.t_fail, t_cooldown=config.t_cooldown,
+                block_r=config.merge_block_r, interpret=interp,
+            )
+        )
+        counts_next = jnp.sum(rcnt.reshape(n, -1, lane)[:, :, 0], axis=1)
+        round_idx = st.round
+        st2 = st._replace(
+            hb=hb, age=age, status=status, alive=alive,
+            hb_base=store_base, round=st.round + 1,
+        )
+        n_det = ndet.reshape(nloc)
+        first_obs = fobs.reshape(nloc)
+        metrics, any_fail = _round_stats(n_det, st2, LOCAL_CTX)
+        self_member = alive & (_diag(status) == MEMBER)
+        member_col = cnt_incl.reshape(nloc) - self_member.astype(jnp.int32)
+        rejoined = jnp.zeros_like(alive)  # constant: resets fold away
+        mc = _update_carry(mc, st2, rejoined, any_fail, first_obs, round_idx,
+                           LOCAL_CTX, member_col=member_col)
+        return (st2, mc, counts_next), metrics
+
+    if mcarry0 is None:
+        mcarry0 = MetricsCarry.init(nloc)
+    (state, mcarry, _), per_round = lax.scan(
+        step, (state, mcarry0, counts0), events
+    )
+    return state, mcarry, per_round
+
+
 def _scan_rounds(
     state: SimState,
     config: SimConfig,
@@ -1066,6 +1197,16 @@ def _scan_rounds(
     small membership view between chunks) accumulates first-detection /
     convergence rounds exactly as one long scan would.
     """
+    if (
+        ctx.axis is None
+        and not matrix_events
+        and _use_rr(config, state.n, _nsubj(state.hb.shape))
+    ):
+        # whole round in one kernel; rejoin_rate is 0 here (a nonzero rate
+        # forces matrix_events at the caller)
+        return _scan_rounds_rr(
+            state, config, key, events, crash_rate, churn_ok, mcarry0
+        )
     fused = _fused_ok(config, matrix_events, state.n, _nsubj(state.hb.shape))
 
     def step(carry, ev: RoundEvents):

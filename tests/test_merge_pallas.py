@@ -226,6 +226,45 @@ def test_full_round_equivalence_xla_vs_stripe():
     assert jnp.array_equal(px.true_detections, pp.true_detections)
 
 
+@pytest.mark.slow  # N=4096 interpreter-mode kernel run
+def test_full_round_equivalence_xla_vs_rr():
+    """The resident-round kernel (tick + view build + merge + reductions in
+    ONE pallas call, with carried member counts and in-place lane update)
+    reproduces the XLA scan bit-for-bit — states, carry, AND per-round
+    metrics, across a deep horizon with churn and tracked crashes."""
+    base = SimConfig(
+        n=4096,
+        topology="random",
+        fanout=6,
+        remove_broadcast=False,
+        fresh_cooldown=True,
+        t_cooldown=12,
+        view_dtype="int8",
+        hb_dtype="int8",
+        merge_block_c=4096,
+    )
+    key = jax.random.PRNGKey(17)
+    out = {}
+    for kernel in ("xla", "pallas_rr_interpret"):
+        cfg = dataclasses.replace(base, merge_kernel=kernel)
+        final, carry, per_round = run_rounds(
+            init_state(cfg), cfg, 8, key, crash_rate=0.02
+        )
+        out[kernel] = (final, carry, per_round)
+    fx, cx, px = out["xla"]
+    fp, cp, pp = out["pallas_rr_interpret"]
+    assert jnp.array_equal(fx.hb, fp.hb)
+    assert jnp.array_equal(fx.age, fp.age)
+    assert jnp.array_equal(fx.status, fp.status)
+    assert jnp.array_equal(fx.alive, fp.alive)
+    assert jnp.array_equal(fx.hb_base, fp.hb_base)
+    assert jnp.array_equal(cx.first_detect, cp.first_detect)
+    assert jnp.array_equal(cx.first_observer, cp.first_observer)
+    assert jnp.array_equal(cx.converged, cp.converged)
+    assert jnp.array_equal(px.true_detections, pp.true_detections)
+    assert jnp.array_equal(px.false_positives, pp.false_positives)
+
+
 def test_stripe_and_arc_kernel_smoke():
     """Fast-lane coverage for the stripe/arc production kernels: 2
     interpret-mode rounds each against the XLA round (the slow lane runs
@@ -237,13 +276,20 @@ def test_stripe_and_arc_kernel_smoke():
             view_dtype="int8", hb_dtype="int8", merge_block_c=4096,
         )
         key = jax.random.PRNGKey(13)
+        # the resident-round kernel (whole round in one pallas call, the
+        # round-4 headline path) only serves random explicit-edge topology
+        kernels = ["pallas_stripe_interpret"]
+        if topology == "random":
+            kernels.append("pallas_rr_interpret")
         out = {}
-        for kernel in ("xla", "pallas_stripe_interpret"):
+        for kernel in ["xla"] + kernels:
             cfg = dataclasses.replace(base, merge_kernel=kernel)
             out[kernel] = run_rounds(init_state(cfg), cfg, 2, key,
                                      crash_rate=0.02)
         fx, cx, _ = out["xla"]
-        fp, cp, _ = out["pallas_stripe_interpret"]
-        assert jnp.array_equal(fx.hb, fp.hb), topology
-        assert jnp.array_equal(fx.status, fp.status), topology
-        assert jnp.array_equal(cx.first_detect, cp.first_detect), topology
+        for kernel in kernels:
+            fp, cp, _ = out[kernel]
+            assert jnp.array_equal(fx.hb, fp.hb), (topology, kernel)
+            assert jnp.array_equal(fx.status, fp.status), (topology, kernel)
+            assert jnp.array_equal(cx.first_detect, cp.first_detect), (
+                topology, kernel)

@@ -1,0 +1,25 @@
+#!/bin/bash
+# Round-4 full-scale evidence runs (VERDICT r3 task 3): the exact sharded
+# BASELINE-config-4 program on the 8-way virtual CPU mesh, at sizes the
+# committed FULLSCALE artifact has never shown.  Sequential — one host core —
+# and nice'd so interactive work keeps priority.  Each run writes its own
+# artifact as soon as it completes.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p /tmp/fullscale_r4
+# the axon site hook imports jax at interpreter startup, so the platform
+# must be pinned in the environment BEFORE python launches —
+# full_scale._force_cpu_mesh alone is too late under this site config
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="--xla_force_host_platform_device_count=8"
+unset PALLAS_AXON_POOL_IPS PALLAS_AXON_REMOTE_COMPILE PALLAS_AXON_TPU_GEN
+echo "[$(date -u +%FT%TZ)] start N=65536" >> /tmp/fullscale_r4/progress.log
+nice -n 19 python -m gossipfs_tpu.bench.full_scale \
+  --n 65536 --rounds 16 --out FULLSCALE_65536.json \
+  > /tmp/fullscale_r4/n65536.out 2>&1
+echo "[$(date -u +%FT%TZ)] done N=65536 rc=$?" >> /tmp/fullscale_r4/progress.log
+echo "[$(date -u +%FT%TZ)] start N=98304" >> /tmp/fullscale_r4/progress.log
+nice -n 19 python -m gossipfs_tpu.bench.full_scale \
+  --n 98304 --rounds 12 --out FULLSCALE_98304.json \
+  > /tmp/fullscale_r4/n98304.out 2>&1
+echo "[$(date -u +%FT%TZ)] done N=98304 rc=$?" >> /tmp/fullscale_r4/progress.log
