@@ -475,9 +475,20 @@ def _rebase_shifts(
     base to the new one (the merge write renormalizes every stored value to
     this round's base), ``store_base`` is the new per-subject base (zero in
     int32 mode).  See the anchoring argument in :func:`_pre_tick`.
+
+    :func:`_rebase_shifts_vec` is the shape-agnostic core (the rr scan
+    carries its lanes stripe-major, where ``hb.shape[1:]`` is no longer
+    the subject shape).
     """
     hb = state.hb
     basec = state.hb_base.reshape(hb.shape[1:])  # all-zero in int32 mode
+    return _rebase_shifts_vec(hb.dtype, basec, config, colmax_est)
+
+
+def _rebase_shifts_vec(
+    hb_dtype, basec: jax.Array, config: SimConfig, colmax_est: jax.Array
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    hb = jnp.zeros((), dtype=hb_dtype)  # dtype carrier only
     view_base = jnp.maximum(colmax_est - config.rebase_window, 0)
     if hb.dtype != jnp.int32:
         # tracks the diagonal, DOWN included: a rejoin resets the subject's
@@ -1013,8 +1024,10 @@ def _update_carry(
     member_col: jax.Array | None = None,
 ) -> MetricsCarry:
     n = state.n
-    nd, shp = state.status.ndim, state.status.shape
-    nloc = _nsubj(shp)
+    # nloc from the per-subject vector, NOT the lane shape — the rr scan
+    # carries its lanes in the stripe-major layout where shape[1:] is no
+    # longer the subject count
+    nloc = any_fail.shape[0]
     first_detect, first_observer, converged = carry  # [nloc] — shard's slice
     # rejoined = joins that actually took effect: new incarnation, new clock
     rejoined_l = ctx.slice_cols(rejoined, nloc)
@@ -1033,6 +1046,7 @@ def _update_carry(
         # the full-matrix reduction below
         all_dropped = (member_col.reshape(nloc) == 0) & ~alive_l
     else:
+        nd, shp = state.status.ndim, state.status.shape
         dropped = (
             ~_rx(state.alive, nd) | _eye(n, shp, ctx) | (state.status != MEMBER)
         )
@@ -1054,7 +1068,8 @@ def _use_rr(config: SimConfig, n: int, nloc: int) -> bool:
     of recomputed (round-4 redesign; see the kernel's module comment for
     the traffic arithmetic).  Requirements beyond the stripe kernel's:
     the lean fault model (callers: matrix_events == False), fresh
-    cooldown, gossip-only dissemination, random explicit-edge topology,
+    cooldown, gossip-only dissemination, a random topology (explicit
+    edges, or arc bases — the kernel then window-maxes the view stripe),
     and all-int8 lanes.
     """
     from gossipfs_tpu.ops import merge_pallas
@@ -1064,10 +1079,15 @@ def _use_rr(config: SimConfig, n: int, nloc: int) -> bool:
     if (
         config.remove_broadcast
         or not config.fresh_cooldown
-        or config.topology != "random"
+        or config.topology not in ("random", "random_arc")
         or config.hb_dtype != "int8"
         # honor the debug knob: 'off' means the separate-pass round
         or config.fused_tick != "auto"
+    ):
+        return False
+    if config.topology == "random_arc" and (
+        config.n % merge_pallas.ARC_CHUNK
+        or not 1 < config.fanout <= merge_pallas.ARC_CHUNK
     ):
         return False
     if not merge_pallas.stripe_supported(n, config.fanout, nloc):
@@ -1099,12 +1119,25 @@ def _scan_rounds_rr(
     from gossipfs_tpu.ops import merge_pallas
 
     n = state.n
-    shp = state.hb.shape
-    nloc = _nsubj(shp)
     interp = config.merge_kernel.endswith("interpret")
     lane = merge_pallas.LANE
+    # stripe-major lane layout [nc, N, cs, LANE] for the whole scan: each
+    # stripe's rows become one contiguous region, so every kernel DMA is a
+    # single contiguous transfer (one transpose each way per scan)
+    tr = lambda a: a.transpose(1, 0, 2, 3)  # noqa: E731
+    state = state._replace(
+        hb=tr(state.hb), age=tr(state.age), status=tr(state.status)
+    )
+    nc, _, cs, _ = state.hb.shape
+    subj_shape = (nc, cs, lane)
+    c_blk = cs * lane
+
+    def diag(arr4):  # subject j's own row entry, stripe-major layout
+        j = jnp.arange(n)
+        return arr4[j // c_blk, j, (j % c_blk) // lane, j % lane]
+
     counts0 = jnp.sum(
-        (state.status == MEMBER).astype(jnp.int32), axis=_subj_axes(state.status)
+        (state.status == MEMBER).astype(jnp.int32), axis=(0, 2, 3)
     )
 
     def step(carry, ev: RoundEvents):
@@ -1122,14 +1155,13 @@ def _scan_rounds_rr(
         active = alive & ~small
         refresher = alive & small
         # per-subject rebase vectors (_pre_tick's diagonal anchor + the
-        # shared _rebase_shifts; int8 mode: view and storage windows
+        # shared rebase policy; int8 mode: view and storage windows
         # coincide, so sa == sb)
         basec = st.hb_base
-        colmax_est = _diag(st.hb).astype(jnp.int32) + basec + 1
-        sa_s, sb_s, store_base_s = _rebase_shifts(
-            st, config, colmax_est.reshape(shp[1:])
+        colmax_est = diag(st.hb).astype(jnp.int32) + basec + 1
+        sa, sb, store_base = _rebase_shifts_vec(
+            st.hb.dtype, basec, config, colmax_est
         )
-        store_base = store_base_s.reshape(-1)
         g = config.hb_grace - basec
         flags = (
             active.astype(jnp.int32)
@@ -1138,36 +1170,46 @@ def _scan_rounds_rr(
         ).astype(jnp.int8)
         flags = jnp.broadcast_to(flags[:, None], (n, lane))
         edges = topology.in_edges(config, k_edge, None)
+        arc_fanout = config.fanout if config.topology == "random_arc" else None
         hb, age, status, cnt_incl, ndet, fobs, rcnt = (
             merge_pallas.resident_round_blocked(
                 edges, st.hb, st.age, st.status, flags,
-                sa_s, sb_s, g.reshape(shp[1:]),
+                sa.reshape(subj_shape), sb.reshape(subj_shape),
+                g.reshape(subj_shape), fanout=arc_fanout,
                 member=int(MEMBER), unknown=int(UNKNOWN), failed=int(FAILED),
                 age_clamp=AGE_CLAMP, window=config.rebase_window,
                 t_fail=config.t_fail, t_cooldown=config.t_cooldown,
                 block_r=config.merge_block_r, interpret=interp,
             )
         )
-        counts_next = jnp.sum(rcnt.reshape(n, -1, lane)[:, :, 0], axis=1)
+        # rcnt is lane-replicated: summing ALL lanes and dividing by LANE
+        # is a contiguous reduce (the [:, :, 0] slice formulation was a
+        # strided gather, ~7x slower over the 33 MB buffer)
+        counts_next = jnp.sum(
+            rcnt.reshape(n, -1), axis=1, dtype=jnp.int32
+        ) // lane
         round_idx = st.round
         st2 = st._replace(
             hb=hb, age=age, status=status, alive=alive,
             hb_base=store_base, round=st.round + 1,
         )
-        n_det = ndet.reshape(nloc)
-        first_obs = fobs.reshape(nloc)
+        n_det = ndet.reshape(n)
+        first_obs = fobs.reshape(n)
         metrics, any_fail = _round_stats(n_det, st2, LOCAL_CTX)
-        self_member = alive & (_diag(status) == MEMBER)
-        member_col = cnt_incl.reshape(nloc) - self_member.astype(jnp.int32)
+        self_member = alive & (diag(status) == MEMBER)
+        member_col = cnt_incl.reshape(n) - self_member.astype(jnp.int32)
         rejoined = jnp.zeros_like(alive)  # constant: resets fold away
         mc = _update_carry(mc, st2, rejoined, any_fail, first_obs, round_idx,
                            LOCAL_CTX, member_col=member_col)
         return (st2, mc, counts_next), metrics
 
     if mcarry0 is None:
-        mcarry0 = MetricsCarry.init(nloc)
+        mcarry0 = MetricsCarry.init(n)
     (state, mcarry, _), per_round = lax.scan(
         step, (state, mcarry0, counts0), events
+    )
+    state = state._replace(
+        hb=tr(state.hb), age=tr(state.age), status=tr(state.status)
     )
     return state, mcarry, per_round
 

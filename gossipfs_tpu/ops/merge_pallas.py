@@ -1069,6 +1069,7 @@ def _rr_kernel(
     n: int, n_fanout: int, r_blk: int, cs: int, chunk: int,
     member: int, unknown: int, failed: int, age_clamp: int,
     window: int, t_fail: int, t_cooldown: int, hb_min: int,
+    arc: bool = False,
 ):
     nchunks = n // chunk
     nblocks = n // r_blk
@@ -1078,6 +1079,7 @@ def _rr_kernel(
         sa_ref, sb_ref, g_ref, hb_any, age_any, status_any,
         hb_out, age_out, status_out, cnt_out, ndet_out, fobs_out, rcnt_out,
         stripe, best_scratch, lane_scratch, lane_sems,
+        *arc_scratch,
     ):
         # The raw lanes arrive ONCE, in ANY memory space; every VMEM
         # crossing is an explicit software-pipelined DMA into the shared
@@ -1097,7 +1099,7 @@ def _rr_kernel(
             rows = pl.ds(blk_rows * rows_per, rows_per)
             for li, lane in enumerate((hb_any, age_any, status_any)):
                 pltpu.make_async_copy(
-                    lane.at[rows, j],
+                    lane.at[j, rows],
                     lane_scratch.at[slot, li, pl.ds(0, rows_per)],
                     lane_sems.at[slot, li],
                 ).start()
@@ -1105,7 +1107,7 @@ def _rr_kernel(
         def wait(rows_per, slot):
             for li, lane in enumerate((hb_any, age_any, status_any)):
                 pltpu.make_async_copy(
-                    lane.at[pl.ds(0, rows_per), j],
+                    lane.at[j, pl.ds(0, rows_per)],
                     lane_scratch.at[slot, li, pl.ds(0, rows_per)],
                     lane_sems.at[slot, li],
                 ).wait()
@@ -1156,6 +1158,15 @@ def _rr_kernel(
                 return 0
 
             lax.fori_loop(0, nchunks, body, 0, unroll=False)
+            if arc:
+                # arc senders are F consecutive rows: replace the stripe
+                # with its windowed row-max once, so the per-receiver
+                # merge below is ONE vector load instead of an F-way
+                # scalar-issued gather (O(log F) vectorized passes,
+                # amortized over every receiver)
+                bufa, bufb, halo = arc_scratch
+                _windowmax_inplace(stripe, bufa, bufb, halo, n_fanout,
+                                   n // ARC_CHUNK)
             # the view build used both ping-pong slots; reload this
             # step's receiver block (the one unpipelined load per stripe)
             issue(0, r_blk, 0)
@@ -1169,13 +1180,19 @@ def _rr_kernel(
         def _():
             issue(i + 1, r_blk, lax.rem(i + 1, 2))
 
-        # --- every i: F-way max from the resident stripe ----------------
-        def gather(r, _):
-            acc = stripe[edges_ref[r, 0]].astype(jnp.int32)
-            for f in range(1, n_fanout):
-                acc = jnp.maximum(acc, stripe[edges_ref[r, f]].astype(jnp.int32))
-            best_scratch[r] = acc
-            return 0
+        # --- every i: merge rows from the resident stripe ---------------
+        if arc:
+            def gather(r, _):
+                best_scratch[r] = stripe[edges_ref[r, 0]].astype(jnp.int32)
+                return 0
+        else:
+            def gather(r, _):
+                acc = stripe[edges_ref[r, 0]].astype(jnp.int32)
+                for f in range(1, n_fanout):
+                    acc = jnp.maximum(acc,
+                                      stripe[edges_ref[r, f]].astype(jnp.int32))
+                best_scratch[r] = acc
+                return 0
 
         lax.fori_loop(0, r_blk, gather, 0, unroll=False)
         wait(r_blk, slot)
@@ -1206,11 +1223,11 @@ def _rr_kernel(
         upd = advance | add
         new_hb = jnp.clip(jnp.where(upd, best + (sa - sb), hb - sb),
                           hb_min, -hb_min - 1)
-        hb_out[:, 0] = new_hb.astype(hb_out.dtype)
+        hb_out[0] = new_hb.astype(hb_out.dtype)
         new_age = jnp.minimum(jnp.where(upd, 0, age) + 1, age_clamp)
-        age_out[:, 0] = new_age.astype(age_out.dtype)
+        age_out[0] = new_age.astype(age_out.dtype)
         st_new = jnp.where(add, member, st)
-        status_out[:, 0] = st_new.astype(status_out.dtype)
+        status_out[0] = st_new.astype(status_out.dtype)
 
         # per-subject reductions, accumulated across consecutive i steps
         cnt_part = jnp.sum((recv & (st_new == member)).astype(jnp.int32),
@@ -1245,7 +1262,7 @@ def _rr_kernel(
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "member", "unknown", "failed", "age_clamp", "window",
+        "fanout", "member", "unknown", "failed", "age_clamp", "window",
         "t_fail", "t_cooldown", "block_r", "chunk", "interpret",
     ),
 )
@@ -1259,6 +1276,7 @@ def resident_round_blocked(
     sb: jax.Array,
     g: jax.Array,
     *,
+    fanout: int | None = None,
     member: int,
     unknown: int,
     failed: int,
@@ -1272,10 +1290,15 @@ def resident_round_blocked(
 ) -> tuple[jax.Array, ...]:
     """One whole gossip round (lean crash-only fault model) in one kernel.
 
-    Contract (all lanes int8 in the :func:`blocked_shape` layout, PRE-tick):
+    Contract (all lanes int8, STRIPE-MAJOR ``[nc, N, cs, LANE]`` layout —
+    ``blocked_shape`` transposed so each stripe's rows are contiguous —
+    PRE-tick):
 
     * ``edges`` int32 [N, F] in-edge sender ids (NOT remapped for dead
-      receivers — the epilogue gates on the alive bit instead).
+      receivers — the epilogue gates on the alive bit instead).  For the
+      ``random_arc`` topology pass arc BASES int32 [N] plus ``fanout=F``:
+      the kernel then window-maxes the view stripe once (O(log F)
+      vectorized passes) and the per-receiver merge is a single load.
     * ``flags`` int8 [N, LANE]: bit 0 = active sender this round
       (alive & group >= min_group), bit 1 = small-group refresher,
       bit 2 = alive.  Derived per round from the carried member counts.
@@ -1291,10 +1314,16 @@ def resident_round_blocked(
     NEXT round's active/refresher split (carried by the scan — the
     member-count XLA pass is gone too).
     """
-    n, nc, cs, _ = hb.shape
-    fanout = edges.shape[1]
+    nc, n, cs, _ = hb.shape
+    arc = fanout is not None
+    if not arc:
+        fanout = edges.shape[1]
+    elif edges.ndim == 1:
+        edges = edges.reshape(n, 1)
     if hb.dtype != jnp.int8:
         raise ValueError("resident round kernel requires int8 lanes")
+    if arc and n % ARC_CHUNK:
+        raise ValueError(f"arc resident round needs N % {ARC_CHUNK} == 0")
     if not stripe_supported(n, fanout, nc * cs * LANE):
         raise ValueError(
             f"resident round kernel needs lane-aligned N, cs*LANE == "
@@ -1309,16 +1338,26 @@ def resident_round_blocked(
         r_blk //= 2
     hb_min = int(jnp.iinfo(jnp.int8).min)
 
-    row_spec = lambda j, i: (i, j, 0, 0)  # noqa: E731
-    lane_blk = pl.BlockSpec((r_blk, 1, cs, LANE), row_spec,
+    # stripe-major lane layout [nc, N, cs, LANE]: a stripe's rows are one
+    # contiguous region, so every lane DMA block and output block is a
+    # single contiguous transfer (the receiver-major layout's 4 KB-strided
+    # rows bounded the kernel at ~220 GB/s effective)
+    lane_blk = pl.BlockSpec((1, r_blk, cs, LANE), lambda j, i: (j, i, 0, 0),
                             memory_space=pltpu.VMEM)
     subj_spec = pl.BlockSpec(
         (1, cs, LANE), lambda j, i: (j, 0, 0), memory_space=pltpu.VMEM
     )
     buf_rows = max(ch, r_blk)
+    ew = 1 if arc else fanout
+    ext = ARC_CHUNK + fanout - 1
+    arc_scratch = [
+        pltpu.VMEM((ext, cs, LANE), jnp.bfloat16),
+        pltpu.VMEM((ext, cs, LANE), jnp.bfloat16),
+        pltpu.VMEM((fanout - 1, cs, LANE), jnp.int8),
+    ] if arc else []
     out = pl.pallas_call(
         _rr_kernel(n, fanout, r_blk, cs, ch, member, unknown, failed,
-                   age_clamp, window, t_fail, t_cooldown, hb_min),
+                   age_clamp, window, t_fail, t_cooldown, hb_min, arc=arc),
         grid=(nc, n // r_blk),
         # in-place lane update: safe because every [row-block, stripe]
         # region's reads (the i==0 view-build chunk pass and the one-step-
@@ -1329,7 +1368,7 @@ def resident_round_blocked(
         # buffers from peak HBM
         input_output_aliases={5: 0, 6: 1, 7: 2},
         in_specs=[
-            pl.BlockSpec((r_blk, fanout), lambda j, i: (i, 0),
+            pl.BlockSpec((r_blk, ew), lambda j, i: (i, 0),
                          memory_space=pltpu.SMEM),
             pl.BlockSpec((n, LANE), lambda j, i: (0, 0),
                          memory_space=pltpu.VMEM),   # flags (resident)
@@ -1347,9 +1386,9 @@ def resident_round_blocked(
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((n, nc, cs, LANE), jnp.int8),
-            jax.ShapeDtypeStruct((n, nc, cs, LANE), jnp.int8),
-            jax.ShapeDtypeStruct((n, nc, cs, LANE), jnp.int8),
+            jax.ShapeDtypeStruct((nc, n, cs, LANE), jnp.int8),
+            jax.ShapeDtypeStruct((nc, n, cs, LANE), jnp.int8),
+            jax.ShapeDtypeStruct((nc, n, cs, LANE), jnp.int8),
             jax.ShapeDtypeStruct((nc, cs, LANE), jnp.int32),
             jax.ShapeDtypeStruct((nc, cs, LANE), jnp.int32),
             jax.ShapeDtypeStruct((nc, cs, LANE), jnp.int32),
@@ -1361,7 +1400,7 @@ def resident_round_blocked(
             # shared ping-pong: view-build chunks AND receiver blocks
             pltpu.VMEM((2, 3, buf_rows, cs, LANE), jnp.int8),
             pltpu.SemaphoreType.DMA((2, 3)),
-        ],
+        ] + arc_scratch,
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=120 * 1024 * 1024),
         interpret=interpret,

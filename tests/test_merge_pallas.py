@@ -277,10 +277,9 @@ def test_stripe_and_arc_kernel_smoke():
         )
         key = jax.random.PRNGKey(13)
         # the resident-round kernel (whole round in one pallas call, the
-        # round-4 headline path) only serves random explicit-edge topology
-        kernels = ["pallas_stripe_interpret"]
-        if topology == "random":
-            kernels.append("pallas_rr_interpret")
+        # round-4 headline path) serves both random topologies: explicit
+        # edges, or arc bases via the in-stripe windowed row-max
+        kernels = ["pallas_stripe_interpret", "pallas_rr_interpret"]
         out = {}
         for kernel in ["xla"] + kernels:
             cfg = dataclasses.replace(base, merge_kernel=kernel)
