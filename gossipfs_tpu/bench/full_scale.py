@@ -34,12 +34,37 @@ import time
 
 
 def _force_cpu_mesh(n_devices: int) -> None:
+    """Force this process onto an ``n_devices``-wide virtual CPU mesh.
+
+    The container's sitecustomize registers the axon TPU backend at
+    interpreter startup whenever ``PALLAS_AXON_POOL_IPS`` is set — BEFORE
+    this function runs — so mutating ``os.environ`` alone is too late: the
+    100k-class state would land on (and exhaust) the one real chip.
+    """
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     flags = os.environ.get("XLA_FLAGS", "")
     os.environ["XLA_FLAGS"] = (
-        f"{flags} --xla_force_host_platform_device_count={n_devices}".strip()
-    )
+        f"{flags} --xla_force_host_platform_device_count={n_devices}"
+        # XLA's CPU-collective rendezvous aborts the process when the 8
+        # virtual devices' threads arrive at an all-reduce more than 40 s
+        # apart.  On this 1-core host a 100k-class shard computes for
+        # MINUTES between collectives, so the skew between timesliced
+        # device threads routinely exceeds the default — this, not memory
+        # or wall-clock, is what capped earlier full-scale artifacts at
+        # N=32,768.  Raise warn/terminate to 12 h.
+        " --xla_cpu_collective_call_warn_stuck_seconds=43200"
+        " --xla_cpu_collective_call_terminate_timeout_seconds=43200"
+        " --xla_cpu_collective_timeout_seconds=43200"
+    ).strip()
+    # sitecustomize has already imported jax and registered the axon
+    # factory; deregister it before any backend initializes (same pattern
+    # as tests/conftest.py) so the env mutation above actually takes
+    import jax
+    from jax._src import xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+    jax.config.update("jax_platforms", "cpu")
 
 
 def run(n: int, rounds: int, crash_at: int, track: int, crash_rate: float,

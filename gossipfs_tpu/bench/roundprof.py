@@ -1,11 +1,18 @@
-"""Per-round timing across merge-kernel configurations (TPU tuning aid).
+"""Per-round timing + bandwidth utilization across merge-kernel configs.
 
     python -m gossipfs_tpu.bench.roundprof            # default N=16384
     python -m gossipfs_tpu.bench.roundprof --n 8192 --rounds 50
 
-Prints ms/round and rounds/s for each named configuration so kernel work
-(ops/merge_pallas.py) can be attributed: the XLA-remainder cost is the gap
-between a config's round time and its merge kernel's standalone time.
+For each named configuration this prints ms/round, rounds/s, and the
+bandwidth-utilization block (the MFU analog for this bandwidth-bound
+workload): HBM bytes the round's program moves (modeled per path from the
+lane dtypes — see :func:`round_bytes`), achieved GB/s against the chip's
+peak, and the protocol's information-floor bytes (each hb/age/status entry
+read once + written once — no program that advances the whole cluster's
+state can move less), whose implied round time is the headline's ceiling.
+The XLA-remainder cost is the gap between a config's round time and its
+merge kernel's standalone time (utils/profiling.op_breakdown attributes
+it op-by-op).
 """
 
 from __future__ import annotations
@@ -64,7 +71,91 @@ def variants(n: int) -> dict[str, SimConfig]:
         out["arc_hb8_xla"] = dataclasses.replace(
             cfg, topology="random_arc", merge_kernel="xla", hb_dtype="int8",
         )
+        out["rr"] = dataclasses.replace(
+            cfg, merge_kernel="pallas_rr", merge_block_c=STRIPE_BLOCK_C,
+            hb_dtype="int8", merge_block_r=256,
+        )
+        out["rr_arc"] = dataclasses.replace(
+            cfg, topology="random_arc", merge_kernel="pallas_rr",
+            merge_block_c=STRIPE_BLOCK_C, hb_dtype="int8", merge_block_r=256,
+        )
     return out
+
+
+# v5e HBM peak (one chip): 819 GB/s
+HBM_PEAK_GBS = 819.0
+
+
+def round_bytes(cfg: SimConfig) -> dict:
+    """Modeled HBM bytes per round, by phase, for a config's chosen path.
+
+    The model counts matrix ([N, N]-lane) traffic only — per-subject
+    vectors, edges, and RNG are O(N·F) and three orders of magnitude
+    smaller.  Byte counts per phase follow each path's actual program:
+
+    * ``floor``: the PROTOCOL's information floor — each entry's minimal
+      wire (hb byte + the age|status packed byte the rr path proves
+      sufficient) read once + written once, i.e. 4·N² bytes — the same
+      for every row so ceilings are comparable across configs; paths
+      carrying wider state pay their surplus in the phase bytes, not in a
+      redefined floor.
+    * ``pallas_rr``: the resident-round kernel's wire is TWO bytes per
+      entry (hb int8 + the age|status packed byte); it reads each lane
+      stripe twice (view build + receiver sweep) and writes once, plus
+      the [N, nc·LANE] int32 per-receiver count side output (written by
+      the kernel, re-read by the scan's reduce).
+    * ``pallas_stripe`` / ``pallas``: separate XLA tick+view pass (3 lane
+      reads, 3 lane writes + 1 view write), kernel (view read — F-fold
+      for the gather kernel's per-row DMAs, once for the stripe — + 3
+      lane reads + 3 lane writes), member-count pass (1 status read).
+    * ``xla``: as stripe but the merge's view read is F-fold (gather).
+    """
+    n = cfg.n
+    nn = n * n
+    hb_b = {"int32": 4, "int16": 2, "int8": 1}[cfg.hb_dtype]
+    view_b = {"int32": 4, "int16": 2, "int8": 1}[cfg.view_dtype]
+    lanes_rw = nn * (hb_b + 1 + 1)  # hb + age + status, one crossing each
+    floor = 2 * nn * 2  # minimal wire (2 B/entry packed), read + write
+    f = cfg.fanout
+    arc = cfg.topology == "random_arc"
+    if cfg.merge_kernel.startswith("pallas_rr"):
+        from gossipfs_tpu.ops.merge_pallas import LANE
+
+        nc = n // cfg.merge_block_c
+        packed = nn * 2  # hb int8 + age|status packed into one byte
+        phases = {
+            "view_build_read": packed,
+            "receiver_read": packed,
+            "lane_write": packed,
+            "recv_count_side": 2 * n * nc * LANE * 4,
+        }
+        total = sum(phases.values())
+        return {"phases": phases, "total": total, "floor": floor}
+    else:
+        merge_view_reads = nn * view_b if arc else f * nn * view_b
+        if cfg.merge_kernel.startswith("pallas_stripe"):
+            merge_view_reads = nn * view_b  # stripe resident: one crossing
+        phases = {
+            "tick_view_pass": 2 * lanes_rw + nn * view_b,
+            "merge_kernel": merge_view_reads + 2 * lanes_rw,
+            "member_count_pass": nn,
+        }
+    total = sum(phases.values())
+    return {"phases": phases, "total": total, "floor": floor}
+
+
+def bandwidth_row(cfg: SimConfig, seconds_per_round: float) -> dict:
+    b = round_bytes(cfg)
+    gbs = b["total"] / seconds_per_round / 1e9
+    floor_s = b["floor"] / (HBM_PEAK_GBS * 1e9)
+    return {
+        "modeled_bytes_per_round": b["total"],
+        "achieved_gb_per_s": round(gbs, 1),
+        "pct_of_peak_hbm": round(100.0 * gbs / HBM_PEAK_GBS, 1),
+        "floor_bytes_per_round": b["floor"],
+        "floor_implied_ceiling_rounds_per_sec": round(1.0 / floor_s, 1),
+        "phase_bytes": b["phases"],
+    }
 
 
 def time_config(cfg: SimConfig, rounds: int, reps: int = 3) -> float:
@@ -96,6 +187,7 @@ def main(argv=None) -> None:
         rows[name] = {
             "ms_per_round": round(per_round * 1e3, 3),
             "rounds_per_sec": round(1.0 / per_round, 1),
+            **bandwidth_row(cfg, per_round),
         }
         print(json.dumps({"config": name, "n": args.n, **rows[name]}), flush=True)
 

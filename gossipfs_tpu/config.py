@@ -23,9 +23,12 @@ Topology = Literal["ring", "random", "random_arc"]
 
 # The ``age`` lane is stored as int8 and saturates here: every protocol
 # comparison is against a small threshold (t_fail, t_cooldown), so any age
-# beyond the clamp behaves identically.  Kept < 127 so ``age + 1`` can never
-# overflow before the clamp is applied.
-AGE_CLAMP = 100
+# beyond the clamp behaves identically.  Kept at 63 (6 bits) so age and
+# status (2 bits) pack into ONE byte on the resident-round kernel's wire —
+# the packing that cuts the round's HBM traffic by a third
+# (ops/merge_pallas.resident_round_blocked); SimConfig rejects thresholds
+# that would need deeper ages.
+AGE_CLAMP = 63
 
 # Per-subject heartbeat rebasing windows for the gossip view (core/rounds.py
 # ``_merge``).  Gossipable entries lag the freshest copy of a subject's

@@ -1123,12 +1123,15 @@ def _scan_rounds_rr(
     lane = merge_pallas.LANE
     # stripe-major lane layout [nc, N, cs, LANE] for the whole scan: each
     # stripe's rows become one contiguous region, so every kernel DMA is a
-    # single contiguous transfer (one transpose each way per scan)
+    # single contiguous transfer (one transpose each way per scan).  The
+    # age and status lanes travel PACKED into one byte
+    # (merge_pallas.pack_age_status) — the kernel's HBM wire is 2 B/entry,
+    # a third less traffic than the 3-lane form on a bandwidth-bound round.
     tr = lambda a: a.transpose(1, 0, 2, 3)  # noqa: E731
-    state = state._replace(
-        hb=tr(state.hb), age=tr(state.age), status=tr(state.status)
-    )
-    nc, _, cs, _ = state.hb.shape
+    hb4 = tr(state.hb)
+    status4 = tr(state.status)
+    as4 = merge_pallas.pack_age_status(tr(state.age), status4)
+    nc, _, cs, _ = hb4.shape
     subj_shape = (nc, cs, lane)
     c_blk = cs * lane
 
@@ -1136,31 +1139,33 @@ def _scan_rounds_rr(
         j = jnp.arange(n)
         return arr4[j // c_blk, j, (j % c_blk) // lane, j % lane]
 
-    counts0 = jnp.sum(
-        (state.status == MEMBER).astype(jnp.int32), axis=(0, 2, 3)
-    )
+    counts0 = jnp.sum((status4 == MEMBER).astype(jnp.int32), axis=(0, 2, 3))
+
+    class _Cols(NamedTuple):  # what _round_stats/_update_carry consume
+        alive: jax.Array
+        n: int
 
     def step(carry, ev: RoundEvents):
-        st, mc, counts = carry
-        k = jax.random.fold_in(key, st.round)
+        hb4, as4, alive0, hb_base, rnd, mc, counts = carry
+        k = jax.random.fold_in(key, rnd)
         k_edge, k_churn = jax.random.split(k)
         crash = ev.crash | ev.leave
         if crash_rate > 0.0:
-            c2, _ = topology.churn_masks(k_churn, st.alive, crash_rate, 0.0)
+            c2, _ = topology.churn_masks(k_churn, alive0, crash_rate, 0.0)
             if churn_ok is not None:
                 c2 = c2 & churn_ok
             crash = crash | c2
-        alive = st.alive & ~crash
+        alive = alive0 & ~crash
         small = counts < config.min_group
         active = alive & ~small
         refresher = alive & small
         # per-subject rebase vectors (_pre_tick's diagonal anchor + the
         # shared rebase policy; int8 mode: view and storage windows
         # coincide, so sa == sb)
-        basec = st.hb_base
-        colmax_est = diag(st.hb).astype(jnp.int32) + basec + 1
+        basec = hb_base
+        colmax_est = diag(hb4).astype(jnp.int32) + basec + 1
         sa, sb, store_base = _rebase_shifts_vec(
-            st.hb.dtype, basec, config, colmax_est
+            hb4.dtype, basec, config, colmax_est
         )
         g = config.hb_grace - basec
         flags = (
@@ -1171,9 +1176,9 @@ def _scan_rounds_rr(
         flags = jnp.broadcast_to(flags[:, None], (n, lane))
         edges = topology.in_edges(config, k_edge, None)
         arc_fanout = config.fanout if config.topology == "random_arc" else None
-        hb, age, status, cnt_incl, ndet, fobs, rcnt = (
+        hb2, as2, cnt_incl, ndet, fobs, rcnt = (
             merge_pallas.resident_round_blocked(
-                edges, st.hb, st.age, st.status, flags,
+                edges, hb4, as4, flags,
                 sa.reshape(subj_shape), sb.reshape(subj_shape),
                 g.reshape(subj_shape), fanout=arc_fanout,
                 member=int(MEMBER), unknown=int(UNKNOWN), failed=int(FAILED),
@@ -1188,28 +1193,31 @@ def _scan_rounds_rr(
         counts_next = jnp.sum(
             rcnt.reshape(n, -1), axis=1, dtype=jnp.int32
         ) // lane
-        round_idx = st.round
-        st2 = st._replace(
-            hb=hb, age=age, status=status, alive=alive,
-            hb_base=store_base, round=st.round + 1,
-        )
+        cols = _Cols(alive=alive, n=n)
         n_det = ndet.reshape(n)
         first_obs = fobs.reshape(n)
-        metrics, any_fail = _round_stats(n_det, st2, LOCAL_CTX)
-        self_member = alive & (diag(status) == MEMBER)
+        metrics, any_fail = _round_stats(n_det, cols, LOCAL_CTX)
+        self_member = alive & (
+            ((diag(as2).astype(jnp.int32) + 128) & 3) == MEMBER
+        )
         member_col = cnt_incl.reshape(n) - self_member.astype(jnp.int32)
         rejoined = jnp.zeros_like(alive)  # constant: resets fold away
-        mc = _update_carry(mc, st2, rejoined, any_fail, first_obs, round_idx,
+        mc = _update_carry(mc, cols, rejoined, any_fail, first_obs, rnd,
                            LOCAL_CTX, member_col=member_col)
-        return (st2, mc, counts_next), metrics
+        return (hb2, as2, alive, store_base, rnd + 1, mc, counts_next), metrics
 
     if mcarry0 is None:
         mcarry0 = MetricsCarry.init(n)
-    (state, mcarry, _), per_round = lax.scan(
-        step, (state, mcarry0, counts0), events
+    (hb4, as4, alive, hb_base, rnd, mcarry, _), per_round = lax.scan(
+        step,
+        (hb4, as4, state.alive, state.hb_base, state.round, mcarry0, counts0),
+        events,
     )
+    age_w, st_w = merge_pallas.unpack_age_status(as4)
     state = state._replace(
-        hb=tr(state.hb), age=tr(state.age), status=tr(state.status)
+        hb=tr(hb4), age=tr(age_w.astype(jnp.int8)),
+        status=tr(st_w.astype(jnp.int8)), alive=alive, hb_base=hb_base,
+        round=rnd,
     )
     return state, mcarry, per_round
 
@@ -1365,10 +1373,75 @@ def _run_rounds_impl(
 _RUN_ROUNDS_STATIC = (
     "config", "num_rounds", "crash_rate", "rejoin_rate", "crash_only_events"
 )
-run_rounds = partial(jax.jit, static_argnames=_RUN_ROUNDS_STATIC)(_run_rounds_impl)
-# in-place variant: XLA reuses the input state's HBM for the output (the
-# caller's ``state`` is consumed).  At N=32k the scan needs ~13 GiB without
-# aliasing — past a v5e chip's headroom — and ~9 GiB with it.
-run_rounds_donate = partial(
+_run_rounds_jit = partial(jax.jit, static_argnames=_RUN_ROUNDS_STATIC)(
+    _run_rounds_impl
+)
+_run_rounds_donate_jit = partial(
     jax.jit, static_argnames=_RUN_ROUNDS_STATIC, donate_argnums=(0,)
 )(_run_rounds_impl)
+
+
+def check_crash_only_promise(
+    events: RoundEvents | None, crash_only_events: bool
+) -> None:
+    """Fail loudly when a join-carrying schedule meets crash_only_events.
+
+    ``crash_only_events=True`` is the caller's static promise that the
+    schedule carries no join bits (leave bits are honored as silent death;
+    join bits would be silently IGNORED on the lean path) — enforced while
+    the events are still concrete, so a schedule that breaks the promise
+    fails instead of simulating the wrong dynamics.  Shared by every entry
+    that takes the flag (run_rounds, run_rounds_donate,
+    parallel.mesh.run_rounds_sharded).
+    """
+    if crash_only_events and events is not None and not isinstance(
+        events.join, jax.core.Tracer
+    ):
+        if bool(jnp.any(events.join)):
+            raise ValueError(
+                "crash_only_events=True ignores events.join, but the "
+                "schedule contains join bits — drop the flag or the joins"
+            )
+
+
+def run_rounds(
+    state: SimState,
+    config: SimConfig,
+    num_rounds: int,
+    key: jax.Array,
+    events: RoundEvents | None = None,
+    crash_rate: float = 0.0,
+    rejoin_rate: float = 0.0,
+    churn_ok: jax.Array | None = None,
+    mcarry0: MetricsCarry | None = None,
+    crash_only_events: bool = False,
+) -> tuple[SimState, MetricsCarry, RoundMetrics]:
+    """Jitted entry for :func:`_run_rounds_impl` (same signature/docs)."""
+    check_crash_only_promise(events, crash_only_events)
+    return _run_rounds_jit(
+        state, config, num_rounds, key, events, crash_rate, rejoin_rate,
+        churn_ok, mcarry0, crash_only_events,
+    )
+
+
+def run_rounds_donate(
+    state: SimState,
+    config: SimConfig,
+    num_rounds: int,
+    key: jax.Array,
+    events: RoundEvents | None = None,
+    crash_rate: float = 0.0,
+    rejoin_rate: float = 0.0,
+    churn_ok: jax.Array | None = None,
+    mcarry0: MetricsCarry | None = None,
+    crash_only_events: bool = False,
+) -> tuple[SimState, MetricsCarry, RoundMetrics]:
+    """In-place variant: XLA reuses the input state's HBM for the output
+    (the caller's ``state`` is consumed).  At N=32k the scan needs ~13 GiB
+    without aliasing — past a v5e chip's headroom — and ~9 GiB with it.
+    """
+    check_crash_only_promise(events, crash_only_events)
+    return _run_rounds_donate_jit(
+        state, config, num_rounds, key, events, crash_rate, rejoin_rate,
+        churn_ok, mcarry0, crash_only_events,
+    )

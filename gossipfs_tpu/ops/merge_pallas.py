@@ -538,7 +538,12 @@ def _epilogue_and_count(
 
     part = jnp.sum((recv & (st_new == member)).astype(jnp.int32), axis=0)[None]
     if detect_stats:
-        fresh = fail if fail is not None else (st == failed) & (age == 0)
+        # recv-masked even though today's writers make it redundant (the
+        # detector is the only writer of FAILED/age=0 and it only fires on
+        # live receivers): a future writer of FAILED/age=0 — matrix events
+        # or remove_broadcast on this path — must not inflate the stats
+        # (ADVICE r3)
+        fresh = (fail if fail is not None else (st == failed) & (age == 0)) & recv
         ndet_part = jnp.sum(fresh.astype(jnp.int32), axis=0)[None]
         rows = lax.broadcasted_iota(jnp.int32, st.shape, 0) + i * r_blk
         fobs_part = jnp.min(jnp.where(fresh, rows, n), axis=0)[None]
@@ -1026,12 +1031,19 @@ def arc_merge_update_blocked(
 #             exactly once
 #
 # Per-round HBM traffic drops from ~17 N^2 bytes (tick fusion 6 + view
-# fusion 3 + kernel 7 + count pass 1) to ~9 N^2 (view build reads 3,
-# receiver sweep reads 3 + writes 3).  The tick is recomputed twice per
-# element (view build + receiver sweep) — duplicated VPU, two fewer HBM
-# round trips, the same trade _round_core_fused makes in XLA.
+# fusion 3 + kernel 7 + count pass 1) to ~6 N^2: the kernel's wire is TWO
+# byte lanes per entry — hb int8 plus age(6b)|status(2b) PACKED into one
+# biased byte (AGE_CLAMP = 63 makes age fit; config rejects deeper
+# thresholds) — so the view build reads 2, the receiver sweep reads 2 and
+# writes 2.  The round is ambient-bandwidth-bound (the shared chip
+# delivers a fraction of its spec sheet), so a byte saved is time saved
+# 1:1; the unpack (one add, one shift, one mask) rides the VPU's idle
+# lanes.  The tick is recomputed twice per element (view build + receiver
+# sweep) — duplicated VPU, two fewer HBM round trips, the same trade
+# _round_core_fused makes in XLA (a tick-stub experiment measured the
+# duplicated compute at ~0 ms: it hides entirely under the DMA waits).
 #
-# All arithmetic is WIDENED int32 over the stored int8 lanes, with
+# All arithmetic is WIDENED int32 over the packed int8 lanes, with
 # per-subject int32 vectors (sa/sb/g) carrying the rebase state — the
 # unclipped formulation the narrow-dtype XLA paths are proven equivalent
 # to (core/rounds.py _membership_update / _gossip_view / _tick).
@@ -1040,6 +1052,23 @@ def arc_merge_update_blocked(
 # rows per view-build chunk: int32 temporaries over a (chunk, cs, LANE)
 # block are what bounds VMEM here (16 MB per temporary at 1024 rows)
 RR_CHUNK = 256
+
+
+def pack_age_status(age: jax.Array, status: jax.Array) -> jax.Array:
+    """age(6b)|status(2b) into one biased int8: (age << 2 | status) - 128.
+
+    The resident-round kernel's lane format — valid for age <= AGE_CLAMP
+    (63) and status in {0, 1, 2}.  Biasing keeps the packed value inside
+    signed int8 so the lane shares the hb lanes' dtype and tiling.
+    """
+    p = (age.astype(jnp.int32) << 2) | status.astype(jnp.int32)
+    return (p - 128).astype(jnp.int8)
+
+
+def unpack_age_status(asl: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Inverse of :func:`pack_age_status`; returns int32 (age, status)."""
+    p = asl.astype(jnp.int32) + 128
+    return p >> 2, p & 3
 
 
 def _rr_tick_block(hb, age, st, act_r, ref_r, eye, g, hb_min, t_fail,
@@ -1076,47 +1105,54 @@ def _rr_kernel(
 
     def kernel(
         edges_ref, flags_all,
-        sa_ref, sb_ref, g_ref, hb_any, age_any, status_any,
-        hb_out, age_out, status_out, cnt_out, ndet_out, fobs_out, rcnt_out,
-        stripe, best_scratch, lane_scratch, lane_sems,
+        sa_ref, sb_ref, g_ref, hb_any, as_any,
+        hb_out, as_out, cnt_out, ndet_out, fobs_out, rcnt_out,
+        stripe, best_scratch, vbuf, vsems, rbuf, rsems,
         *arc_scratch,
     ):
         # The raw lanes arrive ONCE, in ANY memory space; every VMEM
-        # crossing is an explicit software-pipelined DMA into the shared
-        # (2, 3, r_blk, cs, LANE) ping-pong — BlockSpec-fetched lane
-        # inputs measured ~3 ms/round slower here (Mosaic serializes its
-        # own block copies against the kernel's manual DMAs, the same
+        # crossing is an explicit software-pipelined DMA — BlockSpec-fetched
+        # lane inputs measured ~3 ms/round slower here (Mosaic serializes
+        # its own block copies against the kernel's manual DMAs, the same
         # effect the fused gather kernel hit in round 3), and passing the
-        # lanes twice (BlockSpec + ANY) made XLA materialize three
-        # 0.8 ms defensive copies per round.
+        # lanes twice (BlockSpec + ANY) made XLA materialize three 0.8 ms
+        # defensive copies per round.  The view-build chunks (vbuf) and the
+        # receiver blocks (rbuf) ping-pong through SEPARATE buffers so the
+        # first receiver block's DMA can be issued before the stripe's view
+        # build and hide entirely under it (a shared buffer forced an
+        # unpipelined reload after every view build).
         j = pl.program_id(0)
         i = pl.program_id(1)
         sa = sa_ref[0][None].astype(jnp.int32)
         sb = sb_ref[0][None].astype(jnp.int32)
         g = g_ref[0][None].astype(jnp.int32)
 
-        def issue(blk_rows, rows_per, slot):
+        def issue_into(buf, sems, blk_rows, rows_per, slot):
             rows = pl.ds(blk_rows * rows_per, rows_per)
-            for li, lane in enumerate((hb_any, age_any, status_any)):
+            for li, lane in enumerate((hb_any, as_any)):
                 pltpu.make_async_copy(
-                    lane.at[j, rows],
-                    lane_scratch.at[slot, li, pl.ds(0, rows_per)],
-                    lane_sems.at[slot, li],
+                    lane.at[j, rows], buf.at[slot, li], sems.at[slot, li]
                 ).start()
 
-        def wait(rows_per, slot):
-            for li, lane in enumerate((hb_any, age_any, status_any)):
+        def wait_on(buf, sems, rows_per, slot):
+            for li, lane in enumerate((hb_any, as_any)):
                 pltpu.make_async_copy(
-                    lane.at[j, pl.ds(0, rows_per)],
-                    lane_scratch.at[slot, li, pl.ds(0, rows_per)],
-                    lane_sems.at[slot, li],
+                    lane.at[j, pl.ds(0, rows_per)], buf.at[slot, li],
+                    sems.at[slot, li],
                 ).wait()
+
+        issue = functools.partial(issue_into, vbuf, vsems)
+        wait = functools.partial(wait_on, vbuf, vsems)
+        rissue = functools.partial(issue_into, rbuf, rsems)
+        rwait = functools.partial(wait_on, rbuf, rsems)
 
         # --- i == 0: build this stripe's gossip view in VMEM ------------
         # chunked double-buffered DMAs over the raw lanes; the tick is
         # recomputed on each chunk so the view reflects post-tick state.
         @pl.when(i == 0)
         def _():
+            # this stripe's first receiver block rides under the view build
+            rissue(0, r_blk, 0)
             issue(0, chunk, 0)
 
             def body(c, _):
@@ -1127,9 +1163,9 @@ def _rr_kernel(
                     issue(c + 1, chunk, lax.rem(c + 1, 2))
 
                 wait(chunk, slot)
-                hb = lane_scratch[slot, 0, pl.ds(0, chunk)].astype(jnp.int32)
-                age = lane_scratch[slot, 1, pl.ds(0, chunk)].astype(jnp.int32)
-                st = lane_scratch[slot, 2, pl.ds(0, chunk)].astype(jnp.int32)
+                hb = vbuf[slot, 0].astype(jnp.int32)
+                p = vbuf[slot, 1].astype(jnp.int32) + 128
+                age, st = p >> 2, p & 3
                 fl = flags_all[pl.ds(c * chunk, chunk)].astype(jnp.int32)
                 fl = fl.reshape(chunk, 1, LANE)
                 act_r = (fl & 1) != 0
@@ -1167,23 +1203,23 @@ def _rr_kernel(
                 bufa, bufb, halo = arc_scratch
                 _windowmax_inplace(stripe, bufa, bufb, halo, n_fanout,
                                    n // ARC_CHUNK)
-            # the view build used both ping-pong slots; reload this
-            # step's receiver block (the one unpipelined load per stripe)
-            issue(0, r_blk, 0)
 
         # prefetch the NEXT receiver block while this one is gathered and
         # merged; the last block of a stripe prefetches nothing (the next
-        # stripe's view build will clobber the buffers anyway)
+        # stripe's i == 0 step issues its own block 0 under the view build)
         slot = lax.rem(i, 2)
 
         @pl.when(i + 1 < nblocks)
         def _():
-            issue(i + 1, r_blk, lax.rem(i + 1, 2))
+            rissue(i + 1, r_blk, lax.rem(i + 1, 2))
 
         # --- every i: merge rows from the resident stripe ---------------
+        # best accumulates widened (no narrow-int vector max on v5e) but
+        # stores int8 — view values fit, and the narrower scratch frees
+        # VMEM for bigger row blocks
         if arc:
             def gather(r, _):
-                best_scratch[r] = stripe[edges_ref[r, 0]].astype(jnp.int32)
+                best_scratch[r] = stripe[edges_ref[r, 0]]
                 return 0
         else:
             def gather(r, _):
@@ -1191,16 +1227,16 @@ def _rr_kernel(
                 for f in range(1, n_fanout):
                     acc = jnp.maximum(acc,
                                       stripe[edges_ref[r, f]].astype(jnp.int32))
-                best_scratch[r] = acc
+                best_scratch[r] = acc.astype(best_scratch.dtype)
                 return 0
 
         lax.fori_loop(0, r_blk, gather, 0, unroll=False)
-        wait(r_blk, slot)
+        rwait(r_blk, slot)
 
         # --- tick recompute + merge epilogue on the receiver block ------
-        hb = lane_scratch[slot, 0, pl.ds(0, r_blk)].astype(jnp.int32)
-        age = lane_scratch[slot, 1, pl.ds(0, r_blk)].astype(jnp.int32)
-        st = lane_scratch[slot, 2, pl.ds(0, r_blk)].astype(jnp.int32)
+        hb = rbuf[slot, 0].astype(jnp.int32)
+        p = rbuf[slot, 1].astype(jnp.int32) + 128
+        age, st = p >> 2, p & 3
         fl = flags_all[pl.ds(i * r_blk, r_blk)].astype(jnp.int32)
         fl = fl.reshape(r_blk, 1, LANE)
         act_r = (fl & 1) != 0
@@ -1216,7 +1252,7 @@ def _rr_kernel(
             t_fail, t_cooldown, member, failed, unknown,
         )
 
-        best = best_scratch[...]
+        best = best_scratch[...].astype(jnp.int32)
         any_m = best >= 0
         advance = recv & any_m & (st == member) & (best > hb - sa)
         add = recv & any_m & (st == unknown)
@@ -1225,9 +1261,8 @@ def _rr_kernel(
                           hb_min, -hb_min - 1)
         hb_out[0] = new_hb.astype(hb_out.dtype)
         new_age = jnp.minimum(jnp.where(upd, 0, age) + 1, age_clamp)
-        age_out[0] = new_age.astype(age_out.dtype)
         st_new = jnp.where(add, member, st)
-        status_out[0] = st_new.astype(status_out.dtype)
+        as_out[0] = (((new_age << 2) | st_new) - 128).astype(as_out.dtype)
 
         # per-subject reductions, accumulated across consecutive i steps
         cnt_part = jnp.sum((recv & (st_new == member)).astype(jnp.int32),
@@ -1269,8 +1304,7 @@ def _rr_kernel(
 def resident_round_blocked(
     edges: jax.Array,
     hb: jax.Array,
-    age: jax.Array,
-    status: jax.Array,
+    asl: jax.Array,
     flags: jax.Array,
     sa: jax.Array,
     sb: jax.Array,
@@ -1290,10 +1324,13 @@ def resident_round_blocked(
 ) -> tuple[jax.Array, ...]:
     """One whole gossip round (lean crash-only fault model) in one kernel.
 
-    Contract (all lanes int8, STRIPE-MAJOR ``[nc, N, cs, LANE]`` layout —
-    ``blocked_shape`` transposed so each stripe's rows are contiguous —
-    PRE-tick):
+    Contract (two int8 lanes per entry, STRIPE-MAJOR ``[nc, N, cs, LANE]``
+    layout — ``blocked_shape`` transposed so each stripe's rows are
+    contiguous — PRE-tick):
 
+    * ``hb`` int8; ``asl`` the :func:`pack_age_status` byte — the kernel's
+      whole HBM wire is 2 B/entry, which is what bounds the round on the
+      bandwidth-shared chip.
     * ``edges`` int32 [N, F] in-edge sender ids (NOT remapped for dead
       receivers — the epilogue gates on the alive bit instead).  For the
       ``random_arc`` topology pass arc BASES int32 [N] plus ``fanout=F``:
@@ -1307,7 +1344,7 @@ def resident_round_blocked(
       (new_base - hb_base) and grace threshold (hb_grace - hb_base).
     * statics: the protocol constants; ``window`` is the int8 rebase window.
 
-    Returns (hb', age', status', member_cnt [nc,cs,LANE], n_det, first_obs,
+    Returns (hb', asl', member_cnt [nc,cs,LANE], n_det, first_obs,
     recv_cnt [N, nc*LANE] — per-receiver per-stripe partial member counts,
     lane-replicated: ``recv_cnt.reshape(n, nc, LANE)[:, :, 0].sum(1)`` is
     the post-merge membership-list size of each receiver, which feeds the
@@ -1347,7 +1384,6 @@ def resident_round_blocked(
     subj_spec = pl.BlockSpec(
         (1, cs, LANE), lambda j, i: (j, 0, 0), memory_space=pltpu.VMEM
     )
-    buf_rows = max(ch, r_blk)
     ew = 1 if arc else fanout
     ext = ARC_CHUNK + fanout - 1
     arc_scratch = [
@@ -1362,11 +1398,11 @@ def resident_round_blocked(
         # in-place lane update: safe because every [row-block, stripe]
         # region's reads (the i==0 view-build chunk pass and the one-step-
         # early receiver prefetch) strictly precede its own step's output
-        # write, and stripes never overlap.  Kills the three defensive
-        # copies XLA otherwise inserts for custom-call operands that are
-        # also scan carries (~2.5 ms/round) and drops three [N, N] lane
-        # buffers from peak HBM
-        input_output_aliases={5: 0, 6: 1, 7: 2},
+        # write, and stripes never overlap.  Kills the defensive copies XLA
+        # otherwise inserts for custom-call operands that are also scan
+        # carries (~2.5 ms/round) and drops two [N, N] lane buffers from
+        # peak HBM
+        input_output_aliases={5: 0, 6: 1},
         in_specs=[
             pl.BlockSpec((r_blk, ew), lambda j, i: (i, 0),
                          memory_space=pltpu.SMEM),
@@ -1375,18 +1411,16 @@ def resident_round_blocked(
             subj_spec,  # sa
             subj_spec,  # sb
             subj_spec,  # g
-            pl.BlockSpec(memory_space=pl.ANY),   # hb     (manual DMAs)
-            pl.BlockSpec(memory_space=pl.ANY),   # age
-            pl.BlockSpec(memory_space=pl.ANY),   # status
+            pl.BlockSpec(memory_space=pl.ANY),   # hb       (manual DMAs)
+            pl.BlockSpec(memory_space=pl.ANY),   # age|status packed
         ],
         out_specs=[
-            lane_blk, lane_blk, lane_blk,
+            lane_blk, lane_blk,
             subj_spec, subj_spec, subj_spec,
             pl.BlockSpec((r_blk, LANE), lambda j, i: (i, j),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((nc, n, cs, LANE), jnp.int8),
             jax.ShapeDtypeStruct((nc, n, cs, LANE), jnp.int8),
             jax.ShapeDtypeStruct((nc, n, cs, LANE), jnp.int8),
             jax.ShapeDtypeStruct((nc, cs, LANE), jnp.int32),
@@ -1396,15 +1430,17 @@ def resident_round_blocked(
         ],
         scratch_shapes=[
             pltpu.VMEM((n, cs, LANE), jnp.int8),          # view stripe
-            pltpu.VMEM((r_blk, cs, LANE), jnp.int32),     # best
-            # shared ping-pong: view-build chunks AND receiver blocks
-            pltpu.VMEM((2, 3, buf_rows, cs, LANE), jnp.int8),
-            pltpu.SemaphoreType.DMA((2, 3)),
+            pltpu.VMEM((r_blk, cs, LANE), jnp.int8),      # best (narrow)
+            # separate ping-pongs: view-build chunks / receiver blocks
+            pltpu.VMEM((2, 2, ch, cs, LANE), jnp.int8),
+            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.VMEM((2, 2, r_blk, cs, LANE), jnp.int8),
+            pltpu.SemaphoreType.DMA((2, 2)),
         ] + arc_scratch,
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=120 * 1024 * 1024),
         interpret=interpret,
-    )(edges, flags, sa, sb, g, hb, age, status)
+    )(edges, flags, sa, sb, g, hb, asl)
     return tuple(out)
 
 

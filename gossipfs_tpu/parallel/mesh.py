@@ -139,6 +139,7 @@ def run_rounds_sharded(
     """
     import jax.numpy as jnp
 
+    from gossipfs_tpu.core import rounds
     from gossipfs_tpu.core.state import RoundEvents
 
     n = config.n
@@ -151,7 +152,9 @@ def run_rounds_sharded(
     # crash_only_events: the caller's static promise that scheduled events
     # carry no leave/join bits — keeps the lean event path (see
     # core.rounds._run_rounds_impl), which matters for peak memory at the
-    # 100k-class capacity points
+    # 100k-class capacity points.  Joins would be silently ignored, so the
+    # promise is enforced while the events are still concrete.
+    rounds.check_crash_only_promise(events, crash_only_events)
     matrix_events = (
         events is not None and not crash_only_events
     ) or rejoin_rate > 0.0

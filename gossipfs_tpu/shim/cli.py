@@ -144,12 +144,21 @@ def dispatch(
             for ev in sim.events:
                 print(ev, file=out)
         elif cmd == "grep":
-            # ``grep [--node <k>] <pattern>``: the explicit flag scopes the
-            # search to node k's own log view (distributed-grep analog);
-            # without it the pattern is searched verbatim, digits included
+            # ``grep [--node <k>] [--] <pattern>``: the explicit flag
+            # scopes the search to node k's own log view (distributed-grep
+            # analog); without it the pattern is searched verbatim, digits
+            # included.  ``--`` ends flag parsing, and a ``--node`` whose
+            # operand is not an int falls back to pattern text, so a
+            # pattern literally starting with "--node" stays greppable
+            # (ADVICE r3)
             node = None
             if len(args) >= 2 and args[0] == "--node":
-                node, args = int(args[1]), args[2:]
+                try:
+                    node, args = int(args[1]), args[2:]
+                except ValueError:
+                    pass
+            if args and args[0] == "--":
+                args = args[1:]
             for entry in sim.log.grep(" ".join(args), node=node):
                 print(entry, file=out)
         else:

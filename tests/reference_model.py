@@ -24,6 +24,8 @@ from __future__ import annotations
 
 import dataclasses
 
+from gossipfs_tpu.config import AGE_CLAMP
+
 UNKNOWN, MEMBER, FAILED = 0, 1, 2
 
 
@@ -189,5 +191,8 @@ class NaiveSim:
         for i in range(n):
             if self.alive[i]:
                 for e in self.tables[i]:
-                    e.age += 1
+                    # saturate like the sim's age lane (state.py: every
+                    # protocol comparison is against a small threshold, so
+                    # the clamp is part of the contract, not an artifact)
+                    e.age = min(e.age + 1, AGE_CLAMP)
         self.round += 1
