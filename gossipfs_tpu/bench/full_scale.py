@@ -53,7 +53,7 @@ def _force_cpu_mesh(n_devices: int) -> None:
         # device threads routinely exceeds the default — this, not memory
         # or wall-clock, is what capped earlier full-scale artifacts at
         # N=32,768.  Raise warn/terminate to 12 h.
-        " --xla_cpu_collective_call_warn_stuck_seconds=43200"
+        " --xla_cpu_collective_call_warn_stuck_timeout_seconds=43200"
         " --xla_cpu_collective_call_terminate_timeout_seconds=43200"
         " --xla_cpu_collective_timeout_seconds=43200"
     ).strip()

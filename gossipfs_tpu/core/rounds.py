@@ -1445,3 +1445,13 @@ def run_rounds_donate(
         state, config, num_rounds, key, events, crash_rate, rejoin_rate,
         churn_ok, mcarry0, crash_only_events,
     )
+
+
+# the guard wrappers keep the jitted functions' introspection surface:
+# callers (and tests) use lower()/AOT, cache-size assertions, and explicit
+# cache clears on these names
+for _wrapper, _jitted in ((run_rounds, _run_rounds_jit),
+                          (run_rounds_donate, _run_rounds_donate_jit)):
+    _wrapper._cache_size = _jitted._cache_size
+    _wrapper.clear_cache = _jitted.clear_cache
+    _wrapper.lower = _jitted.lower

@@ -1,0 +1,209 @@
+"""Cluster launcher for the one-process-per-node deployment.
+
+Spawns ``n`` ``gossipfs_tpu.deploy.node`` OS processes (each with its own
+UDP gossip endpoint, replica store, log file, and gRPC server — the
+reference's per-machine topology, main.go:14-35), then exposes client
+helpers and the kill -9 scenario the deployment exists to demonstrate:
+
+    python -m gossipfs_tpu.deploy.launcher --n 5
+
+prints one JSON document with measured wall-clock times for failure
+detection (the gossip way: the victim vanishes from a SURVIVOR's view),
+re-replication (the replica set heals to full strength on live nodes),
+byte-identical recovery of the file, and a master-kill election.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+from gossipfs_tpu.shim.client import ShimClient
+
+
+def _free_port_base(span: int) -> int:
+    """A base port with ``span`` free ports above it (probe-and-hope; the
+    cluster binds within milliseconds of the probe)."""
+    for _ in range(64):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        base = s.getsockname()[1]
+        s.close()
+        if base + 2 * span < 65000:
+            return base
+    raise RuntimeError("no free port window")
+
+
+class Cluster:
+    """n node processes + per-node ShimClients."""
+
+    def __init__(self, n: int, period: float = 0.1, root: str | None = None):
+        self.n = n
+        self.period = period
+        self.root = root or tempfile.mkdtemp(prefix="gossipfs_deploy_")
+        base = _free_port_base(2 * n + 16)
+        self.udp_base = base
+        self.rpc_base = base + n + 8
+        self.procs: dict[int, subprocess.Popen] = {}
+        self._clients: dict[int, ShimClient] = {}
+
+    def client(self, idx: int) -> ShimClient:
+        c = self._clients.get(idx)
+        if c is None:
+            c = self._clients[idx] = ShimClient(
+                f"127.0.0.1:{self.rpc_base + idx}", timeout=5.0
+            )
+        return c
+
+    def spawn(self, idx: int) -> None:
+        env = dict(os.environ)
+        # the node imports no jax; scrub the TPU tunnel vars anyway so a
+        # transitive import can never dial the chip from N processes
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        self.procs[idx] = subprocess.Popen(
+            [sys.executable, "-m", "gossipfs_tpu.deploy.node",
+             "--idx", str(idx), "--n", str(self.n),
+             "--udp-base", str(self.udp_base),
+             "--rpc-base", str(self.rpc_base),
+             "--dir", self.root, "--period", str(self.period)],
+            env=env,
+        )
+
+    def start(self, timeout: float = 30.0) -> None:
+        self.spawn(0)  # introducer first (reference SPOF, slave.go:22)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                self.client(0).lsm(0)
+                break
+            except Exception:
+                time.sleep(0.1)
+        else:
+            raise RuntimeError("introducer did not come up")
+        for i in range(1, self.n):
+            self.spawn(i)
+        # wait until every node's own view holds the full cohort
+        while time.monotonic() < deadline:
+            try:
+                views = [set(self.client(i).lsm(i)) for i in range(self.n)]
+                if all(v == set(range(self.n)) for v in views):
+                    return
+            except Exception:
+                pass
+            time.sleep(0.1)
+        raise RuntimeError("cluster did not converge")
+
+    def kill9(self, idx: int) -> None:
+        self.procs[idx].send_signal(signal.SIGKILL)
+        self.procs[idx].wait()
+
+    def wait_detected(self, victim: int, observer: int,
+                      timeout: float = 30.0) -> float:
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout:
+            if victim not in self.client(observer).lsm(observer):
+                return time.monotonic() - t0
+            time.sleep(self.period / 4)
+        raise TimeoutError(f"{observer} never dropped {victim}")
+
+    def wait_repaired(self, file: str, via: int, expect: int,
+                      timeout: float = 60.0) -> float:
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout:
+            live = set(self.client(via).lsm(via))
+            reps = set(self.client(via).ls(file))
+            if len(reps) >= expect and reps <= live:
+                return time.monotonic() - t0
+            time.sleep(self.period / 2)
+        raise TimeoutError(f"{file} never healed")
+
+    def wait_new_master(self, via: int, old: int, timeout: float = 60.0) -> float:
+        """Wait until a put through ``via`` succeeds under a new master."""
+        t0 = time.monotonic()
+        probe = b"election-probe"
+        while time.monotonic() - t0 < timeout:
+            try:
+                if self.client(via).put("___probe.txt", probe, confirm=True):
+                    return time.monotonic() - t0
+            except Exception:
+                pass
+            time.sleep(self.period)
+        raise TimeoutError("no new master answered a put")
+
+    def stop(self) -> None:
+        for c in self._clients.values():
+            c.close()
+        for p in self.procs.values():
+            if p.poll() is None:
+                p.terminate()
+        for p in self.procs.values():
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def scenario(n: int = 5, period: float = 0.1) -> dict:
+    """The deployment's reason to exist, measured end to end."""
+    cluster = Cluster(n, period=period)
+    out: dict = {"n": n, "period_s": period}
+    try:
+        t0 = time.monotonic()
+        cluster.start()
+        out["startup_convergence_s"] = round(time.monotonic() - t0, 3)
+
+        data = os.urandom(256 * 1024)  # 256 KB payload
+        assert cluster.client(1).put("wiki.txt", data)
+        holders = cluster.client(1).ls("wiki.txt")
+        out["put_replicas"] = holders
+
+        # kill -9 a NON-master replica holder; watch from a survivor
+        victim = next(h for h in holders if h != 0)
+        observer = next(i for i in range(n) if i != victim and i != 0)
+        cluster.kill9(victim)
+        out["victim"] = victim
+        out["detect_s"] = round(
+            cluster.wait_detected(victim, observer), 3
+        )
+        out["repair_s"] = round(
+            cluster.wait_repaired("wiki.txt", observer, min(4, n - 1)), 3
+        )
+        got = cluster.client(observer).get("wiki.txt")
+        out["bytes_identical_after_repair"] = got == data
+
+        # kill -9 the master; the lowest live node must take over
+        cluster.kill9(0)
+        out["election_s"] = round(cluster.wait_new_master(observer, 0), 3)
+        # distributed grep: each node serves only its own log; fan out
+        hits = []
+        for i in range(n):
+            if i in (0, victim):
+                continue
+            hits += cluster.client(i).call(
+                "Grep", pattern="became master"
+            ).get("lines") or []
+        out["election_logged"] = bool(hits)
+        return out
+    finally:
+        cluster.stop()
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--n", type=int, default=5)
+    p.add_argument("--period", type=float, default=0.1)
+    args = p.parse_args(argv)
+    print(json.dumps(scenario(args.n, args.period)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,547 @@
+"""One-process-per-node deployment: the reference's REAL topology.
+
+The reference runs every cluster member as its own OS process carrying all
+layers — UDP gossip membership, the SDFS replica store, and a per-node RPC
+server (reference: main.go:14-35, server/server.go:179-199).  The embedded
+shim (shim/service.py) keeps that RPC surface but hosts the whole cluster
+in one process; THIS module is the deployment where each node is its own
+``python -m gossipfs_tpu.deploy.node`` process and every repair, election,
+and confirmation crosses a real process boundary:
+
+  * membership: the real-socket gossip node (detector/udp.py ``UdpNode``
+    — reference wire constants, ring push, timeout detection, REMOVE
+    broadcast) auto-ticking on its own asyncio loop.  kill -9 the process
+    and the others detect it the protocol way.
+  * files: a private ``sdfs/store.LocalStore`` rooted in the node's own
+    directory; replica bytes move between processes as ``PutFileData``
+    gRPC messages (the reference moves them via scp, slave.go:680-698 —
+    same sanctioned substitution the embedded shim documents).
+  * control plane: each node serves the gossipfs.proto surface on its own
+    port.  The master role (initially node 0, reference master/master.go)
+    plans placement and drives re-replication ``RECOVERY_DELAY`` periods
+    after a holder leaves its own membership view; when the master dies,
+    the lowest live node campaigns with per-node ``Vote`` RPCs and
+    ``AssignNewMaster`` returns each node's store listing for the metadata
+    rebuild (reference: slave.go:930-1051).
+  * logs: every node appends to ``<dir>/node<i>.log``; the ``Grep`` RPC
+    serves the node's own log — the reference's distributed grep, with the
+    querier fanning out to live nodes.
+
+No jax anywhere on this path: a node process starts in milliseconds and
+never touches the TPU tunnel.
+
+    python -m gossipfs_tpu.deploy.node --idx 3 --n 5 \
+        --udp-base 19000 --rpc-base 19100 --dir /tmp/cluster
+
+``deploy/launcher.py`` spawns a whole cluster and runs the kill -9
+detection/repair/election scenario end to end.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import base64
+import json
+import pathlib
+import threading
+import time
+
+import grpc
+
+from gossipfs_tpu.detector.udp import CMD_SEP, UdpNode
+from gossipfs_tpu.sdfs.store import LocalStore
+from gossipfs_tpu.sdfs.types import (
+    RECOVERY_DELAY,          # periods after detection before re-replication
+    REPLICATION_FACTOR,
+    WRITE_CONFLICT_WINDOW,   # seconds (1 reference round == 1 s)
+)
+from gossipfs_tpu.shim import wire
+from gossipfs_tpu.shim.client import ShimClient
+from gossipfs_tpu.shim.wire import SERVICE
+
+
+class _Env:
+    """The small interface UdpNode needs from its host (duck-typed for the
+    in-process UdpCluster in detector/udp.py)."""
+
+    def __init__(self, daemon: "NodeDaemon", period: float, t_fail: int,
+                 t_cooldown: int, min_group: int):
+        self.period = period
+        self.t_fail = t_fail
+        self.t_cooldown = t_cooldown
+        self.min_group = min_group
+        self.fresh_cooldown = True
+        self._daemon = daemon
+
+    def record_detection(self, observer: int, subject_addr: str) -> None:
+        self._daemon.on_detection(subject_addr)
+
+
+class NodeDaemon:
+    """One cluster member: gossip + store + RPC server, all in-process."""
+
+    def __init__(self, idx: int, n: int, udp_base: int, rpc_base: int,
+                 root: str, period: float = 0.1, t_fail: int = 5,
+                 t_cooldown: int = 5, min_group: int = 4,
+                 auto_confirm: bool = True, introducer: int = 0):
+        self.idx = idx
+        self.n = n
+        self.udp_base = udp_base
+        self.rpc_base = rpc_base
+        self.period = period
+        self.auto_confirm = auto_confirm
+        self.introducer = introducer
+        self.master_id = 0  # initial master, reference main.go
+        root_p = pathlib.Path(root)
+        self.store = LocalStore(root_p / f"node{idx}")
+        self.log_path = root_p / f"node{idx}.log"
+        self._env = _Env(self, period, t_fail, t_cooldown, min_group)
+        self.udp = UdpNode(self._env, idx, udp_base + idx)
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._lock = threading.RLock()
+        # master state (meaningful only while self.idx == self.master_id)
+        self.meta: dict[str, tuple[int, list[int]]] = {}  # file -> (version, holders)
+        self.last_put: dict[str, tuple[float, str]] = {}  # file -> (time, callback)
+        self._lost_at: dict[int, float] = {}              # node -> detect time
+        self._clients: dict[int, ShimClient] = {}
+        self._server: grpc.Server | None = None
+        self._stop = threading.Event()
+
+    # -- plumbing ----------------------------------------------------------
+
+    def log(self, kind: str, message: str, **fields) -> None:
+        entry = {"ts": round(time.time(), 3), "node": self.idx,
+                 "kind": kind, "message": message, **fields}
+        with open(self.log_path, "a") as f:
+            f.write(json.dumps(entry) + "\n")
+
+    def client(self, idx: int) -> ShimClient:
+        c = self._clients.get(idx)
+        if c is None:
+            c = self._clients[idx] = ShimClient(
+                f"127.0.0.1:{self.rpc_base + idx}", timeout=3.0
+            )
+        return c
+
+    def view(self) -> list[int]:
+        """Node indices in this node's own membership table."""
+        out = []
+        for addr in list(self.udp.members):
+            port = int(addr.rsplit(":", 1)[1])
+            out.append(port - self.udp_base)
+        return sorted(out)
+
+    def on_detection(self, subject_addr: str) -> None:
+        port = int(subject_addr.rsplit(":", 1)[1])
+        subject = port - self.udp_base
+        self._lost_at.setdefault(subject, time.monotonic())
+        self.log("detect", f"detected failure of node {subject}",
+                 subject=subject)
+
+    # -- master duties -----------------------------------------------------
+
+    def _place(self, file: str, live: list[int]) -> list[int]:
+        """Hash-ringed placement over the master's live view (reference
+        master/master.go:104-131 hashes onto the member ring).  crc32, not
+        ``hash()``: Python string hashing is salted per process, and the
+        master role migrates between processes on election."""
+        import zlib
+
+        if not live:
+            return []
+        start = zlib.crc32(file.encode()) % len(live)
+        return [live[(start + k) % len(live)] for k in
+                range(min(REPLICATION_FACTOR, len(live)))]
+
+    def _master_repair(self) -> None:
+        now = time.monotonic()
+        live = set(self.view())
+        # a holder can leave the master's view through a peer's REMOVE
+        # broadcast, which never passes through this node's own detector —
+        # the view, not record_detection, is the authority on loss
+        with self._lock:
+            holding = {h for _, hs in self.meta.values() for h in hs}
+        for h in holding - live:
+            self._lost_at.setdefault(h, now)
+        due = {s for s, t0 in self._lost_at.items()
+               if now - t0 >= RECOVERY_DELAY * self.period and s not in live}
+        if not due:
+            return
+        retry = False
+        with self._lock:
+            for file, (version, holders) in list(self.meta.items()):
+                dead = [h for h in holders if h in due]
+                if not dead:
+                    continue
+                survivors = [h for h in holders if h in live]
+                if not survivors:
+                    self.log("lost", f"no live replica of {file}", file=file)
+                    continue
+                candidates = [x for x in sorted(live)
+                              if x not in holders]
+                placed, failed = [], False
+                for src, tgt in zip(survivors * len(dead),
+                                    candidates[:len(dead)]):
+                    try:
+                        self.client(src).call(
+                            "RemoteReput", source=src, target=tgt,
+                            file=file, version=version,
+                        )
+                        placed.append(tgt)
+                        self.log("re_replicate",
+                                 f"Re-replicated {file} v{version} from "
+                                 f"{src} to [{tgt}]", file=file, source=src,
+                                 target=tgt)
+                    except grpc.RpcError as e:
+                        failed = True
+                        self.log("repair_error", str(e.code()), file=file)
+                if failed:
+                    # keep the dead holders listed so the next control
+                    # tick re-detects the deficit and retries; only the
+                    # successfully-pushed targets become holders
+                    retry = True
+                    self.meta[file] = (version, holders + placed)
+                else:
+                    self.meta[file] = (
+                        version, [h for h in holders if h not in due] + placed
+                    )
+        if not retry:
+            for s in due:
+                self._lost_at.pop(s, None)
+
+    def _maybe_campaign(self) -> None:
+        """Lowest live node runs the distributed revote when the master is
+        gone from its own view (reference slave.go:930-1051)."""
+        live = self.view()
+        if self.master_id in live or not live or live[0] != self.idx:
+            return
+        votes = 1  # self
+        for peer in live:
+            if peer == self.idx:
+                continue
+            try:
+                r = self.client(peer).call(
+                    "Vote", candidate=self.idx, voter=peer
+                )
+                votes += 1 if r.get("elected") else 0
+            except grpc.RpcError:
+                pass
+        if votes <= len(live) // 2:
+            self.log("election_stall", f"{votes}/{len(live)} votes")
+            return
+        # won.  Rebuild the metadata from per-node store listings BEFORE
+        # announcing: each AssignNewMaster flips that peer's master pointer
+        # immediately, so a put raced between announcement and rebuild
+        # would land in a meta dict the rebuild then replaces (observed as
+        # a lost file).  Gather -> install atomically -> announce.
+        per_holder: dict[str, list[tuple[int, int]]] = {}  # file -> [(peer, v)]
+        for peer in live:
+            listing: dict[str, int] = {}
+            if peer == self.idx:
+                listing = self.store.listing()
+            else:
+                try:
+                    r = self.client(peer).call("Store", node=peer)
+                    listing = dict(r.get("listing") or {})
+                except grpc.RpcError:
+                    continue
+            for file, version in listing.items():
+                per_holder.setdefault(file, []).append((peer, int(version)))
+        with self._lock:
+            self.master_id = self.idx
+            # keep only the max-version holders per file (stale replicas
+            # are repaired by read-repair / the next put, not trusted here)
+            self.meta = {}
+            for file, pairs in per_holder.items():
+                v = max(ver for _, ver in pairs)
+                self.meta[file] = (v, [p for p, ver in pairs if ver == v])
+        for peer in live:
+            if peer == self.idx:
+                continue
+            try:
+                # the reply's listing (reference slave.go:1010-1051 shape)
+                # is redundant here — the rebuild already ran
+                self.client(peer).call(
+                    "AssignNewMaster", node=peer, master=self.idx
+                )
+            except grpc.RpcError:
+                pass
+        self.log("elected", f"node {self.idx} became master with "
+                 f"{votes}/{len(live)} votes", votes=votes)
+
+    def _control_loop(self) -> None:
+        while not self._stop.wait(self.period):
+            try:
+                if self.master_id == self.idx:
+                    self._master_repair()
+                else:
+                    self._maybe_campaign()
+            except Exception as e:  # keep the daemon alive; log the fault
+                self.log("control_error", repr(e))
+
+    # -- RPC handlers ------------------------------------------------------
+
+    def Put(self, req, ctx):
+        file = req["file"]
+        data = base64.b64decode(req.get("data_b64", ""))
+        info = self.client(self.master_id).call(
+            "GetPutInfo", file=file, confirm=bool(req.get("confirm")),
+            callback=f"127.0.0.1:{self.rpc_base + self.idx}",
+        )
+        if not info.get("ok"):
+            return {"ok": False}
+        version = int(info.get("version", 1))
+        payload = base64.b64encode(data).decode()
+        for replica in info.get("replicas") or []:
+            self.client(int(replica)).call(
+                "PutFileData", node=int(replica), file=file,
+                version=version, data_b64=payload,
+            )
+        self.log("put", f"put {file} v{version}", file=file)
+        return {"ok": True}
+
+    def GetPutInfo(self, req, ctx):
+        file = req["file"]
+        now = time.time()
+        with self._lock:
+            prev = self.last_put.get(file)
+        conflict = prev is not None and now - prev[0] < WRITE_CONFLICT_WINDOW
+        if conflict and not req.get("confirm"):
+            # ask the REQUESTER to confirm the overwrite
+            # (server.go:155-177); its own policy answers.  The RPC runs
+            # with no lock held — a dead/hung requester must not stall
+            # the master's repair loop or other writers for its timeout
+            cb = req.get("callback") or ""
+            ok = False
+            if cb:
+                try:
+                    c = ShimClient(cb, timeout=5.0)
+                    ok = bool(c.call("AskForConfirmation",
+                                     file=file).get("confirm"))
+                    c.close()
+                except grpc.RpcError:
+                    ok = False
+            if not ok:
+                return {"ok": False, "conflict": True}
+        with self._lock:
+            version, holders = self.meta.get(file, (0, []))
+            live = self.view()
+            replicas = holders if holders else self._place(file, live)
+            replicas = [r for r in replicas if r in live] or \
+                self._place(file, live)
+            self.meta[file] = (version + 1, list(replicas))
+            self.last_put[file] = (now, req.get("callback") or "")
+        return {"ok": True, "conflict": conflict,
+                "replicas": list(replicas), "version": version + 1}
+
+    def PutFileData(self, req, ctx):
+        data = base64.b64decode(req.get("data_b64", ""))
+        self.store.put(req["file"], data, int(req.get("version", 1)))
+        return {"ok": True}
+
+    def GetFileData(self, req, ctx):
+        data = self.store.get(req["file"])
+        if data is None:
+            return {"local_version": -1}
+        return {"local_version": self.store.version(req["file"]),
+                "data_b64": base64.b64encode(data).decode()}
+
+    def GetFileInfo(self, req, ctx):
+        with self._lock:
+            version, holders = self.meta.get(req["file"], (-1, []))
+        return {"replicas": list(holders), "version": version}
+
+    def Get(self, req, ctx):
+        info = self.client(self.master_id).call("GetFileInfo",
+                                                file=req["file"])
+        want = int(info.get("version", -1))
+        live = set(self.view())
+        for holder in info.get("replicas") or []:
+            if int(holder) not in live:
+                continue
+            try:
+                r = self.client(int(holder)).call(
+                    "GetFileData", node=int(holder), file=req["file"]
+                )
+            except grpc.RpcError:
+                continue
+            # a replica that missed the latest write (failed push, repair
+            # sourced from a stale holder) must not serve old bytes as
+            # current
+            if int(r.get("local_version", -1)) >= want >= 0:
+                return {"found": True, "data_b64": r.get("data_b64", "")}
+        return {"found": False}
+
+    def GetDeleteInfo(self, req, ctx):
+        with self._lock:
+            _, holders = self.meta.get(req["file"], (0, []))
+            self.meta.pop(req["file"], None)
+            self.last_put.pop(req["file"], None)
+        return {"old_replicas": list(holders)}
+
+    def DeleteFileData(self, req, ctx):
+        self.store.delete(req["file"])
+        return {"ok": True}
+
+    def Delete(self, req, ctx):
+        info = self.client(self.master_id).call("GetDeleteInfo",
+                                                file=req["file"])
+        for holder in info.get("old_replicas") or []:
+            try:
+                self.client(int(holder)).call(
+                    "DeleteFileData", node=int(holder), file=req["file"]
+                )
+            except grpc.RpcError:
+                pass
+        return {"ok": True}
+
+    def Ls(self, req, ctx):
+        info = self.client(self.master_id).call("GetFileInfo",
+                                                file=req["file"])
+        return {"replicas": info.get("replicas") or []}
+
+    def Store(self, req, ctx):
+        return {"listing": self.store.listing()}
+
+    def RemoteReput(self, req, ctx):
+        """Master -> surviving holder: push the file to the new target."""
+        file, target = req["file"], int(req["target"])
+        data = self.store.get(file)
+        if data is None:
+            return {"ok": False, "error": "no local copy"}
+        self.client(target).call(
+            "PutFileData", node=target, file=file,
+            version=int(req.get("version", 1)),
+            data_b64=base64.b64encode(data).decode(),
+        )
+        self.log("reput", f"pushed {file} to {target}", file=file,
+                 target=target)
+        return {"ok": True}
+
+    def Vote(self, req, ctx):
+        """Grant iff the candidate is the lowest node in MY live view."""
+        live = self.view()
+        grant = bool(live) and int(req["candidate"]) == live[0]
+        return {"elected": grant, "votes": 1 if grant else 0}
+
+    def AssignNewMaster(self, req, ctx):
+        with self._lock:
+            self.master_id = int(req["master"])
+        self.log("new_master", f"master is now {self.master_id}",
+                 master=self.master_id)
+        return {"listing": self.store.listing()}
+
+    def AskForConfirmation(self, req, ctx):
+        return {"confirm": self.auto_confirm}
+
+    def UpdateFileVersion(self, req, ctx):
+        with self._lock:
+            v, holders = self.meta.get(req["file"], (0, []))
+            self.meta[req["file"]] = (int(req["version"]), holders)
+        return {"ok": True}
+
+    def Lsm(self, req, ctx):
+        return {"members": self.view()}
+
+    def AliveNodes(self, req, ctx):
+        return {"nodes": self.view()}
+
+    def Grep(self, req, ctx):
+        """Serve THIS node's own log (reference: each machine greps its own
+        Machine.log, logger/logger.go:28-44); the querier fans out."""
+        import re
+        pat = re.compile(req.get("pattern", ""))
+        lines = []
+        if self.log_path.exists():
+            for line in self.log_path.read_text().splitlines():
+                if pat.search(line):
+                    lines.append(json.loads(line))
+        return {"lines": lines}
+
+    def ShowMetadata(self, req, ctx):
+        with self._lock:
+            return {"files": {
+                f: {"version": v, "node_list": hs}
+                for f, (v, hs) in self.meta.items()
+            }}
+
+    METHODS = (
+        "Put", "GetPutInfo", "PutFileData", "GetFileData", "GetFileInfo",
+        "Get", "GetDeleteInfo", "DeleteFileData", "Delete", "Ls", "Store",
+        "RemoteReput", "Vote", "AssignNewMaster", "AskForConfirmation",
+        "UpdateFileVersion", "Lsm", "AliveNodes", "Grep", "ShowMetadata",
+    )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _generic_handler(self) -> grpc.GenericRpcHandler:
+        def make(method):
+            fn = getattr(self, method)
+
+            def unary(request, context):
+                return fn(request, context)
+
+            return grpc.unary_unary_rpc_method_handler(
+                unary,
+                request_deserializer=wire.request_deserializer(method),
+                response_serializer=wire.response_serializer(method),
+            )
+
+        return grpc.method_handlers_generic_handler(
+            SERVICE, {m: make(m) for m in self.METHODS}
+        )
+
+    def serve_forever(self) -> None:
+        from concurrent import futures
+
+        # membership loop on a background thread
+        loop = asyncio.new_event_loop()
+        self._loop = loop
+        t = threading.Thread(target=loop.run_forever, daemon=True)
+        t.start()
+        asyncio.run_coroutine_threadsafe(self.udp.start(), loop).result(10)
+        if self.idx != self.introducer:
+            intro_addr = f"127.0.0.1:{self.udp_base + self.introducer}"
+            loop.call_soon_threadsafe(
+                self.udp._send, intro_addr,
+                f"{self.udp.addr}{CMD_SEP}JOIN",
+            )
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=16),
+            options=wire.message_size_options(),
+        )
+        self._server.add_generic_rpc_handlers((self._generic_handler(),))
+        self._server.add_insecure_port(f"127.0.0.1:{self.rpc_base + self.idx}")
+        self._server.start()
+        ctrl = threading.Thread(target=self._control_loop, daemon=True)
+        ctrl.start()
+        self.log("start", f"node {self.idx} up "
+                 f"(udp {self.udp.port}, rpc {self.rpc_base + self.idx})")
+        try:
+            self._server.wait_for_termination()
+        finally:
+            self._stop.set()
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--idx", type=int, required=True)
+    p.add_argument("--n", type=int, required=True)
+    p.add_argument("--udp-base", type=int, default=19000)
+    p.add_argument("--rpc-base", type=int, default=19100)
+    p.add_argument("--dir", type=str, required=True)
+    p.add_argument("--period", type=float, default=0.1)
+    p.add_argument("--t-fail", type=int, default=5)
+    p.add_argument("--no-auto-confirm", action="store_true")
+    p.add_argument("--introducer", type=int, default=0)
+    args = p.parse_args(argv)
+    NodeDaemon(
+        args.idx, args.n, args.udp_base, args.rpc_base, args.dir,
+        period=args.period, t_fail=args.t_fail,
+        auto_confirm=not args.no_auto_confirm, introducer=args.introducer,
+    ).serve_forever()
+
+
+if __name__ == "__main__":
+    main()

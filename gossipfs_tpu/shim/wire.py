@@ -46,6 +46,7 @@ METHOD_TYPES: dict[str, tuple] = {
     "GetDeleteInfo": (pb.FileRequest, pb.DeleteInfoReply),
     "DeleteFileData": (pb.NodeFileRequest, pb.OkReply),
     "RemoteReput": (pb.ReputRequest, pb.OkReply),
+    "PutFileData": (pb.PutFileDataRequest, pb.OkReply),
     "Vote": (pb.VoteRequest, pb.VoteReply),
     "AssignNewMaster": (pb.AssignRequest, pb.AssignReply),
     "UpdateFileVersion": (pb.UpdateVersionRequest, pb.OkReply),
