@@ -1,0 +1,128 @@
+"""Single-chip capacity frontier: N=65,536 on the resident-round kernel.
+
+The rr kernel's resident view stripe is N x merge_block_c bytes of VMEM;
+at the narrow width (merge_block_c=1024, ops/merge_pallas.RR_BLOCK_CS)
+N=65,536 fits — 4.3 BILLION tracked membership entries on one chip, at
+2 B/entry on the packed wire (8.6 GB of state, updated in place).
+
+What bounds this entry point is HBM at *initialization*: a SimState's
+three [N, N] int8 lanes plus their blocked copies exceed the chip before
+the scan starts, so this bench builds the stripe-major PACKED lanes
+directly inside one jit (zeros + a constant pack byte — the fully-joined
+cohort) and calls the scan core (core/rounds._scan_rounds_rr_packed).
+
+    python -m gossipfs_tpu.bench.frontier                # N=65,536
+    python -m gossipfs_tpu.bench.frontier --n 49152      # cross-check
+
+Prints one JSON line with measured rounds/s and the BASELINE detection
+metrics (TTD first/converged, FPR) for 8 tracked crashes under 1% churn.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def run(n: int, rounds: int, block_c: int, crash_at: int, track: int,
+        crash_rate: float, seed: int, topology: str, block_r: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from gossipfs_tpu.bench.run import tracked_crash_events
+    from gossipfs_tpu.config import SimConfig
+    from gossipfs_tpu.core import rounds as R
+    from gossipfs_tpu.core.state import MEMBER
+    from gossipfs_tpu.metrics.detection import summarize
+    from gossipfs_tpu.ops import merge_pallas
+
+    cfg = SimConfig(
+        n=n,
+        topology=topology,
+        fanout=SimConfig.log_fanout(n),
+        remove_broadcast=False,
+        fresh_cooldown=True,
+        t_cooldown=12,
+        merge_kernel="pallas_rr",
+        merge_block_c=block_c,
+        merge_block_r=block_r,
+        view_dtype="int8",
+        hb_dtype="int8",
+    )
+    lane = merge_pallas.LANE
+    nc = n // block_c
+    cs = block_c // lane
+    events, crash_rounds, churn_ok = tracked_crash_events(
+        cfg, rounds, track, crash_at
+    )
+    joined = int(merge_pallas.pack_age_status(
+        jnp.zeros((), jnp.int32), jnp.int32(MEMBER)
+    ))
+
+    @jax.jit
+    def go(key, events, churn_ok):
+        hb4 = jnp.zeros((nc, n, cs, lane), jnp.int8)
+        as4 = jnp.full((nc, n, cs, lane), joined, jnp.int8)
+        alive = jnp.ones((n,), bool)
+        hb_base = jnp.zeros((n,), jnp.int32)
+        out = R._scan_rounds_rr_packed(
+            hb4, as4, alive, hb_base, jnp.int32(0), cfg, key, events,
+            crash_rate, churn_ok,
+        )
+        # lanes stay on device; only the metrics leave
+        return out[5], out[6]
+
+    key = jax.random.PRNGKey(seed)
+    mcarry, per_round = go(key, events, churn_ok)
+    jax.block_until_ready(mcarry)
+    t0 = time.perf_counter()
+    mcarry, per_round = go(key, events, churn_ok)
+    jax.block_until_ready(mcarry)
+    elapsed = time.perf_counter() - t0
+
+    report = summarize(mcarry, per_round, crash_rounds)
+    ttd_f = [v for v in report.ttd_first.values() if v >= 0]
+    ttd_c = [v for v in report.ttd_converged.values() if v >= 0]
+    import statistics
+    return {
+        "metric": "single-chip capacity frontier (resident-round kernel, "
+                  "packed 2 B/entry wire)",
+        "n": n,
+        "entries": n * n,
+        "merge_block_c": block_c,
+        "fanout": cfg.fanout,
+        "topology": topology,
+        "rounds": rounds,
+        "crash_churn": crash_rate,
+        "tracked_crashes": len(crash_rounds),
+        "detected": len(ttd_f),
+        "ttd_first_median": statistics.median(ttd_f) if ttd_f else None,
+        "ttd_first_max": max(ttd_f) if ttd_f else None,
+        "ttd_converged_median": statistics.median(ttd_c) if ttd_c else None,
+        "false_positive_rate": report.false_positive_rate,
+        "seconds_per_round": round(elapsed / rounds, 4),
+        "rounds_per_sec": round(rounds / elapsed, 2),
+    }
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--n", type=int, default=65_536)
+    p.add_argument("--rounds", type=int, default=20)
+    p.add_argument("--block-c", type=int, default=1024)
+    p.add_argument("--block-r", type=int, default=256)
+    p.add_argument("--crash-at", type=int, default=3)
+    p.add_argument("--track", type=int, default=8)
+    p.add_argument("--crash-rate", type=float, default=0.01)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--topology", type=str, default="random")
+    args = p.parse_args(argv)
+    print(json.dumps(run(args.n, args.rounds, args.block_c, args.crash_at,
+                         args.track, args.crash_rate, args.seed,
+                         args.topology, args.block_r)))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

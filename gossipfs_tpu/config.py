@@ -166,26 +166,40 @@ class SimConfig:
                 raise ValueError(f"merge_kernel={self.merge_kernel!r} "
                                  "requires view_dtype='int8'")
             from gossipfs_tpu.ops.merge_pallas import (
+                RR_BLOCK_CS,
                 STRIPE_BLOCK_C,
                 STRIPE_MAX_BYTES,
+                rr_supported,
                 stripe_supported,
             )
 
-            if self.merge_block_c != STRIPE_BLOCK_C:
-                raise ValueError(
-                    f"merge_kernel={self.merge_kernel!r} requires "
-                    f"merge_block_c={STRIPE_BLOCK_C} (the VMEM-resident "
-                    f"stripe width), got {self.merge_block_c}"
-                )
-            if not stripe_supported(self.n, self.fanout):
-                # reject eagerly rather than silently running the XLA path:
-                # N must be lane-aligned, a multiple of the stripe width,
-                # and small enough that one stripe fits VMEM
-                raise ValueError(
-                    f"merge_kernel={self.merge_kernel!r} unsupported at n={self.n}"
-                    f" (needs n % {STRIPE_BLOCK_C} == 0 and "
-                    f"n * {STRIPE_BLOCK_C} <= {STRIPE_MAX_BYTES} B of VMEM)"
-                )
+            if self.merge_kernel.startswith("pallas_rr"):
+                # the rr kernel accepts narrower resident stripes — the
+                # capacity lever: N * merge_block_c bytes must fit VMEM,
+                # so N=65,536 runs at merge_block_c=1024
+                if not rr_supported(self.n, self.fanout, self.merge_block_c):
+                    raise ValueError(
+                        f"merge_kernel={self.merge_kernel!r} needs "
+                        f"merge_block_c in {RR_BLOCK_CS} with "
+                        f"n * merge_block_c <= {STRIPE_MAX_BYTES} B "
+                        f"(n={self.n}, merge_block_c={self.merge_block_c})"
+                    )
+            else:
+                if self.merge_block_c != STRIPE_BLOCK_C:
+                    raise ValueError(
+                        f"merge_kernel={self.merge_kernel!r} requires "
+                        f"merge_block_c={STRIPE_BLOCK_C} (the VMEM-resident "
+                        f"stripe width), got {self.merge_block_c}"
+                    )
+                if not stripe_supported(self.n, self.fanout):
+                    # reject eagerly rather than silently running the XLA
+                    # path: N must be lane-aligned, a multiple of the
+                    # stripe width, and small enough to fit VMEM
+                    raise ValueError(
+                        f"merge_kernel={self.merge_kernel!r} unsupported at "
+                        f"n={self.n} (needs n % {STRIPE_BLOCK_C} == 0 and "
+                        f"n * {STRIPE_BLOCK_C} <= {STRIPE_MAX_BYTES} B of VMEM)"
+                    )
         if self.fused_tick not in ("auto", "off"):
             raise ValueError(f"unknown fused_tick: {self.fused_tick!r}")
         if self.view_dtype not in ("int16", "int8"):

@@ -1090,7 +1090,8 @@ def _use_rr(config: SimConfig, n: int, nloc: int) -> bool:
         or not 1 < config.fanout <= merge_pallas.ARC_CHUNK
     ):
         return False
-    if not merge_pallas.stripe_supported(n, config.fanout, nloc):
+    if not merge_pallas.rr_supported(n, config.fanout, config.merge_block_c,
+                                     nloc):
         return False
     return (
         config.merge_kernel.endswith("interpret")
@@ -1118,9 +1119,6 @@ def _scan_rounds_rr(
     """
     from gossipfs_tpu.ops import merge_pallas
 
-    n = state.n
-    interp = config.merge_kernel.endswith("interpret")
-    lane = merge_pallas.LANE
     # stripe-major lane layout [nc, N, cs, LANE] for the whole scan: each
     # stripe's rows become one contiguous region, so every kernel DMA is a
     # single contiguous transfer (one transpose each way per scan).  The
@@ -1129,9 +1127,49 @@ def _scan_rounds_rr(
     # a third less traffic than the 3-lane form on a bandwidth-bound round.
     tr = lambda a: a.transpose(1, 0, 2, 3)  # noqa: E731
     hb4 = tr(state.hb)
-    status4 = tr(state.status)
-    as4 = merge_pallas.pack_age_status(tr(state.age), status4)
-    nc, _, cs, _ = hb4.shape
+    as4 = merge_pallas.pack_age_status(tr(state.age), tr(state.status))
+    hb4, as4, alive, hb_base, rnd, mcarry, per_round = _scan_rounds_rr_packed(
+        hb4, as4, state.alive, state.hb_base, state.round,
+        config, key, events, crash_rate, churn_ok, mcarry0,
+    )
+    age_w, st_w = merge_pallas.unpack_age_status(as4)
+    state = state._replace(
+        hb=tr(hb4), age=tr(age_w.astype(jnp.int8)),
+        status=tr(st_w.astype(jnp.int8)), alive=alive, hb_base=hb_base,
+        round=rnd,
+    )
+    return state, mcarry, per_round
+
+
+def _scan_rounds_rr_packed(
+    hb4: jax.Array,
+    as4: jax.Array,
+    alive0: jax.Array,
+    hb_base0: jax.Array,
+    round0: jax.Array,
+    config: SimConfig,
+    key: jax.Array,
+    events: RoundEvents,
+    crash_rate: float,
+    churn_ok: jax.Array | None,
+    mcarry0: MetricsCarry | None = None,
+) -> tuple:
+    """The rr scan core over stripe-major PACKED lanes.
+
+    ``hb4`` int8 and ``as4`` (merge_pallas.pack_age_status) in the
+    [nc, N, cs, LANE] stripe-major layout.  Split out from
+    :func:`_scan_rounds_rr` so capacity-frontier callers
+    (bench/frontier.py) can build the packed lanes directly — at N=65,536
+    the three separate [N, N] int8 lanes of a SimState plus their blocked
+    copies exceed the chip's HBM before the scan even starts, while the
+    packed pair (2 B/entry, built in place by a jitted initializer) fits
+    with room for the scan.
+    """
+    from gossipfs_tpu.ops import merge_pallas
+
+    interp = config.merge_kernel.endswith("interpret")
+    lane = merge_pallas.LANE
+    nc, n, cs, _ = hb4.shape
     subj_shape = (nc, cs, lane)
     c_blk = cs * lane
 
@@ -1139,7 +1177,10 @@ def _scan_rounds_rr(
         j = jnp.arange(n)
         return arr4[j // c_blk, j, (j % c_blk) // lane, j % lane]
 
-    counts0 = jnp.sum((status4 == MEMBER).astype(jnp.int32), axis=(0, 2, 3))
+    counts0 = jnp.sum(
+        (merge_pallas.unpack_age_status(as4)[1] == MEMBER).astype(jnp.int32),
+        axis=(0, 2, 3),
+    )
 
     class _Cols(NamedTuple):  # what _round_stats/_update_carry consume
         alive: jax.Array
@@ -1198,7 +1239,7 @@ def _scan_rounds_rr(
         first_obs = fobs.reshape(n)
         metrics, any_fail = _round_stats(n_det, cols, LOCAL_CTX)
         self_member = alive & (
-            ((diag(as2).astype(jnp.int32) + 128) & 3) == MEMBER
+            merge_pallas.unpack_age_status(diag(as2))[1] == MEMBER
         )
         member_col = cnt_incl.reshape(n) - self_member.astype(jnp.int32)
         rejoined = jnp.zeros_like(alive)  # constant: resets fold away
@@ -1210,16 +1251,10 @@ def _scan_rounds_rr(
         mcarry0 = MetricsCarry.init(n)
     (hb4, as4, alive, hb_base, rnd, mcarry, _), per_round = lax.scan(
         step,
-        (hb4, as4, state.alive, state.hb_base, state.round, mcarry0, counts0),
+        (hb4, as4, alive0, hb_base0, round0, mcarry0, counts0),
         events,
     )
-    age_w, st_w = merge_pallas.unpack_age_status(as4)
-    state = state._replace(
-        hb=tr(hb4), age=tr(age_w.astype(jnp.int8)),
-        status=tr(st_w.astype(jnp.int8)), alive=alive, hb_base=hb_base,
-        round=rnd,
-    )
-    return state, mcarry, per_round
+    return hb4, as4, alive, hb_base, rnd, mcarry, per_round
 
 
 def _scan_rounds(

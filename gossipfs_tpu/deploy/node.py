@@ -102,8 +102,13 @@ class NodeDaemon:
         self._lock = threading.RLock()
         # master state (meaningful only while self.idx == self.master_id)
         self.meta: dict[str, tuple[int, list[int]]] = {}  # file -> (version, holders)
+        # placements handed out by GetPutInfo but not yet committed by the
+        # writer's UpdateFileVersion — a writer that dies mid-push leaves
+        # only a stale pending entry, never unreadable metadata
+        self.pending: dict[str, tuple[int, list[int]]] = {}
         self.last_put: dict[str, tuple[float, str]] = {}  # file -> (time, callback)
         self._lost_at: dict[int, float] = {}              # node -> detect time
+        self._repair_tick = 0
         self._clients: dict[int, ShimClient] = {}
         self._server: grpc.Server | None = None
         self._stop = threading.Event()
@@ -156,6 +161,7 @@ class NodeDaemon:
 
     def _master_repair(self) -> None:
         now = time.monotonic()
+        self._repair_tick += 1
         live = set(self.view())
         # a holder can leave the master's view through a peer's REMOVE
         # broadcast, which never passes through this node's own detector —
@@ -181,21 +187,27 @@ class NodeDaemon:
                 candidates = [x for x in sorted(live)
                               if x not in holders]
                 placed, failed = [], False
-                for src, tgt in zip(survivors * len(dead),
-                                    candidates[:len(dead)]):
+                for k, tgt in enumerate(candidates[:len(dead)]):
+                    # rotate sources ACROSS ticks too, so a copy-less
+                    # survivor (refused RemoteReput) doesn't livelock the
+                    # retry on the same source forever
+                    src = survivors[(k + self._repair_tick) % len(survivors)]
                     try:
-                        self.client(src).call(
+                        ok = bool(self.client(src).call(
                             "RemoteReput", source=src, target=tgt,
                             file=file, version=version,
-                        )
+                        ).get("ok"))
+                    except grpc.RpcError as e:
+                        ok = False
+                        self.log("repair_error", str(e.code()), file=file)
+                    if ok:
                         placed.append(tgt)
                         self.log("re_replicate",
                                  f"Re-replicated {file} v{version} from "
                                  f"{src} to [{tgt}]", file=file, source=src,
                                  target=tgt)
-                    except grpc.RpcError as e:
+                    else:
                         failed = True
-                        self.log("repair_error", str(e.code()), file=file)
                 if failed:
                     # keep the dead holders listed so the next control
                     # tick re-detects the deficit and retries; only the
@@ -271,10 +283,27 @@ class NodeDaemon:
                  f"{votes}/{len(live)} votes", votes=votes)
 
     def _control_loop(self) -> None:
+        tick = 0
         while not self._stop.wait(self.period):
+            tick += 1
             try:
                 if self.master_id == self.idx:
                     self._master_repair()
+                    if tick % 20 == 0:
+                        # idempotent re-announce: a peer whose server was
+                        # slow during the election's single AssignNewMaster
+                        # fan-out would otherwise point at the dead master
+                        # forever (it never campaigns unless it is lowest)
+                        for peer in self.view():
+                            if peer == self.idx:
+                                continue
+                            try:
+                                self.client(peer).call(
+                                    "AssignNewMaster", node=peer,
+                                    master=self.idx,
+                                )
+                            except grpc.RpcError:
+                                pass
                 else:
                     self._maybe_campaign()
             except Exception as e:  # keep the daemon alive; log the fault
@@ -298,6 +327,11 @@ class NodeDaemon:
                 "PutFileData", node=int(replica), file=file,
                 version=version, data_b64=payload,
             )
+        # commit: the master publishes the new version only now that every
+        # replica holds the bytes (reference Update_file_version)
+        self.client(self.master_id).call(
+            "UpdateFileVersion", node=self.idx, file=file, version=version
+        )
         self.log("put", f"put {file} v{version}", file=file)
         return {"ok": True}
 
@@ -330,7 +364,11 @@ class NodeDaemon:
             replicas = holders if holders else self._place(file, live)
             replicas = [r for r in replicas if r in live] or \
                 self._place(file, live)
-            self.meta[file] = (version + 1, list(replicas))
+            # two-phase, the reference's own flow (Get_put_info hands out
+            # the plan, Update_file_version commits after the transfer):
+            # committing v+1 here would strand the readable v if the
+            # writer dies between this reply and its pushes
+            self.pending[file] = (version + 1, list(replicas))
             self.last_put[file] = (now, req.get("callback") or "")
         return {"ok": True, "conflict": conflict,
                 "replicas": list(replicas), "version": version + 1}
@@ -409,7 +447,11 @@ class NodeDaemon:
         file, target = req["file"], int(req["target"])
         data = self.store.get(file)
         if data is None:
-            return {"ok": False, "error": "no local copy"}
+            # OkReply carries only `ok` — a free-text field here would
+            # fail response serialization and surface as an opaque
+            # RpcError at the master instead of a clean refusal
+            self.log("reput_miss", f"no local copy of {file}", file=file)
+            return {"ok": False}
         self.client(target).call(
             "PutFileData", node=target, file=file,
             version=int(req.get("version", 1)),
@@ -427,18 +469,26 @@ class NodeDaemon:
 
     def AssignNewMaster(self, req, ctx):
         with self._lock:
+            changed = self.master_id != int(req["master"])
             self.master_id = int(req["master"])
-        self.log("new_master", f"master is now {self.master_id}",
-                 master=self.master_id)
+        if changed:  # re-announces are periodic; log transitions only
+            self.log("new_master", f"master is now {self.master_id}",
+                     master=self.master_id)
         return {"listing": self.store.listing()}
 
     def AskForConfirmation(self, req, ctx):
         return {"confirm": self.auto_confirm}
 
     def UpdateFileVersion(self, req, ctx):
+        """The writer's commit: the pushes landed, publish the placement."""
+        file, version = req["file"], int(req["version"])
         with self._lock:
-            v, holders = self.meta.get(req["file"], (0, []))
-            self.meta[req["file"]] = (int(req["version"]), holders)
+            pend = self.pending.pop(file, None)
+            if pend is not None and pend[0] == version:
+                self.meta[file] = pend
+            else:
+                v, holders = self.meta.get(file, (0, []))
+                self.meta[file] = (version, holders)
         return {"ok": True}
 
     def Lsm(self, req, ctx):

@@ -658,6 +658,26 @@ def stripe_supported(n: int, fanout: int, n_cols: int | None = None) -> bool:
     )
 
 
+# Stripe widths the resident-round kernel accepts.  Narrower stripes trade
+# per-element gather efficiency for VMEM: at c_blk=1024 the resident view
+# stripe is N x 1024 bytes, which is what admits N=65,536 on one chip
+# (64 MB stripe) — measured unpadded (Mosaic packs (8, 128) int8 scratch
+# without rounding the sublane dim up to the (32, 128) tile).
+RR_BLOCK_CS = (1024, 2048, 4096)
+
+
+def rr_supported(n: int, fanout: int, c_blk: int,
+                 n_cols: int | None = None) -> bool:
+    if n_cols is None:
+        n_cols = n
+    return (
+        supported(n, fanout, n_cols)
+        and c_blk in RR_BLOCK_CS
+        and n_cols % c_blk == 0
+        and n * c_blk <= STRIPE_MAX_BYTES
+    )
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -1277,7 +1297,12 @@ def _rr_kernel(
         # crashes the TPU lowering (layout.h implicit_dim check)
         rc = jnp.sum((st_new == member).astype(jnp.int32), axis=2)
         rc = jnp.sum(rc, axis=1, keepdims=True)
-        rcnt_out[...] = jnp.broadcast_to(rc, (rc.shape[0], LANE))
+        # int16 output: a per-stripe partial count is <= cs*LANE <= 4096.
+        # At the N=65,536 frontier this buffer is [N, nc*LANE] — int16
+        # halves a gigabyte-class side output
+        rcnt_out[...] = jnp.broadcast_to(
+            rc, (rc.shape[0], LANE)
+        ).astype(rcnt_out.dtype)
 
         @pl.when(i == 0)
         def _():
@@ -1361,10 +1386,10 @@ def resident_round_blocked(
         raise ValueError("resident round kernel requires int8 lanes")
     if arc and n % ARC_CHUNK:
         raise ValueError(f"arc resident round needs N % {ARC_CHUNK} == 0")
-    if not stripe_supported(n, fanout, nc * cs * LANE):
+    if not rr_supported(n, fanout, cs * LANE, nc * cs * LANE):
         raise ValueError(
-            f"resident round kernel needs lane-aligned N, cs*LANE == "
-            f"{STRIPE_BLOCK_C} and N*{STRIPE_BLOCK_C} <= {STRIPE_MAX_BYTES} B "
+            f"resident round kernel needs lane-aligned N, cs*LANE in "
+            f"{RR_BLOCK_CS} and N*cs*LANE <= {STRIPE_MAX_BYTES} B "
             f"(N={n}, blocked cols={cs * LANE}); use the stripe/XLA path"
         )
     ch = min(chunk, n)
@@ -1426,7 +1451,7 @@ def resident_round_blocked(
             jax.ShapeDtypeStruct((nc, cs, LANE), jnp.int32),
             jax.ShapeDtypeStruct((nc, cs, LANE), jnp.int32),
             jax.ShapeDtypeStruct((nc, cs, LANE), jnp.int32),
-            jax.ShapeDtypeStruct((n, nc * LANE), jnp.int32),
+            jax.ShapeDtypeStruct((n, nc * LANE), jnp.int16),
         ],
         scratch_shapes=[
             pltpu.VMEM((n, cs, LANE), jnp.int8),          # view stripe

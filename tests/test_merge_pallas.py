@@ -227,11 +227,16 @@ def test_full_round_equivalence_xla_vs_stripe():
 
 
 @pytest.mark.slow  # N=4096 interpreter-mode kernel run
-def test_full_round_equivalence_xla_vs_rr():
+@pytest.mark.parametrize("block_c", [4096, 1024])
+def test_full_round_equivalence_xla_vs_rr(block_c):
     """The resident-round kernel (tick + view build + merge + reductions in
     ONE pallas call, with carried member counts and in-place lane update)
     reproduces the XLA scan bit-for-bit — states, carry, AND per-round
-    metrics, across a deep horizon with churn and tracked crashes."""
+    metrics, across a deep horizon with churn and tracked crashes.
+
+    block_c=1024 is the narrow resident stripe the N=65,536 capacity
+    frontier runs (bench/frontier.py) — same kernel, 8x less VMEM per
+    stripe."""
     base = SimConfig(
         n=4096,
         topology="random",
@@ -241,7 +246,7 @@ def test_full_round_equivalence_xla_vs_rr():
         t_cooldown=12,
         view_dtype="int8",
         hb_dtype="int8",
-        merge_block_c=4096,
+        merge_block_c=block_c,
     )
     key = jax.random.PRNGKey(17)
     out = {}
