@@ -29,50 +29,27 @@ import time
 def run(n: int, rounds: int, block_c: int, crash_at: int, track: int,
         crash_rate: float, seed: int, topology: str, block_r: int) -> dict:
     import jax
-    import jax.numpy as jnp
 
     from gossipfs_tpu.bench.run import tracked_crash_events
     from gossipfs_tpu.config import SimConfig
     from gossipfs_tpu.core import rounds as R
-    from gossipfs_tpu.core.state import MEMBER
     from gossipfs_tpu.metrics.detection import summarize
-    from gossipfs_tpu.ops import merge_pallas
 
-    cfg = SimConfig(
-        n=n,
-        topology=topology,
-        fanout=SimConfig.log_fanout(n),
-        remove_broadcast=False,
-        fresh_cooldown=True,
-        t_cooldown=12,
-        merge_kernel="pallas_rr",
-        merge_block_c=block_c,
-        merge_block_r=block_r,
-        view_dtype="int8",
-        hb_dtype="int8",
-    )
-    lane = merge_pallas.LANE
-    nc = n // block_c
-    cs = block_c // lane
+    cfg = SimConfig.packed_rr(n, block_c, topology=topology,
+                              merge_block_r=block_r)
     events, crash_rounds, churn_ok = tracked_crash_events(
         cfg, rounds, track, crash_at
     )
-    joined = int(merge_pallas.pack_age_status(
-        jnp.zeros((), jnp.int32), jnp.int32(MEMBER)
-    ))
 
     @jax.jit
     def go(key, events, churn_ok):
-        hb4 = jnp.zeros((nc, n, cs, lane), jnp.int8)
-        as4 = jnp.full((nc, n, cs, lane), joined, jnp.int8)
-        alive = jnp.ones((n,), bool)
-        hb_base = jnp.zeros((n,), jnp.int32)
+        hb4, as4, alive, hb_base, rnd, counts = R.rr_packed_init(cfg)
         out = R._scan_rounds_rr_packed(
-            hb4, as4, alive, hb_base, jnp.int32(0), cfg, key, events,
-            crash_rate, churn_ok,
+            hb4, as4, alive, hb_base, rnd, cfg, key, events,
+            crash_rate, churn_ok, counts0=counts,
         )
         # lanes stay on device; only the metrics leave
-        return out[5], out[6]
+        return out[6], out[7]
 
     key = jax.random.PRNGKey(seed)
     mcarry, per_round = go(key, events, churn_ok)

@@ -253,3 +253,19 @@ class SimConfig:
     def log_fanout(n: int) -> int:
         """North-star fanout = ceil(log2 N), the BASELINE.json 100k config."""
         return max(1, math.ceil(math.log2(max(n, 2))))
+
+    @classmethod
+    def packed_rr(cls, n: int, block_c: int = 1024,
+                  interpret: bool = False, **overrides) -> "SimConfig":
+        """The resident-round capacity profile — ONE definition of the
+        rr-kernel protocol config shared by the frontier bench, the
+        ``--packed`` CLI, and PackedDetector tests (a drifted copy in any
+        of them would silently change the measured protocol)."""
+        kw = dict(
+            n=n, topology="random", fanout=cls.log_fanout(n),
+            remove_broadcast=False, fresh_cooldown=True, t_cooldown=12,
+            merge_kernel="pallas_rr_interpret" if interpret else "pallas_rr",
+            merge_block_c=block_c, view_dtype="int8", hb_dtype="int8",
+        )
+        kw.update(overrides)
+        return cls(**kw)

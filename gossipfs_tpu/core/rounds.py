@@ -979,8 +979,7 @@ def _round_core_fused(
     return new_state, metrics, member_col, any_fail, first_obs_now
 
 
-@partial(jax.jit, static_argnames=("config",))
-def gossip_round(
+def _gossip_round_impl(
     state: SimState,
     events: RoundEvents,
     edges: jax.Array | None,
@@ -1011,6 +1010,18 @@ def gossip_round(
     if blocked:
         state = _from_blocked(state)
     return state, metrics, any_fail, first_obs
+
+
+gossip_round = partial(jax.jit, static_argnames=("config",))(
+    _gossip_round_impl
+)
+# donated variant for exclusive-owner drivers (detector/sim.py with
+# donate=True): the input state's buffers are consumed, which is what fits
+# the interactive single-round path at the N=49,152 capacity point — the
+# non-donated call's doubled lanes + relayout copies exceed HBM there
+gossip_round_donate = partial(
+    jax.jit, static_argnames=("config",), donate_argnums=(0,)
+)(_gossip_round_impl)
 
 
 def _update_carry(
@@ -1128,9 +1139,11 @@ def _scan_rounds_rr(
     tr = lambda a: a.transpose(1, 0, 2, 3)  # noqa: E731
     hb4 = tr(state.hb)
     as4 = merge_pallas.pack_age_status(tr(state.age), tr(state.status))
-    hb4, as4, alive, hb_base, rnd, mcarry, per_round = _scan_rounds_rr_packed(
-        hb4, as4, state.alive, state.hb_base, state.round,
-        config, key, events, crash_rate, churn_ok, mcarry0,
+    hb4, as4, alive, hb_base, rnd, _, mcarry, per_round = (
+        _scan_rounds_rr_packed(
+            hb4, as4, state.alive, state.hb_base, state.round,
+            config, key, events, crash_rate, churn_ok, mcarry0,
+        )
     )
     age_w, st_w = merge_pallas.unpack_age_status(as4)
     state = state._replace(
@@ -1139,6 +1152,39 @@ def _scan_rounds_rr(
         round=rnd,
     )
     return state, mcarry, per_round
+
+
+def rr_packed_init(config: SimConfig) -> tuple:
+    """Fully-joined packed stripe-major initial state for the rr core.
+
+    Device arrays built directly in the scan's own layout — the frontier
+    entry points (bench/frontier.py, detector.sim.PackedDetector) call
+    this instead of init_state because three [N, N] SimState lanes plus
+    blocked copies exceed HBM at N=65,536 before the scan starts.
+    Returns (hb4, as4, alive, hb_base, round, counts).
+    """
+    from gossipfs_tpu.ops import merge_pallas
+
+    n = config.n
+    lane = merge_pallas.LANE
+    nc = n // config.merge_block_c
+    cs = config.merge_block_c // lane
+    joined = int(merge_pallas.pack_age_status(
+        jnp.zeros((), jnp.int32), jnp.int32(int(MEMBER))
+    ))
+
+    @jax.jit
+    def init():
+        return (
+            jnp.zeros((nc, n, cs, lane), jnp.int8),
+            jnp.full((nc, n, cs, lane), joined, jnp.int8),
+            jnp.ones((n,), bool),
+            jnp.zeros((n,), jnp.int32),
+            jnp.int32(0),
+            jnp.full((n,), n, jnp.int32),
+        )
+
+    return init()
 
 
 def _scan_rounds_rr_packed(
@@ -1153,6 +1199,7 @@ def _scan_rounds_rr_packed(
     crash_rate: float,
     churn_ok: jax.Array | None,
     mcarry0: MetricsCarry | None = None,
+    counts0: jax.Array | None = None,
 ) -> tuple:
     """The rr scan core over stripe-major PACKED lanes.
 
@@ -1177,10 +1224,15 @@ def _scan_rounds_rr_packed(
         j = jnp.arange(n)
         return arr4[j // c_blk, j, (j % c_blk) // lane, j % lane]
 
-    counts0 = jnp.sum(
-        (merge_pallas.unpack_age_status(as4)[1] == MEMBER).astype(jnp.int32),
-        axis=(0, 2, 3),
-    )
+    if counts0 is None:
+        # a full pass over the packed lane; per-round drivers
+        # (detector.sim.PackedDetector) thread the carried counts back in
+        # instead of paying it every advance
+        counts0 = jnp.sum(
+            (merge_pallas.unpack_age_status(as4)[1] == MEMBER)
+            .astype(jnp.int32),
+            axis=(0, 2, 3),
+        )
 
     class _Cols(NamedTuple):  # what _round_stats/_update_carry consume
         alive: jax.Array
@@ -1249,12 +1301,12 @@ def _scan_rounds_rr_packed(
 
     if mcarry0 is None:
         mcarry0 = MetricsCarry.init(n)
-    (hb4, as4, alive, hb_base, rnd, mcarry, _), per_round = lax.scan(
+    (hb4, as4, alive, hb_base, rnd, mcarry, counts), per_round = lax.scan(
         step,
         (hb4, as4, alive0, hb_base0, round0, mcarry0, counts0),
         events,
     )
-    return hb4, as4, alive, hb_base, rnd, mcarry, per_round
+    return hb4, as4, alive, hb_base, rnd, counts, mcarry, per_round
 
 
 def _scan_rounds(

@@ -52,18 +52,23 @@ class CoSim:
         seed: int = 0,
         log: EventLog | None = None,
         election: str = "local",
+        detector=None,
     ):
         """``election``: "local" computes election outcomes centrally inside
         ``update_membership`` (the in-process fast path); "rpc" defers them —
         the cluster only flags ``election_pending`` and the gRPC shim drives
         the real per-node Vote / AssignNewMaster protocol
         (``ShimServicer.run_pending_election``), matching the reference's
-        distributed revote (slave.go:930-1051)."""
+        distributed revote (slave.go:930-1051).
+
+        ``detector``: any FailureDetector (default: a fresh SimDetector).
+        The capacity-frontier interactive CLI passes a
+        ``detector.sim.PackedDetector`` — same seam, rr-kernel state."""
         if election not in ("local", "rpc"):
             raise ValueError(f"unknown election mode: {election!r}")
         self.config = config
         self.election = election
-        self.detector = SimDetector(config, seed=seed)
+        self.detector = detector or SimDetector(config, seed=seed)
         self.cluster = SDFSCluster(config.n, seed=seed, introducer=config.introducer)
         self.log = log or EventLog()
         self._recover_at: list[int] = []  # rounds at which to run fail_recover
@@ -71,7 +76,8 @@ class CoSim:
 
     @property
     def round(self) -> int:
-        return int(self.detector.state.round)
+        det = self.detector
+        return det.round if hasattr(det, "round") else int(det.state.round)
 
     def _observer(self) -> int | None:
         """See ``select_observer`` — the *view itself* stays pure gossip data:

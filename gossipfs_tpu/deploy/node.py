@@ -104,8 +104,11 @@ class NodeDaemon:
         self.meta: dict[str, tuple[int, list[int]]] = {}  # file -> (version, holders)
         # placements handed out by GetPutInfo but not yet committed by the
         # writer's UpdateFileVersion — a writer that dies mid-push leaves
-        # only a stale pending entry, never unreadable metadata
-        self.pending: dict[str, tuple[int, list[int]]] = {}
+        # only a stale pending entry, never unreadable metadata.  Keyed by
+        # (file, version) with versions allocated past any pending one, so
+        # concurrent writers to the same file each commit THEIR OWN plan
+        # (a single slot would let writer A publish writer B's replica set)
+        self.pending: dict[tuple[str, int], list[int]] = {}
         self.last_put: dict[str, tuple[float, str]] = {}  # file -> (time, callback)
         self._lost_at: dict[int, float] = {}              # node -> detect time
         self._repair_tick = 0
@@ -122,12 +125,15 @@ class NodeDaemon:
             f.write(json.dumps(entry) + "\n")
 
     def client(self, idx: int) -> ShimClient:
-        c = self._clients.get(idx)
-        if c is None:
-            c = self._clients[idx] = ShimClient(
-                f"127.0.0.1:{self.rpc_base + idx}", timeout=3.0
-            )
-        return c
+        # called from gRPC worker threads, the control loop, and announce
+        # threads; grpc channels are thread-safe but the cache isn't
+        with self._lock:
+            c = self._clients.get(idx)
+            if c is None:
+                c = self._clients[idx] = ShimClient(
+                    f"127.0.0.1:{self.rpc_base + idx}", timeout=3.0
+                )
+            return c
 
     def view(self) -> list[int]:
         """Node indices in this node's own membership table."""
@@ -282,6 +288,15 @@ class NodeDaemon:
         self.log("elected", f"node {self.idx} became master with "
                  f"{votes}/{len(live)} votes", votes=votes)
 
+    def _announce(self, peers: list[int]) -> None:
+        for peer in peers:
+            try:
+                self.client(peer).call(
+                    "AssignNewMaster", node=peer, master=self.idx
+                )
+            except grpc.RpcError:
+                pass
+
     def _control_loop(self) -> None:
         tick = 0
         while not self._stop.wait(self.period):
@@ -293,17 +308,14 @@ class NodeDaemon:
                         # idempotent re-announce: a peer whose server was
                         # slow during the election's single AssignNewMaster
                         # fan-out would otherwise point at the dead master
-                        # forever (it never campaigns unless it is lowest)
-                        for peer in self.view():
-                            if peer == self.idx:
-                                continue
-                            try:
-                                self.client(peer).call(
-                                    "AssignNewMaster", node=peer,
-                                    master=self.idx,
-                                )
-                            except grpc.RpcError:
-                                pass
+                        # forever (it never campaigns unless it is lowest).
+                        # Fire-and-forget thread: a hung peer's RPC timeout
+                        # must not stall the repair loop it shares a thread
+                        # with
+                        peers = [p for p in self.view() if p != self.idx]
+                        threading.Thread(
+                            target=self._announce, args=(peers,), daemon=True
+                        ).start()
                 else:
                     self._maybe_campaign()
             except Exception as e:  # keep the daemon alive; log the fault
@@ -366,12 +378,16 @@ class NodeDaemon:
                 self._place(file, live)
             # two-phase, the reference's own flow (Get_put_info hands out
             # the plan, Update_file_version commits after the transfer):
-            # committing v+1 here would strand the readable v if the
-            # writer dies between this reply and its pushes
-            self.pending[file] = (version + 1, list(replicas))
+            # committing here would strand the readable version if the
+            # writer dies between this reply and its pushes.  The new
+            # version goes past every in-flight one so concurrent writers
+            # never share a pending slot
+            new_v = max([version] + [v for (f, v) in self.pending
+                                     if f == file]) + 1
+            self.pending[(file, new_v)] = list(replicas)
             self.last_put[file] = (now, req.get("callback") or "")
         return {"ok": True, "conflict": conflict,
-                "replicas": list(replicas), "version": version + 1}
+                "replicas": list(replicas), "version": new_v}
 
     def PutFileData(self, req, ctx):
         data = base64.b64decode(req.get("data_b64", ""))
@@ -416,6 +432,8 @@ class NodeDaemon:
             _, holders = self.meta.get(req["file"], (0, []))
             self.meta.pop(req["file"], None)
             self.last_put.pop(req["file"], None)
+            for k in [k for k in self.pending if k[0] == req["file"]]:
+                del self.pending[k]
         return {"old_replicas": list(holders)}
 
     def DeleteFileData(self, req, ctx):
@@ -483,12 +501,11 @@ class NodeDaemon:
         """The writer's commit: the pushes landed, publish the placement."""
         file, version = req["file"], int(req["version"])
         with self._lock:
-            pend = self.pending.pop(file, None)
-            if pend is not None and pend[0] == version:
-                self.meta[file] = pend
-            else:
-                v, holders = self.meta.get(file, (0, []))
-                self.meta[file] = (version, holders)
+            plan = self.pending.pop((file, version), None)
+            cur_v, holders = self.meta.get(file, (0, []))
+            if version >= cur_v:
+                self.meta[file] = (version, plan if plan is not None
+                                   else holders)
         return {"ok": True}
 
     def Lsm(self, req, ctx):

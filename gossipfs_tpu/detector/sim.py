@@ -17,7 +17,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from gossipfs_tpu.config import SimConfig
-from gossipfs_tpu.core.rounds import gossip_round, run_rounds
+from gossipfs_tpu.core.rounds import (
+    gossip_round,
+    gossip_round_donate,
+    run_rounds,
+)
 from gossipfs_tpu.core.state import MEMBER, RoundEvents, SimState, init_state
 from gossipfs_tpu.detector.api import DetectionEvent
 from gossipfs_tpu.utils.snapshot import Snapshot, SnapshotBuffer
@@ -31,8 +35,15 @@ class SimDetector:
         config: SimConfig,
         member_mask: np.ndarray | None = None,
         seed: int = 0,
+        donate: bool = False,
     ):
+        """``donate=True``: each interactive ``advance`` consumes the
+        previous state's buffers (core.rounds.gossip_round_donate) — the
+        detector must be the state's exclusive owner (don't hold
+        references to ``det.state`` across an advance).  This is what
+        fits the interactive path at the N=49,152 capacity point."""
         self.config = config
+        self.donate = donate
         self.state: SimState = init_state(
             config, None if member_mask is None else jnp.asarray(member_mask)
         )
@@ -106,7 +117,8 @@ class SimDetector:
 
                 edges = topology.in_edges(self.config, k, None)
             round_idx = int(self.state.round)
-            self.state, _, any_fail, first_obs = gossip_round(
+            step = gossip_round_donate if self.donate else gossip_round
+            self.state, _, any_fail, first_obs = step(
                 self.state, ev, edges, self.config
             )
             if not bool(jnp.any(any_fail)):
@@ -266,5 +278,128 @@ class SimDetector:
     def drain_events(self) -> list[DetectionEvent]:
         self._join_bulk()
         self._resolve_pending_bulk()
+        out, self._events = self._events, []
+        return out
+
+
+class PackedDetector:
+    """Interactive FailureDetector over the rr kernel's packed state.
+
+    The capacity-frontier interactive path: the state lives as the
+    resident-round kernel's stripe-major packed lanes (2 B/entry,
+    core/rounds._scan_rounds_rr_packed) and every ``advance`` runs ONE
+    donated 1-round scan — which is what fits N=49,152+ interactively
+    (the 2-D ``gossip_round`` path's doubled lanes measured 20.3 GB at
+    that size, past the chip).  Same FailureDetector seam as SimDetector
+    for the verbs the lean crash-only fault model carries: ``crash`` and
+    ``leave`` (silent death — no LEAVE broadcast on this path); ``join``
+    raises, matching ``run_rounds(crash_only_events=True)``'s contract.
+    Detection events are synthesized by diffing the carried
+    first-detection vector, so they match the scan path's first-observer
+    semantics exactly.
+    """
+
+    def __init__(self, config: SimConfig, seed: int = 0):
+        from gossipfs_tpu.core import rounds as R
+
+        if not R._use_rr(config, config.n, config.n):
+            raise ValueError(
+                "PackedDetector requires a resident-round config "
+                "(merge_kernel='pallas_rr', all-int8, random/random_arc)"
+            )
+        self.config = config
+        self._carry = R.rr_packed_init(config)
+        self._mcarry = R.MetricsCarry.init(config.n)
+        self._key = jax.random.PRNGKey(seed)
+        self._pending_crash: set[int] = set()
+        self._events: list[DetectionEvent] = []
+
+        def one_round(hb4, as4, alive, hb_base, rnd, counts, mc, ev):
+            return R._scan_rounds_rr_packed(
+                hb4, as4, alive, hb_base, rnd, config,
+                # fold the round into the session key inside the core
+                self._key, ev, 0.0, None, mcarry0=mc, counts0=counts,
+            )
+
+        self._step = jax.jit(one_round, donate_argnums=(0, 1))
+
+    @property
+    def round(self) -> int:
+        return int(self._carry[4])
+
+    # -- verbs -------------------------------------------------------------
+    def _check(self, node: int) -> int:
+        # an unvalidated id would poison the pending set and raise on
+        # every subsequent advance — fatal for a multi-GB frontier session
+        if not 0 <= node < self.config.n:
+            raise ValueError(
+                f"node id {node} out of range [0, {self.config.n})"
+            )
+        return node
+
+    def crash(self, node: int) -> None:
+        self._pending_crash.add(self._check(node))
+
+    def leave(self, node: int) -> None:
+        # lean fault model: leave == silent death (the scan path's
+        # crash_only_events contract; detection still happens by timeout)
+        self._pending_crash.add(self._check(node))
+
+    def join(self, node: int) -> None:
+        raise NotImplementedError(
+            "PackedDetector runs the lean crash-only round; "
+            "use SimDetector for join/rejoin scenarios"
+        )
+
+    def advance(self, rounds: int = 1) -> None:
+        n = self.config.n
+        for _ in range(rounds):
+            mask = np.zeros((n,), dtype=bool)
+            if self._pending_crash:
+                mask[list(self._pending_crash)] = True
+                self._pending_crash.clear()
+            m = jnp.asarray(mask)
+            z = jnp.zeros((1, n), dtype=bool)
+            ev = RoundEvents(crash=m[None], leave=z, join=z)
+            hb4, as4, alive, hb_base, rnd, counts = self._carry
+            round_idx = int(rnd)
+            prev_first = self._mcarry.first_detect
+            (hb4, as4, alive, hb_base, rnd, counts, mc, per_round) = (
+                self._step(hb4, as4, alive, hb_base, rnd, counts,
+                           self._mcarry, ev)
+            )
+            self._carry = (hb4, as4, alive, hb_base, rnd, counts)
+            self._mcarry = mc
+            if int(per_round.true_detections[0]) + int(
+                per_round.false_positives[0]
+            ) == 0:
+                continue  # quiet round: two scalar transfers
+            fresh = np.asarray(
+                (mc.first_detect == round_idx) & (prev_first < 0)
+            )
+            obs = np.asarray(mc.first_observer)
+            alive_h = np.asarray(alive)
+            for subj in np.nonzero(fresh)[0]:
+                self._events.append(
+                    DetectionEvent(
+                        round=round_idx,
+                        observer=int(obs[subj]),
+                        subject=int(subj),
+                        false_positive=bool(alive_h[subj]),
+                    )
+                )
+
+    # -- views -------------------------------------------------------------
+    def membership(self, observer: int) -> list[int]:
+        from gossipfs_tpu.ops import merge_pallas
+
+        as_row = self._carry[1][:, observer]  # [nc, cs, LANE]
+        st = merge_pallas.unpack_age_status(as_row)[1].reshape(-1)
+        return [int(j) for j in np.nonzero(np.asarray(st) == int(MEMBER))[0]]
+
+    def alive_nodes(self) -> list[int]:
+        return [int(j) for j in np.nonzero(np.asarray(self._carry[2]))[0]]
+
+    def drain_events(self) -> list[DetectionEvent]:
         out, self._events = self._events, []
         return out

@@ -77,6 +77,14 @@ def make_parser() -> argparse.ArgumentParser:
         help="seconds to wait for the write-conflict yes/no before "
              "rejecting (reference: server.go:172)",
     )
+    p.add_argument(
+        "--packed", action="store_true",
+        help="capacity-frontier interactive mode: the membership state "
+             "lives as the resident-round kernel's packed lanes "
+             "(detector.sim.PackedDetector) — what fits N=49,152+ "
+             "interactively on one chip.  Implies a random log2(N)-fanout "
+             "crash-only protocol profile; 'join' is unsupported",
+    )
     return p
 
 
@@ -163,7 +171,10 @@ def dispatch(
                 print(entry, file=out)
         else:
             print(f"unknown command: {cmd}", file=out)
-    except (IndexError, ValueError, FileNotFoundError, re.error) as e:
+    except (IndexError, ValueError, FileNotFoundError, re.error,
+            NotImplementedError) as e:
+        # NotImplementedError: mode-gated verbs (e.g. 'join' in --packed)
+        # must print an error, not kill a session holding GBs of state
         print(f"error: {e}", file=out)
     return True
 
@@ -172,11 +183,22 @@ def main(argv=None) -> None:
     parser = make_parser()
     args = parser.parse_args(argv)
     try:
-        cfg = SimConfig(n=args.n, topology=args.topology, fanout=args.fanout)
+        if args.packed:
+            cfg = SimConfig.packed_rr(args.n)
+        else:
+            cfg = SimConfig(n=args.n, topology=args.topology,
+                            fanout=args.fanout)
     except ValueError as e:
         parser.error(str(e))
-    sim = CoSim(cfg, seed=args.seed)
-    print(f"gossipfs sim: {args.n} nodes, {args.topology} topology. 'quit' to exit.")
+    detector = None
+    if args.packed:
+        from gossipfs_tpu.detector.sim import PackedDetector
+
+        detector = PackedDetector(cfg, seed=args.seed)
+    sim = CoSim(cfg, seed=args.seed, detector=detector)
+    print(f"gossipfs sim: {args.n} nodes, {cfg.topology} topology"
+          f"{' (packed frontier mode)' if args.packed else ''}. "
+          "'quit' to exit.")
     # Read stdin UNBUFFERED (byte-at-a-time lines): any buffered layer
     # (the ``for line in sys.stdin`` iterator's read-ahead, or even
     # TextIOWrapper.readline's internal chunking) would slurp pending

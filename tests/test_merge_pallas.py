@@ -236,9 +236,10 @@ def test_full_round_equivalence_xla_vs_rr(block_c):
 
     block_c=1024 is the narrow resident stripe the N=65,536 capacity
     frontier runs (bench/frontier.py) — same kernel, 8x less VMEM per
-    stripe."""
+    stripe; it admits the smaller n, which keeps the interpret-mode cost
+    off the fast lane's critical path."""
     base = SimConfig(
-        n=4096,
+        n=4096 if block_c == 4096 else 2048,
         topology="random",
         fanout=6,
         remove_broadcast=False,
@@ -283,8 +284,13 @@ def test_stripe_and_arc_kernel_smoke():
         key = jax.random.PRNGKey(13)
         # the resident-round kernel (whole round in one pallas call, the
         # round-4 headline path) serves both random topologies: explicit
-        # edges, or arc bases via the in-stripe windowed row-max
-        kernels = ["pallas_stripe_interpret", "pallas_rr_interpret"]
+        # edges, or arc bases via the in-stripe windowed row-max.  The
+        # rr-random pairing is covered by the deeper equivalence test
+        # above, so the fast lane runs it only on the arc topology —
+        # interpret-mode rounds at n=4096 are the lane's priciest seconds
+        kernels = ["pallas_stripe_interpret"]
+        if topology == "random_arc":
+            kernels.append("pallas_rr_interpret")
         out = {}
         for kernel in ["xla"] + kernels:
             cfg = dataclasses.replace(base, merge_kernel=kernel)

@@ -417,3 +417,59 @@ class TestInteractiveHostTraffic:
         # order of magnitude under the N*N fail matrix
         assert pulled and max(pulled) <= 8 * cfg.n
         assert max(pulled) < cfg.n * cfg.n
+
+
+class TestPackedDetector:
+    """Interactive FailureDetector over the rr packed state (the
+    capacity-frontier CLI path, detector/sim.PackedDetector)."""
+
+    def _cfg(self):
+        from gossipfs_tpu.config import SimConfig
+
+        return SimConfig.packed_rr(1024, interpret=True, fanout=8)
+
+    def test_crash_detected_at_t_fail_with_first_observer(self):
+        from gossipfs_tpu.detector.sim import PackedDetector, SimDetector
+
+        cfg = self._cfg()
+        d = PackedDetector(cfg, seed=3)
+        d.advance(3)
+        d.crash(5)
+        d.advance(8)
+        ev = [e for e in d.drain_events() if e.subject == 5]
+        assert len(ev) == 1 and ev[0].round == 8  # crash@3 + t_fail 5
+        assert not ev[0].false_positive
+        assert 5 not in d.alive_nodes()
+        # the scan-path detector agrees on the FIRST detection round (its
+        # interactive path additionally re-reports the subject on later
+        # rounds as more observers fire; the packed path matches the bulk
+        # path's first-detection-only stream)
+        s = SimDetector(cfg, seed=3)
+        s.advance(3)
+        s.crash(5)
+        s.advance(8)
+        sv = [e for e in s.drain_events() if e.subject == 5]
+        assert sv and sv[0].round == 8
+
+    def test_leave_is_silent_death_and_join_raises(self):
+        import pytest
+
+        from gossipfs_tpu.detector.sim import PackedDetector
+
+        d = PackedDetector(self._cfg())
+        d.advance(3)
+        d.leave(7)
+        d.advance(8)
+        assert any(e.subject == 7 for e in d.drain_events())
+        with pytest.raises(NotImplementedError):
+            d.join(7)
+
+    def test_membership_drops_after_convergence(self):
+        from gossipfs_tpu.detector.sim import PackedDetector
+
+        d = PackedDetector(self._cfg())
+        d.advance(3)
+        d.crash(9)
+        d.advance(16)  # detection + gossip diameter
+        assert 9 not in d.membership(0)
+        assert len(d.membership(0)) == 1023
