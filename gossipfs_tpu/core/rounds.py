@@ -1110,6 +1110,19 @@ def _use_rr(config: SimConfig, n: int, nloc: int) -> bool:
     )
 
 
+def _rr_scan_eligible(config: SimConfig, n: int, nloc: int,
+                      matrix_events: bool, ctx: ShardCtx) -> bool:
+    """Single rr-scan gate, shared by the dispatch in :func:`_scan_rounds`
+    and the layout decision in :func:`_run_rounds_impl` — two separately
+    maintained copies would let the relayout and the dispatch drift (a
+    2-D state reaching the rr scan crashes its stripe-major transpose)."""
+    return (
+        ctx.axis is None
+        and not matrix_events
+        and _use_rr(config, n, nloc)
+    )
+
+
 def _scan_rounds_rr(
     state: SimState,
     config: SimConfig,
@@ -1334,11 +1347,8 @@ def _scan_rounds(
     small membership view between chunks) accumulates first-detection /
     convergence rounds exactly as one long scan would.
     """
-    if (
-        ctx.axis is None
-        and not matrix_events
-        and _use_rr(config, state.n, _nsubj(state.hb.shape))
-    ):
+    if _rr_scan_eligible(config, state.n, _nsubj(state.hb.shape),
+                         matrix_events, ctx):
         # whole round in one kernel; rejoin_rate is 0 here (a nonzero rate
         # forces matrix_events at the caller)
         return _scan_rounds_rr(
@@ -1445,6 +1455,12 @@ def _run_rounds_impl(
         events = RoundEvents(crash=zeros, leave=zeros, join=zeros)
 
     blocked = _use_blocked(config, config.fanout, n)
+    if not blocked and _rr_scan_eligible(config, n, n, matrix_events,
+                                         LOCAL_CTX):
+        # the rr scan accepts narrower stripe widths than the stripe
+        # kernels _use_blocked models (rr_supported vs stripe_supported);
+        # it consumes the blocked layout regardless
+        blocked = True
     if blocked:
         # one relayout for the whole horizon (see module header)
         state = _to_blocked(state, config)
