@@ -117,6 +117,16 @@ class SimConfig:
                                      # ALU-bound round 4x denser AND fuse
                                      # the epilogue's outputs into one pass;
                                      # requires view_dtype="int8")
+    rr_resident: str = "auto"        # resident-lanes mode of the rr kernel:
+                                     # park the raw lanes in VMEM during the
+                                     # view-build read so the receiver sweep
+                                     # re-reads nothing from HBM — the round
+                                     # moves the 4 N^2-byte information floor.
+                                     # "auto": on whenever the 3 stripes fit
+                                     # VMEM (merge_pallas.
+                                     # rr_resident_supported); "on": require
+                                     # it (error if it cannot fit); "off":
+                                     # always stream receiver blocks
     fused_tick: str = "auto"         # "auto": rounds with no join/leave events
                                      # and remove_broadcast off fuse the
                                      # heartbeat tick (bump/detect/cooldown)
@@ -184,6 +194,21 @@ class SimConfig:
                         f"n * merge_block_c <= {STRIPE_MAX_BYTES} B "
                         f"(n={self.n}, merge_block_c={self.merge_block_c})"
                     )
+                if self.rr_resident == "on":
+                    from gossipfs_tpu.ops.merge_pallas import (
+                        RR_RESIDENT_MAX_BYTES,
+                        rr_resident_supported,
+                    )
+
+                    if not rr_resident_supported(
+                        self.n, self.fanout, self.merge_block_c
+                    ):
+                        raise ValueError(
+                            "rr_resident='on' needs 3 * n * merge_block_c "
+                            f"<= {RR_RESIDENT_MAX_BYTES} B of VMEM "
+                            f"(n={self.n}, "
+                            f"merge_block_c={self.merge_block_c})"
+                        )
             else:
                 if self.merge_block_c != STRIPE_BLOCK_C:
                     raise ValueError(
@@ -200,6 +225,8 @@ class SimConfig:
                         f"n={self.n} (needs n % {STRIPE_BLOCK_C} == 0 and "
                         f"n * {STRIPE_BLOCK_C} <= {STRIPE_MAX_BYTES} B of VMEM)"
                     )
+        if self.rr_resident not in ("auto", "on", "off"):
+            raise ValueError(f"unknown rr_resident: {self.rr_resident!r}")
         if self.fused_tick not in ("auto", "off"):
             raise ValueError(f"unknown fused_tick: {self.fused_tick!r}")
         if self.view_dtype not in ("int16", "int8"):

@@ -1115,12 +1115,15 @@ def _rr_scan_eligible(config: SimConfig, n: int, nloc: int,
     """Single rr-scan gate, shared by the dispatch in :func:`_scan_rounds`
     and the layout decision in :func:`_run_rounds_impl` — two separately
     maintained copies would let the relayout and the dispatch drift (a
-    2-D state reaching the rr scan crashes its stripe-major transpose)."""
-    return (
-        ctx.axis is None
-        and not matrix_events
-        and _use_rr(config, n, nloc)
-    )
+    2-D state reaching the rr scan crashes its stripe-major transpose).
+
+    Round 5: a subject-axis shard_map ctx is eligible too — the rr scan
+    core is ctx-aware (shard-local row gather, psum'd counts/metrics), so
+    ``run_rounds_sharded`` executes the same resident-round program the
+    v5e-8 projection models.  ``nloc`` (the shard's columns) carries the
+    per-shard stripe-width divisibility through ``_use_rr``.
+    """
+    return not matrix_events and _use_rr(config, n, nloc)
 
 
 def _scan_rounds_rr(
@@ -1131,6 +1134,7 @@ def _scan_rounds_rr(
     crash_rate: float,
     churn_ok: jax.Array | None,
     mcarry0: MetricsCarry | None = None,
+    ctx: ShardCtx = LOCAL_CTX,
 ) -> tuple[SimState, MetricsCarry, RoundMetrics]:
     """The lean crash-only scan over the resident-round kernel.
 
@@ -1140,6 +1144,11 @@ def _scan_rounds_rr(
     ignored, and the per-receiver member counts feeding the small-group
     split are carried across rounds (post-merge status is next round's
     post-events status on this path, so the carried count is exact).
+
+    Under a subject-axis shard_map (``ctx.axis`` set) the lanes are this
+    shard's stripes; rows stay global, so the kernel's row gather remains
+    shard-local and only the [N]-vector member counts and metric sums
+    cross chips (ctx.psum).
     """
     from gossipfs_tpu.ops import merge_pallas
 
@@ -1156,6 +1165,7 @@ def _scan_rounds_rr(
         _scan_rounds_rr_packed(
             hb4, as4, state.alive, state.hb_base, state.round,
             config, key, events, crash_rate, churn_ok, mcarry0,
+            ctx=ctx,
         )
     )
     age_w, st_w = merge_pallas.unpack_age_status(as4)
@@ -1213,6 +1223,7 @@ def _scan_rounds_rr_packed(
     churn_ok: jax.Array | None,
     mcarry0: MetricsCarry | None = None,
     counts0: jax.Array | None = None,
+    ctx: ShardCtx = LOCAL_CTX,
 ) -> tuple:
     """The rr scan core over stripe-major PACKED lanes.
 
@@ -1224,6 +1235,14 @@ def _scan_rounds_rr_packed(
     copies exceed the chip's HBM before the scan even starts, while the
     packed pair (2 B/entry, built in place by a jitted initializer) fits
     with room for the scan.
+
+    Sharded form (``ctx.axis`` set): ``hb4``/``as4`` hold this shard's
+    stripes ([nc_local, N, cs, LANE] — rows global, columns local),
+    ``hb_base0``/``mcarry0`` are the shard's per-subject slices, and
+    ``alive``/``counts``/events stay replicated.  The kernel gets the
+    shard's global column offset for its diagonal mask; the only
+    cross-shard traffic is the [N]-vector member-count psum and the
+    scalar metric psums — the row gather never leaves the chip.
     """
     from gossipfs_tpu.ops import merge_pallas
 
@@ -1232,20 +1251,28 @@ def _scan_rounds_rr_packed(
     nc, n, cs, _ = hb4.shape
     subj_shape = (nc, cs, lane)
     c_blk = cs * lane
+    nloc = nc * c_blk
+    # floor-traffic resident lanes whenever the three stripes fit VMEM
+    # (the headline shape and the N=32,768 frontier; wider/larger shapes
+    # stream receiver blocks as before)
+    resident = config.rr_resident != "off" and (
+        merge_pallas.rr_resident_supported(n, config.fanout, c_blk, nloc)
+    )
 
     def diag(arr4):  # subject j's own row entry, stripe-major layout
-        j = jnp.arange(n)
-        return arr4[j // c_blk, j, (j % c_blk) // lane, j % lane]
+        jl = jnp.arange(nloc)          # local column index
+        rows = jl + ctx.offset         # the diagonal sits at global row j
+        return arr4[jl // c_blk, rows, (jl % c_blk) // lane, jl % lane]
 
     if counts0 is None:
         # a full pass over the packed lane; per-round drivers
         # (detector.sim.PackedDetector) thread the carried counts back in
         # instead of paying it every advance
-        counts0 = jnp.sum(
+        counts0 = ctx.psum(jnp.sum(
             (merge_pallas.unpack_age_status(as4)[1] == MEMBER)
             .astype(jnp.int32),
             axis=(0, 2, 3),
-        )
+        ))
 
     class _Cols(NamedTuple):  # what _round_stats/_update_carry consume
         alive: jax.Array
@@ -1291,29 +1318,32 @@ def _scan_rounds_rr_packed(
                 age_clamp=AGE_CLAMP, window=config.rebase_window,
                 t_fail=config.t_fail, t_cooldown=config.t_cooldown,
                 block_r=config.merge_block_r, interpret=interp,
+                resident=resident, col_offset=ctx.offset,
             )
         )
         # rcnt is lane-replicated: summing ALL lanes and dividing by LANE
         # is a contiguous reduce (the [:, :, 0] slice formulation was a
-        # strided gather, ~7x slower over the 33 MB buffer)
-        counts_next = jnp.sum(
+        # strided gather, ~7x slower over the 33 MB buffer).  Sharded:
+        # each shard's rcnt covers its own stripes — the psum completes
+        # the per-receiver count (the scan's one [N]-vector collective)
+        counts_next = ctx.psum(jnp.sum(
             rcnt.reshape(n, -1), axis=1, dtype=jnp.int32
-        ) // lane
+        ) // lane)
         cols = _Cols(alive=alive, n=n)
-        n_det = ndet.reshape(n)
-        first_obs = fobs.reshape(n)
-        metrics, any_fail = _round_stats(n_det, cols, LOCAL_CTX)
-        self_member = alive & (
+        n_det = ndet.reshape(nloc)
+        first_obs = fobs.reshape(nloc)
+        metrics, any_fail = _round_stats(n_det, cols, ctx)
+        self_member = ctx.slice_cols(alive, nloc) & (
             merge_pallas.unpack_age_status(diag(as2))[1] == MEMBER
         )
-        member_col = cnt_incl.reshape(n) - self_member.astype(jnp.int32)
+        member_col = cnt_incl.reshape(nloc) - self_member.astype(jnp.int32)
         rejoined = jnp.zeros_like(alive)  # constant: resets fold away
         mc = _update_carry(mc, cols, rejoined, any_fail, first_obs, rnd,
-                           LOCAL_CTX, member_col=member_col)
+                           ctx, member_col=member_col)
         return (hb2, as2, alive, store_base, rnd + 1, mc, counts_next), metrics
 
     if mcarry0 is None:
-        mcarry0 = MetricsCarry.init(n)
+        mcarry0 = MetricsCarry.init(nloc)
     (hb4, as4, alive, hb_base, rnd, mcarry, counts), per_round = lax.scan(
         step,
         (hb4, as4, alive0, hb_base0, round0, mcarry0, counts0),
@@ -1352,7 +1382,8 @@ def _scan_rounds(
         # whole round in one kernel; rejoin_rate is 0 here (a nonzero rate
         # forces matrix_events at the caller)
         return _scan_rounds_rr(
-            state, config, key, events, crash_rate, churn_ok, mcarry0
+            state, config, key, events, crash_rate, churn_ok, mcarry0,
+            ctx=ctx,
         )
     fused = _fused_ok(config, matrix_events, state.n, _nsubj(state.hb.shape))
 

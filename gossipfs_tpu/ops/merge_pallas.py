@@ -678,6 +678,23 @@ def rr_supported(n: int, fanout: int, c_blk: int,
     )
 
 
+# Resident-lanes VMEM budget: view stripe + both parked raw lanes
+# (3 x N x c_blk bytes) must leave room for the view-build ping-pong,
+# flags, best_scratch and Mosaic's widened temporaries inside the 128 MB.
+# 102 MB admits the headline shape (N=16,384 at c_blk<=2048) and the
+# N=32,768 frontier at c_blk=1024.
+RR_RESIDENT_MAX_BYTES = 102 * 1024 * 1024
+
+
+def rr_resident_supported(n: int, fanout: int, c_blk: int,
+                          n_cols: int | None = None) -> bool:
+    """Whether the floor-traffic resident-lanes rr variant fits VMEM."""
+    return (
+        rr_supported(n, fanout, c_blk, n_cols)
+        and 3 * n * c_blk <= RR_RESIDENT_MAX_BYTES
+    )
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -1073,6 +1090,9 @@ def arc_merge_update_blocked(
 # block are what bounds VMEM here (16 MB per temporary at 1024 rows)
 RR_CHUNK = 256
 
+# view-build DMA pipeline depth (see the chunk-loop comment in _rr_kernel)
+VSLOTS = 4
+
 
 def pack_age_status(age: jax.Array, status: jax.Array) -> jax.Array:
     """age(6b)|status(2b) into one biased int8: (age << 2 | status) - 128.
@@ -1091,45 +1111,167 @@ def unpack_age_status(asl: jax.Array) -> tuple[jax.Array, jax.Array]:
     return p >> 2, p & 3
 
 
-def _rr_tick_block(hb, age, st, act_r, ref_r, eye, g, hb_min, t_fail,
-                   t_cooldown, member, failed, unknown):
-    """The heartbeat tick on a widened int32 block (core/rounds.py _tick,
-    lean crash-only path: fresh_cooldown on, no remove broadcast).
+# ---------------------------------------------------------------------------
+# Packed in-kernel arithmetic (int32 compute over the packed int8 lanes).
+#
+# Round-5 device traces showed the rr kernel COMPUTE-bound, not
+# bandwidth-bound: with every elementwise stage stubbed out the kernel
+# streams its lanes at near-spec HBM rate (~2.4 ms/round at N=16k), while
+# the widened tick/view/merge stages added ~9 ms.  Probing Mosaic on v5e:
+# int8 vectors support only bitwise + equality (no add, no ordered
+# compare); int16 adds legalize but ordered compares don't; even bf16
+# ordered compares are rejected ("target does not support this
+# comparison") — ordered compares exist at i32 width only, so narrow-dtype
+# density is off the table.  What remains is doing LESS i32 work:
+#   * the age|status byte is never unpacked — for the packed biased byte
+#     asl = ((age << 2) | st) - 128,
+#       st == X           <=>  (asl & 3) == X
+#       st == X & age > t <=>  (asl & 3) == X  &  asl > ((t << 2) | X) - 128
+#       age := 0, st kept <=>  asl := (asl & 3) - 128
+#       st := 0, age kept <=>  asl := asl & -4       (UNKNOWN == 0)
+#       age := age + 1    <=>  asl := asl + 4        (no carry below clamp;
+#                              age == clamp <=> asl >= (clamp << 2) - 128)
+#     which deletes the unpack (+128, >>2, &3) and repack (<<2, |, -128)
+#     from both passes;
+#   * every per-subject saturation threshold is precomputed OUTSIDE the
+#     kernel (the narrow XLA formulation's thresholds, core/rounds.py
+#     _membership_update:584-638) and arrives as one int8 stack, so the
+#     merge runs the narrow path's compare/select chain with no per-element
+#     threshold math;
+#   * resident mode parks the TICKED lanes, so the receiver sweep skips
+#     the duplicate tick entirely (the single largest elementwise stage)
+#     and reconstructs the detection mask with one compare.
+# All arithmetic is i32 with a truncating int8 store, which reproduces the
+# narrow XLA path's mod-2^8 wrap semantics exactly (bit-identical; pinned
+# by the rr parity tests and the golden fuzz suite).
+# ---------------------------------------------------------------------------
 
-    Order matters and mirrors _tick exactly: small-group refresh, diagonal
-    bump (sentinel-sticky), detection over the POST-refresh age, fresh
-    cooldown stamp, then cooldown expiry over the post-detection lanes.
+# rows of the per-subject int8 threshold stack (built in
+# resident_round_blocked, one (cs, LANE) slab per stripe in-kernel)
+V_SA_N, V_SA_ALL, V_HI_N, V_THR_G, V_CMP_DEEP, V_D8, V_UP_DEEP, \
+    V_KEEP_THR, V_HI_THR, V_HAS_HI, V_SB8 = range(11)
+N_VEC = 11
+
+
+def _rr_tick_packed(hb, asl, act_r, ref_r, eye, thr_g, member, failed,
+                    t_fail, t_cooldown):
+    """The heartbeat tick over i32-widened hb + PACKED age|status.
+
+    Mirrors core/rounds.py ``_tick`` (lean crash-only path: small-group
+    refresh, sentinel-sticky diagonal bump, detection over the
+    POST-refresh age, fresh cooldown stamp, cooldown expiry — order
+    matters) on the packed byte: the hb bump wraps on the int8 store
+    exactly like the XLA narrow path's ``hb + bump`` (core/rounds.py:415),
+    and the grace compare uses the precomputed clipped threshold
+    (core/rounds.py:427-434).  Takes/returns i32.
+
+    ``fail`` carries no explicit ``~eye`` term: it is implied.  A bumped
+    diagonal has age 0 (< t_fail after the refresh/bump resets); an
+    unbumped diagonal fails another conjunct — inactive row -> ``act_r``
+    false, non-member -> the member test false, floor sentinel -> ``past``
+    false.  (_tick keeps the reference's explicit self-exclusion; dropping
+    it here removes an iota-mask AND from the hot pass — measured
+    ~0.3 ms/round at N=16k.)
     """
-    refresh = ref_r & (st == member)
-    age = jnp.where(refresh, 0, age)
-    bump = eye & act_r & (st == member) & (hb != hb_min)
-    hb = hb + bump.astype(jnp.int32)
-    age = jnp.where(bump, 0, age)
-    past = (hb > g) & (hb != hb_min)
-    fail = act_r & (st == member) & (~eye) & past & (age > t_fail)
-    st = jnp.where(fail, failed, st)
-    age = jnp.where(fail, 0, age)
-    expire = (st == failed) & (age > t_cooldown)
-    st = jnp.where(expire, unknown, st)
-    return hb, age, st, fail
+    st_bits = asl & 3
+    st_mem = st_bits == member
+    nsent = hb != -128
+    refresh = ref_r & st_mem
+    if eye is None:
+        # caller knows the diagonal does not cross this block: the whole
+        # bump chain drops out at trace time
+        asl = jnp.where(refresh, st_bits - 128, asl)
+    else:
+        bump = eye & act_r & st_mem & nsent
+        hb = hb + bump.astype(jnp.int32)
+        asl = jnp.where(refresh | bump, st_bits - 128, asl)
+    # refresh/bump preserve status, so st_mem still reads the current
+    # status here; `past` needs no sentinel re-test (the bump cannot move
+    # a lane off -128 — it is gated on nsent)
+    past = (hb >= thr_g) & nsent
+    fail = (
+        act_r & st_mem & past
+        & (asl > ((t_fail << 2) | member) - 128)
+    )
+    asl = jnp.where(fail, failed - 128, asl)
+    expire = ((asl & 3) == failed) & (asl > ((t_cooldown << 2) | failed) - 128)
+    asl = jnp.where(expire, asl & -4, asl)
+    # post-tick membership, for free: fail is the only member-removing
+    # transition (expire acts on FAILED lanes)
+    return hb, asl, fail, st_mem & ~fail
+
+
+def _wrap8(x):
+    """int8 wrap of an i32 value in [-384, 383] — the narrow XLA path's
+    mod-2^8 semantics for arithmetic whose result is COMPARED (not just
+    stored; stores wrap for free on the int8 cast)."""
+    return ((x + 128) & 255) - 128
+
+
+def _rr_merge_packed(hb, asl, best, recv, vec, member, unknown, age_clamp):
+    """Merge epilogue (advance / add / rebase / age advance), i32 packed.
+
+    Mirrors core/rounds.py ``_membership_update``'s narrow branch
+    (rounds.py:584-638) term for term; every clipped threshold arrives
+    precomputed in ``vec`` (widened i8 -> i32 values, so compares are the
+    narrow path's sign-extended compares and adds/subs wrap on the final
+    int8 store).  Returns (hb', asl') as i32.
+
+    ``lhs`` is wrapped explicitly: the reference computes it in int8, and
+    in the ``shift_a < -128`` regime (reachable after a rejoin drops the
+    per-subject base) the wrap is what keeps the compare meaningful — an
+    unwrapped i32 sum made ``advance`` unconditionally true there
+    (round-5 review finding).
+    """
+    st = asl & 3
+    any_m = best >= 0
+    advance = (
+        recv & (st == member) & any_m
+        & (best > vec[V_CMP_DEEP]) & (_wrap8(best + vec[V_SA_N]) > hb)
+    )
+    add = recv & (st == unknown) & any_m
+    upd = advance | add
+    up_val = jnp.where(best <= vec[V_UP_DEEP], -128, best + vec[V_D8])
+    keep_val = jnp.where(
+        (vec[V_HAS_HI] != 0) & (hb >= vec[V_HI_THR]),
+        127, hb - vec[V_SB8],
+    )
+    keep_val = jnp.where(hb <= vec[V_KEEP_THR], -128, keep_val)
+    new_hb = jnp.where(upd, up_val, keep_val)
+    base = jnp.where(add, member - 128, jnp.where(advance, st - 128, asl))
+    new_asl = jnp.where(base >= (age_clamp << 2) - 128, base, base + 4)
+    return new_hb, new_asl
 
 
 def _rr_kernel(
     n: int, n_fanout: int, r_blk: int, cs: int, chunk: int,
     member: int, unknown: int, failed: int, age_clamp: int,
     window: int, t_fail: int, t_cooldown: int, hb_min: int,
-    arc: bool = False,
+    arc: bool = False, resident: bool = False, unroll: int = 1,
+    view_dt=jnp.int8, stub: frozenset = frozenset(),
 ):
     nchunks = n // chunk
     nblocks = n // r_blk
 
+    mx = max(chunk, r_blk)
+
     def kernel(
-        edges_ref, flags_all,
-        sa_ref, sb_ref, g_ref, hb_any, as_any,
+        edges_ref, col0_ref, flags_all, vecs_ref, hb_any, as_any,
         hb_out, as_out, cnt_out, ndet_out, fobs_out, rcnt_out,
-        stripe, best_scratch, vbuf, vsems, rbuf, rsems,
-        *arc_scratch,
+        stripe, best_scratch, vbuf, vsems, dbuf, flbuf, *rest,
     ):
+        # resident mode parks the TICKED lanes in VMEM during the
+        # view-build pass, so the receiver sweep touches no HBM at all —
+        # the round's wire drops to the 4 N^2 information floor (read
+        # once + write once) — and skips the tick recompute entirely: a
+        # post-tick (st == FAILED, age == 0) byte can only mean THIS
+        # round's detection (stored ages are always >= 1 — the epilogue
+        # advances every age before store), so the sweep reconstructs the
+        # fail mask with one compare.
+        if resident:
+            hb_res, as_res, *arc_scratch = rest
+        else:
+            rbuf, rsems, *arc_scratch = rest
         # The raw lanes arrive ONCE, in ANY memory space; every VMEM
         # crossing is an explicit software-pipelined DMA — BlockSpec-fetched
         # lane inputs measured ~3 ms/round slower here (Mosaic serializes
@@ -1143,9 +1285,37 @@ def _rr_kernel(
         # unpipelined reload after every view build).
         j = pl.program_id(0)
         i = pl.program_id(1)
-        sa = sa_ref[0][None].astype(jnp.int32)
-        sb = sb_ref[0][None].astype(jnp.int32)
-        g = g_ref[0][None].astype(jnp.int32)
+        # global subject index of this program's first column: 0 single
+        # chip; the shard's offset under subject-axis shard_map (rows stay
+        # global, so the diagonal lives at row == global column)
+        col0 = col0_ref[0, 0]
+        # this stripe's per-subject threshold slab, (cs, LANE) rows widened
+        # once per grid step — broadcasts against (rows, cs, LANE) blocks
+        vec = [vecs_ref[k, 0].astype(jnp.int32) for k in range(N_VEC)]
+
+        # One-time iota scratch (first grid step): per-element iotas are
+        # NOT hoisted by Mosaic out of the chunk loop — recomputing the
+        # diagonal mask's two broadcasted iotas per block measured
+        # ~1.4 ms/round at N=16k.  dbuf holds row - (local col), so the
+        # diagonal test is one load + one compare against a per-block
+        # scalar (the fobs reduction reuses it: min-reducing row - col
+        # over rows and adding the column back on the reduced shape).
+        @pl.when((j == 0) & (i == 0))
+        def _():
+            r0 = lax.broadcasted_iota(jnp.int32, (mx, cs, LANE), 0)
+            cl = (lax.broadcasted_iota(jnp.int32, (mx, cs, LANE), 1) * LANE
+                  + lax.broadcasted_iota(jnp.int32, (mx, cs, LANE), 2))
+            dbuf[...] = r0 - cl
+
+        def load_flags(start, size):
+            # materialize the (size, 1, LANE) -> (size, cs, LANE) flag
+            # broadcast ONCE through scratch: Mosaic otherwise re-runs the
+            # sublane-broadcast relayout at every use (~1.6 ms/round)
+            flbuf[pl.ds(0, size)] = jnp.broadcast_to(
+                flags_all[pl.ds(start, size)].reshape(size, 1, LANE),
+                (size, cs, LANE),
+            )
+            return flbuf[pl.ds(0, size)].astype(jnp.int32)
 
         def issue_into(buf, sems, blk_rows, rows_per, slot):
             rows = pl.ds(blk_rows * rows_per, rows_per)
@@ -1163,58 +1333,105 @@ def _rr_kernel(
 
         issue = functools.partial(issue_into, vbuf, vsems)
         wait = functools.partial(wait_on, vbuf, vsems)
-        rissue = functools.partial(issue_into, rbuf, rsems)
-        rwait = functools.partial(wait_on, rbuf, rsems)
+        if not resident:
+            rissue = functools.partial(issue_into, rbuf, rsems)
+            rwait = functools.partial(wait_on, rbuf, rsems)
 
         # --- i == 0: build this stripe's gossip view in VMEM ------------
-        # chunked double-buffered DMAs over the raw lanes; the tick is
-        # recomputed on each chunk so the view reflects post-tick state.
+        # chunked DMAs over the raw lanes, pipelined VSLOTS deep: at
+        # depth 2 the per-chunk DMA latency (~2 us against a sub-us
+        # transfer at narrow stripe widths) stayed exposed and serialized
+        # the whole build — measured ~2-3 ms/round at c_blk <= 2048.
+        # Chunks stay small (the widened tick temporaries scale with the
+        # chunk and are what actually bound VMEM); only the in-flight
+        # depth grows.  The tick is recomputed on each chunk so the view
+        # reflects post-tick state.
         @pl.when(i == 0)
         def _():
             # this stripe's first receiver block rides under the view build
-            rissue(0, r_blk, 0)
-            issue(0, chunk, 0)
+            if not resident:
+                rissue(0, r_blk, 0)
+            for c0 in range(min(VSLOTS - 1, nchunks)):
+                issue(c0, chunk, c0)
 
             def body(c, _):
-                slot = lax.rem(c, 2)
+                slot = lax.rem(c, VSLOTS)
 
-                @pl.when(c + 1 < nchunks)
+                @pl.when(c + VSLOTS - 1 < nchunks)
                 def _():
-                    issue(c + 1, chunk, lax.rem(c + 1, 2))
+                    issue(c + VSLOTS - 1, chunk,
+                          lax.rem(c + VSLOTS - 1, VSLOTS))
 
                 wait(chunk, slot)
-                hb = vbuf[slot, 0].astype(jnp.int32)
-                p = vbuf[slot, 1].astype(jnp.int32) + 128
-                age, st = p >> 2, p & 3
-                fl = flags_all[pl.ds(c * chunk, chunk)].astype(jnp.int32)
-                fl = fl.reshape(chunk, 1, LANE)
-                act_r = (fl & 1) != 0
-                ref_r = (fl & 2) != 0
-                row_g = (lax.broadcasted_iota(jnp.int32, hb.shape, 0)
-                         + c * chunk)
-                col_g = (lax.broadcasted_iota(jnp.int32, hb.shape, 1) * LANE
-                         + lax.broadcasted_iota(jnp.int32, hb.shape, 2)
-                         + j * cs * LANE)
-                eye = row_g == col_g
-                hb, age, st, _fail = _rr_tick_block(
-                    hb, age, st, act_r, ref_r, eye, g, hb_min,
-                    t_fail, t_cooldown, member, failed, unknown,
-                )
-                # the gossip view: active senders' MEMBER entries within
-                # the rebase window (core/rounds.py _gossip_view, int32
-                # formulation); absent entries are -1
-                rel = hb - sa
-                goss = (
-                    (st == member) & act_r
-                    & (rel >= 0) & (rel <= window) & (hb != hb_min)
-                )
-                stripe[pl.ds(c * chunk, chunk)] = jnp.where(
-                    goss, rel, -1
-                ).astype(stripe.dtype)
+                if "vtick" in stub:
+                    if resident and "park" not in stub:
+                        hb_res[pl.ds(c * chunk, chunk)] = vbuf[slot, 0]
+                        as_res[pl.ds(c * chunk, chunk)] = vbuf[slot, 1]
+                    stripe[pl.ds(c * chunk, chunk)] = (
+                        vbuf[slot, 0].astype(stripe.dtype))
+                    return 0
+                if "noflags" in stub:
+                    act_r = ref_r = jnp.bool_(True)
+                else:
+                    flb = load_flags(c * chunk, chunk)
+                    act_r = (flb & 1) != 0
+                    ref_r = (flb & 2) != 0
+
+                def tick_view(eye):
+                    hb = vbuf[slot, 0].astype(jnp.int32)
+                    asl = vbuf[slot, 1].astype(jnp.int32)
+                    hb, asl, _fail, stm = _rr_tick_packed(
+                        hb, asl, act_r, ref_r, eye, vec[V_THR_G],
+                        member, failed, t_fail, t_cooldown,
+                    )
+                    if resident and "park" not in stub:
+                        # park the TICKED lanes: the receiver sweep reads
+                        # them back without re-ticking (int8 store wraps —
+                        # the narrow XLA path's mod-2^8 semantics)
+                        hb_res[pl.ds(c * chunk, chunk)] = hb.astype(jnp.int8)
+                        as_res[pl.ds(c * chunk, chunk)] = asl.astype(jnp.int8)
+                    # the gossip view: active senders' MEMBER entries
+                    # within the rebase window (core/rounds.py
+                    # _gossip_view, narrow formulation, rounds.py:536-556);
+                    # absent entries -1
+                    goss = (
+                        stm & act_r
+                        & ((hb >= vec[V_SA_N]) | (vec[V_SA_ALL] != 0))
+                        & (hb <= vec[V_HI_N])
+                        & (hb != -128)
+                    )
+                    rel = hb - vec[V_SA_N]
+                    if view_dt != jnp.int8:
+                        # the int8 store wraps for free; a widened stripe
+                        # must wrap explicitly or deep-shift (sa_all)
+                        # subjects store rel - 256 (round-5 review finding)
+                        rel = _wrap8(rel)
+                    stripe[pl.ds(c * chunk, chunk)] = jnp.where(
+                        goss, rel, -1
+                    ).astype(stripe.dtype)
+
+                # the diagonal crosses this stripe only in the c_blk-row
+                # band at its own columns: every other chunk skips the
+                # eye compare and the whole bump chain (fail needs no
+                # ~eye — see _rr_tick_packed's docstring)
+                dlo = j * cs * LANE + col0
+                base_row = c * chunk
+                in_band = (base_row + chunk > dlo) & (base_row < dlo
+                                                      + cs * LANE)
+                if "noeye" in stub:
+                    tick_view(None)
+                else:
+                    @pl.when(in_band)
+                    def _():
+                        tick_view(dbuf[pl.ds(0, chunk)] == dlo - base_row)
+
+                    @pl.when(~in_band)
+                    def _():
+                        tick_view(None)
                 return 0
 
             lax.fori_loop(0, nchunks, body, 0, unroll=False)
-            if arc:
+            if arc and "wmax" not in stub:
                 # arc senders are F consecutive rows: replace the stripe
                 # with its windowed row-max once, so the per-receiver
                 # merge below is ONE vector load instead of an F-way
@@ -1227,82 +1444,126 @@ def _rr_kernel(
         # prefetch the NEXT receiver block while this one is gathered and
         # merged; the last block of a stripe prefetches nothing (the next
         # stripe's i == 0 step issues its own block 0 under the view build)
-        slot = lax.rem(i, 2)
+        if not resident:
+            slot = lax.rem(i, 2)
 
-        @pl.when(i + 1 < nblocks)
-        def _():
-            rissue(i + 1, r_blk, lax.rem(i + 1, 2))
+            @pl.when(i + 1 < nblocks)
+            def _():
+                rissue(i + 1, r_blk, lax.rem(i + 1, 2))
 
         # --- every i: merge rows from the resident stripe ---------------
         # best accumulates widened (no narrow-int vector max on v5e) but
         # stores int8 — view values fit, and the narrower scratch frees
-        # VMEM for bigger row blocks
+        # VMEM for bigger row blocks.  The loop handles ``unroll`` rows
+        # per iteration with a TREE max per row: at narrow stripe widths
+        # (c_blk 1024/2048) a one-row-per-iteration chain of F dependent
+        # maxes left the VPU issue-bound — nc x N serial iterations was
+        # exactly what sank the round-4 resident-lanes attempt — while
+        # unrolled independent rows + log-depth maxes keep the load
+        # pipeline full at every stripe width.
+        # max in the stripe's own dtype where it is vector-maxable (int32 /
+        # bf16 at the narrow tile-aligned widths); int8 widens (no narrow
+        # vector max, and no ordered narrow compares either, on v5e)
+        cd = jnp.int32 if view_dt == jnp.int8 else view_dt
         if arc:
-            def gather(r, _):
-                best_scratch[r] = stripe[edges_ref[r, 0]]
+            def gather(t, _):
+                for k in range(unroll):
+                    r = t * unroll + k
+                    best_scratch[r] = stripe[edges_ref[r, 0]].astype(
+                        best_scratch.dtype)
                 return 0
         else:
-            def gather(r, _):
-                acc = stripe[edges_ref[r, 0]].astype(jnp.int32)
-                for f in range(1, n_fanout):
-                    acc = jnp.maximum(acc,
-                                      stripe[edges_ref[r, f]].astype(jnp.int32))
-                best_scratch[r] = acc.astype(best_scratch.dtype)
+            def gather(t, _):
+                for k in range(unroll):
+                    r = t * unroll + k
+                    vals = [stripe[edges_ref[r, f]].astype(cd)
+                            for f in range(n_fanout)]
+                    while len(vals) > 1:
+                        nxt = [jnp.maximum(vals[m], vals[m + 1])
+                               for m in range(0, len(vals) - 1, 2)]
+                        if len(vals) % 2:
+                            nxt.append(vals[-1])
+                        vals = nxt
+                    best_scratch[r] = vals[0].astype(best_scratch.dtype)
                 return 0
 
-        lax.fori_loop(0, r_blk, gather, 0, unroll=False)
-        rwait(r_blk, slot)
+        if "gather" not in stub:
+            lax.fori_loop(0, r_blk // unroll, gather, 0, unroll=False)
 
-        # --- tick recompute + merge epilogue on the receiver block ------
-        hb = rbuf[slot, 0].astype(jnp.int32)
-        p = rbuf[slot, 1].astype(jnp.int32) + 128
-        age, st = p >> 2, p & 3
-        fl = flags_all[pl.ds(i * r_blk, r_blk)].astype(jnp.int32)
-        fl = fl.reshape(r_blk, 1, LANE)
-        act_r = (fl & 1) != 0
-        ref_r = (fl & 2) != 0
-        recv = (fl & 4) != 0
-        row_g = lax.broadcasted_iota(jnp.int32, hb.shape, 0) + i * r_blk
-        col_g = (lax.broadcasted_iota(jnp.int32, hb.shape, 1) * LANE
-                 + lax.broadcasted_iota(jnp.int32, hb.shape, 2)
-                 + j * cs * LANE)
-        eye = row_g == col_g
-        hb, age, st, fail = _rr_tick_block(
-            hb, age, st, act_r, ref_r, eye, g, hb_min,
-            t_fail, t_cooldown, member, failed, unknown,
-        )
+        # --- tick + merge epilogue on the receiver block ----------------
+        flb = load_flags(i * r_blk, r_blk)
+        recv = (flb & 4) != 0
+        if resident:
+            rrows = pl.ds(i * r_blk, r_blk)
+            raw_hb, raw_as = hb_res[rrows], as_res[rrows]
+        else:
+            rwait(r_blk, slot)
+            raw_hb, raw_as = rbuf[slot, 0], rbuf[slot, 1]
+        if "epi" in stub:
+            hb_out[0] = raw_hb
+            as_out[0] = raw_as
+            rcnt_out[...] = jnp.zeros_like(rcnt_out)
+
+            @pl.when(i == 0)
+            def _():
+                cnt_out[...] = jnp.zeros_like(cnt_out)
+                ndet_out[...] = jnp.zeros_like(ndet_out)
+                fobs_out[...] = jnp.zeros_like(fobs_out)
+
+            return
+        if resident:
+            # parked lanes are already ticked; (FAILED, age 0) identifies
+            # this round's detections (see the parking comment above)
+            hb = raw_hb.astype(jnp.int32)
+            asl = raw_as.astype(jnp.int32)
+            fail = asl == failed - 128
+        else:
+            act_r = (flb & 1) != 0
+            ref_r = (flb & 2) != 0
+            eye = dbuf[pl.ds(0, r_blk)] == j * cs * LANE + col0 - i * r_blk
+            hb, asl, fail, _stm = _rr_tick_packed(
+                raw_hb.astype(jnp.int32), raw_as.astype(jnp.int32),
+                act_r, ref_r, eye, vec[V_THR_G],
+                member, failed, t_fail, t_cooldown,
+            )
 
         best = best_scratch[...].astype(jnp.int32)
-        any_m = best >= 0
-        advance = recv & any_m & (st == member) & (best > hb - sa)
-        add = recv & any_m & (st == unknown)
-        upd = advance | add
-        new_hb = jnp.clip(jnp.where(upd, best + (sa - sb), hb - sb),
-                          hb_min, -hb_min - 1)
+        new_hb, new_asl = _rr_merge_packed(
+            hb, asl, best, recv, vec, member, unknown, age_clamp,
+        )
         hb_out[0] = new_hb.astype(hb_out.dtype)
-        new_age = jnp.minimum(jnp.where(upd, 0, age) + 1, age_clamp)
-        st_new = jnp.where(add, member, st)
-        as_out[0] = (((new_age << 2) | st_new) - 128).astype(as_out.dtype)
+        as_out[0] = new_asl.astype(as_out.dtype)
 
         # per-subject reductions, accumulated across consecutive i steps
-        cnt_part = jnp.sum((recv & (st_new == member)).astype(jnp.int32),
+        st_mem = (new_asl & 3) == member
+        cnt_part = jnp.sum((recv & st_mem).astype(jnp.int32),
                            axis=0)[None]
         ndet_part = jnp.sum(fail.astype(jnp.int32), axis=0)[None]
-        fobs_part = jnp.min(jnp.where(fail, row_g, n), axis=0)[None]
+        # min (row - col) over rows, column added back on the reduced
+        # shape (one small iota) — avoids a full-block row iota
+        dmin = jnp.min(jnp.where(fail, dbuf[pl.ds(0, r_blk)], n), axis=0)
+        col_s = (lax.broadcasted_iota(jnp.int32, (cs, LANE), 0) * LANE
+                 + lax.broadcasted_iota(jnp.int32, (cs, LANE), 1))
+        fobs_part = jnp.where(
+            jnp.any(fail, axis=0), dmin + col_s + i * r_blk, n
+        )[None]
         # per-RECEIVER member count (next round's group-size input),
         # indexed (j, i): every block written exactly once.  The sublane
         # dim is padded to 8 (Mosaic's minimum tile) — consumers read
         # row 0 only
         # reductions stay >= 2-D throughout: a rank-1 intermediate here
         # crashes the TPU lowering (layout.h implicit_dim check)
-        rc = jnp.sum((st_new == member).astype(jnp.int32), axis=2)
-        rc = jnp.sum(rc, axis=1, keepdims=True)
-        # int16 output: a per-stripe partial count is <= cs*LANE <= 4096.
-        # At the N=65,536 frontier this buffer is [N, nc*LANE] — int16
-        # halves a gigabyte-class side output
-        rcnt_out[...] = jnp.broadcast_to(
-            rc, (rc.shape[0], LANE)
-        ).astype(rcnt_out.dtype)
+        if "rcnt" in stub:
+            rcnt_out[...] = jnp.zeros_like(rcnt_out)
+        else:
+            rc = jnp.sum(st_mem.astype(jnp.int32), axis=2)
+            rc = jnp.sum(rc, axis=1, keepdims=True)
+            # int16 output: a per-stripe partial count is <= cs*LANE <=
+            # 4096.  At the N=65,536 frontier this buffer is [N, nc*LANE]
+            # — int16 halves a gigabyte-class side output
+            rcnt_out[...] = jnp.broadcast_to(
+                rc, (rc.shape[0], LANE)
+            ).astype(rcnt_out.dtype)
 
         @pl.when(i == 0)
         def _():
@@ -1324,6 +1585,7 @@ def _rr_kernel(
     static_argnames=(
         "fanout", "member", "unknown", "failed", "age_clamp", "window",
         "t_fail", "t_cooldown", "block_r", "chunk", "interpret",
+        "resident", "gather_unroll", "_stub",
     ),
 )
 def resident_round_blocked(
@@ -1346,8 +1608,21 @@ def resident_round_blocked(
     block_r: int = _FUSED_BLOCK_R,
     chunk: int = RR_CHUNK,
     interpret: bool = False,
+    resident: bool = False,
+    gather_unroll: int | None = None,
+    col_offset: jax.Array | int = 0,
+    _stub: str = "",
 ) -> tuple[jax.Array, ...]:
     """One whole gossip round (lean crash-only fault model) in one kernel.
+
+    ``resident=True`` additionally parks the raw lanes in VMEM during the
+    view-build read, dropping the receiver sweep's HBM re-read: the round
+    then moves exactly the 4 N^2-byte information floor (each packed lane
+    read once, written once).  Requires
+    :func:`rr_resident_supported` — 3 x N x c_blk bytes of VMEM.
+    ``gather_unroll`` overrides the per-iteration row count of the merge
+    gather (default: auto by stripe width).  Bit-identical outputs across
+    both knobs (pinned by tests/test_merge_pallas.py).
 
     Contract (two int8 lanes per entry, STRIPE-MAJOR ``[nc, N, cs, LANE]``
     layout — ``blocked_shape`` transposed so each stripe's rows are
@@ -1392,13 +1667,77 @@ def resident_round_blocked(
             f"{RR_BLOCK_CS} and N*cs*LANE <= {STRIPE_MAX_BYTES} B "
             f"(N={n}, blocked cols={cs * LANE}); use the stripe/XLA path"
         )
+    if resident and not rr_resident_supported(n, fanout, cs * LANE,
+                                              nc * cs * LANE):
+        raise ValueError(
+            f"resident lanes need 3*N*c_blk <= {RR_RESIDENT_MAX_BYTES} B "
+            f"of VMEM (N={n}, c_blk={cs * LANE})"
+        )
     ch = min(chunk, n)
+    if resident:
+        # the parked lanes leave little VMEM headroom: cap the chunk so
+        # the widened tick temporaries (which scale with chunk x c_blk)
+        # fit beside them; the VSLOTS-deep pipeline keeps the smaller
+        # DMAs' latency hidden
+        ch = min(ch, max(64, (1 << 18) // (cs * LANE)))
     while n % ch:
         ch //= 2
     r_blk = max(min(block_r, n), _FUSED_BLOCK_R_MIN)
     while n % r_blk:
         r_blk //= 2
+    # auto gather unroll: one iteration should cover ~a native-tile's worth
+    # of sublanes — 4 rows at c_blk=1024, 2 at 2048, 1 at 4096
+    u = gather_unroll if gather_unroll else max(1, 4096 // (cs * LANE))
+    while r_blk % u:
+        u //= 2
     hb_min = int(jnp.iinfo(jnp.int8).min)
+
+    # Tile-aligned view stripe: int8's native tile is (32, 128) sublanes x
+    # lanes, so at narrow stripe widths (cs < 32) every per-row gather load
+    # straddles a tile and Mosaic lowers it as a slow per-load sublane
+    # rotate — the round-4 "scalar-issued gather" that sank narrow-stripe
+    # throughput.  Widening the stripe element to the dtype whose native
+    # tile height equals cs (int32 at cs=8, bf16 at cs=16 — both have
+    # native vector max, and the int8 view range [-1, 126] is exact in
+    # either) makes each row exactly one aligned tile.  The widened stripe
+    # costs the same VMEM as the c4096 int8 stripe; fall back to int8 when
+    # it cannot fit (the N=65,536 capacity frontier, where VMEM is the
+    # constraint and the gather penalty is accepted).
+    if cs >= 32:
+        view_dt, vbytes = jnp.int8, 1
+    elif cs == 16:
+        view_dt, vbytes = jnp.bfloat16, 2
+    else:
+        view_dt, vbytes = jnp.int32, 4
+    resident_extra = 2 * n * cs * LANE if resident else 0
+    if n * cs * LANE * vbytes + resident_extra > RR_RESIDENT_MAX_BYTES:
+        view_dt, vbytes = jnp.int8, 1
+
+    # per-subject int8 threshold stack for the packed in-kernel arithmetic
+    # (see the module comment above _rr_tick_packed); the int8 casts wrap
+    # mod 2^8 — exactly the narrow XLA formulation's casts
+    if unknown != 0 or not (0 <= member <= 3 and 0 <= failed <= 3):
+        raise ValueError(
+            "packed-int8 rr kernel needs UNKNOWN == 0 and 2-bit statuses"
+        )
+    i8 = jnp.int8
+    sa32 = sa.astype(jnp.int32)
+    sb32 = sb.astype(jnp.int32)
+    g32 = g.astype(jnp.int32)
+    d32 = sa32 - sb32
+    vecs = jnp.stack([
+        sa32.astype(i8),                                # V_SA_N (wraps)
+        (sa32 < -128).astype(i8),                       # V_SA_ALL
+        jnp.clip(sa32 + window, -128, 127).astype(i8),  # V_HI_N
+        jnp.clip(g32 + 1, -128, 127).astype(i8),        # V_THR_G
+        jnp.clip(-129 - sa32, -2, 127).astype(i8),      # V_CMP_DEEP
+        d32.astype(i8),                                 # V_D8 (wraps)
+        jnp.clip(-129 - d32, -2, 127).astype(i8),       # V_UP_DEEP
+        jnp.clip(sb32 - 129, -128, 127).astype(i8),     # V_KEEP_THR
+        jnp.clip(sb32 + 128, -128, 127).astype(i8),     # V_HI_THR
+        (sb32 < 0).astype(i8),                          # V_HAS_HI
+        sb32.astype(i8),                                # V_SB8 (wraps)
+    ])
 
     # stripe-major lane layout [nc, N, cs, LANE]: a stripe's rows are one
     # contiguous region, so every lane DMA block and output block is a
@@ -1414,11 +1753,25 @@ def resident_round_blocked(
     arc_scratch = [
         pltpu.VMEM((ext, cs, LANE), jnp.bfloat16),
         pltpu.VMEM((ext, cs, LANE), jnp.bfloat16),
-        pltpu.VMEM((fanout - 1, cs, LANE), jnp.int8),
+        pltpu.VMEM((fanout - 1, cs, LANE), view_dt),  # stripe-dtype halo
     ] if arc else []
+    if resident:
+        # parked raw lanes replace the receiver-block ping-pong: the sweep
+        # reads VMEM only
+        rblock_scratch = [
+            pltpu.VMEM((n, cs, LANE), jnp.int8),
+            pltpu.VMEM((n, cs, LANE), jnp.int8),
+        ]
+    else:
+        rblock_scratch = [
+            pltpu.VMEM((2, 2, r_blk, cs, LANE), jnp.int8),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ]
     out = pl.pallas_call(
         _rr_kernel(n, fanout, r_blk, cs, ch, member, unknown, failed,
-                   age_clamp, window, t_fail, t_cooldown, hb_min, arc=arc),
+                   age_clamp, window, t_fail, t_cooldown, hb_min, arc=arc,
+                   resident=resident, unroll=u, view_dt=view_dt,
+                   stub=frozenset(s for s in _stub.split(",") if s)),
         grid=(nc, n // r_blk),
         # in-place lane update: safe because every [row-block, stripe]
         # region's reads (the i==0 view-build chunk pass and the one-step-
@@ -1427,15 +1780,16 @@ def resident_round_blocked(
         # otherwise inserts for custom-call operands that are also scan
         # carries (~2.5 ms/round) and drops two [N, N] lane buffers from
         # peak HBM
-        input_output_aliases={5: 0, 6: 1},
+        input_output_aliases={4: 0, 5: 1},
         in_specs=[
             pl.BlockSpec((r_blk, ew), lambda j, i: (i, 0),
                          memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), lambda j, i: (0, 0),
+                         memory_space=pltpu.SMEM),   # global column offset
             pl.BlockSpec((n, LANE), lambda j, i: (0, 0),
                          memory_space=pltpu.VMEM),   # flags (resident)
-            subj_spec,  # sa
-            subj_spec,  # sb
-            subj_spec,  # g
+            pl.BlockSpec((N_VEC, 1, cs, LANE), lambda j, i: (0, j, 0, 0),
+                         memory_space=pltpu.VMEM),   # threshold stack
             pl.BlockSpec(memory_space=pl.ANY),   # hb       (manual DMAs)
             pl.BlockSpec(memory_space=pl.ANY),   # age|status packed
         ],
@@ -1454,18 +1808,22 @@ def resident_round_blocked(
             jax.ShapeDtypeStruct((n, nc * LANE), jnp.int16),
         ],
         scratch_shapes=[
-            pltpu.VMEM((n, cs, LANE), jnp.int8),          # view stripe
+            pltpu.VMEM((n, cs, LANE), view_dt),           # view stripe
             pltpu.VMEM((r_blk, cs, LANE), jnp.int8),      # best (narrow)
-            # separate ping-pongs: view-build chunks / receiver blocks
-            pltpu.VMEM((2, 2, ch, cs, LANE), jnp.int8),
-            pltpu.SemaphoreType.DMA((2, 2)),
-            pltpu.VMEM((2, 2, r_blk, cs, LANE), jnp.int8),
-            pltpu.SemaphoreType.DMA((2, 2)),
-        ] + arc_scratch,
+            # view-build chunk pipeline (VSLOTS deep), then the one-time
+            # iota scratch (diagonal delta) and the materialized flag
+            # broadcast, then either the receiver-block ping-pong
+            # (non-resident) or the parked ticked lanes (resident)
+            pltpu.VMEM((VSLOTS, 2, ch, cs, LANE), jnp.int8),
+            pltpu.SemaphoreType.DMA((VSLOTS, 2)),
+            pltpu.VMEM((max(ch, r_blk), cs, LANE), jnp.int32),  # dbuf
+            pltpu.VMEM((max(ch, r_blk), cs, LANE), jnp.int8),   # flbuf
+        ] + rblock_scratch + arc_scratch,
         compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=120 * 1024 * 1024),
+            vmem_limit_bytes=126 * 1024 * 1024),
         interpret=interpret,
-    )(edges, flags, sa, sb, g, hb, asl)
+    )(edges, jnp.asarray(col_offset, jnp.int32).reshape(1, 1), flags, vecs,
+      hb, asl)
     return tuple(out)
 
 

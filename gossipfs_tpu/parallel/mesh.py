@@ -81,6 +81,14 @@ def _sharded_runner(mesh, config, crash_rate, rejoin_rate, has_churn_ok,
         st = SS(hb=hb, age=age, status=status, alive=alive, round=rnd,
                 hb_base=hb_base)
         blocked = rounds._use_blocked(config, config.fanout, n, nloc)
+        if not blocked and rounds._rr_scan_eligible(
+            config, n, nloc, matrix_events, ctx
+        ):
+            # the rr scan accepts narrower per-shard stripe widths than
+            # the stripe kernels _use_blocked models; it consumes the
+            # blocked layout regardless (same shared-gate pattern as
+            # rounds._run_rounds_impl)
+            blocked = True
         if blocked:
             st = rounds._to_blocked(st, config)
         ev = RoundEvents(crash=ev_crash, leave=ev_leave, join=ev_join)

@@ -227,8 +227,13 @@ def test_full_round_equivalence_xla_vs_stripe():
 
 
 @pytest.mark.slow  # N=4096 interpreter-mode kernel run
-@pytest.mark.parametrize("block_c", [4096, 1024])
-def test_full_round_equivalence_xla_vs_rr(block_c):
+@pytest.mark.parametrize("block_c,rr_resident,topology", [
+    (4096, "off", "random"),
+    (1024, "off", "random"),
+    (1024, "on", "random"),
+    (2048, "on", "random_arc"),  # the round-5 headline shape (bench.py)
+])
+def test_full_round_equivalence_xla_vs_rr(block_c, rr_resident, topology):
     """The resident-round kernel (tick + view build + merge + reductions in
     ONE pallas call, with carried member counts and in-place lane update)
     reproduces the XLA scan bit-for-bit — states, carry, AND per-round
@@ -237,10 +242,13 @@ def test_full_round_equivalence_xla_vs_rr(block_c):
     block_c=1024 is the narrow resident stripe the N=65,536 capacity
     frontier runs (bench/frontier.py) — same kernel, 8x less VMEM per
     stripe; it admits the smaller n, which keeps the interpret-mode cost
-    off the fast lane's critical path."""
+    off the fast lane's critical path.  rr_resident="on" parks the TICKED
+    lanes in VMEM and skips the receiver sweep's tick recompute (round-5
+    floor-traffic mode) — pinned bit-identical to the streaming form and
+    to XLA here."""
     base = SimConfig(
         n=4096 if block_c == 4096 else 2048,
-        topology="random",
+        topology=topology,
         fanout=6,
         remove_broadcast=False,
         fresh_cooldown=True,
@@ -248,6 +256,7 @@ def test_full_round_equivalence_xla_vs_rr(block_c):
         view_dtype="int8",
         hb_dtype="int8",
         merge_block_c=block_c,
+        rr_resident=rr_resident,
     )
     key = jax.random.PRNGKey(17)
     out = {}
@@ -267,6 +276,49 @@ def test_full_round_equivalence_xla_vs_rr(block_c):
     assert jnp.array_equal(cx.first_detect, cp.first_detect)
     assert jnp.array_equal(cx.first_observer, cp.first_observer)
     assert jnp.array_equal(cx.converged, cp.converged)
+    assert jnp.array_equal(px.true_detections, pp.true_detections)
+    assert jnp.array_equal(px.false_positives, pp.false_positives)
+
+
+@pytest.mark.slow  # interpreter-mode kernel rounds
+@pytest.mark.parametrize("topology,rr_resident", [
+    ("random", "off"),       # widened (int32) view stripe at c_blk=1024
+    ("random_arc", "on"),    # resident parked lanes + window-maxed stripe
+])
+def test_rr_deep_shift_regime_parity(topology, rr_resident):
+    """The shift_a < -128 regime (reachable after a rejoin drops a
+    subject's base): the narrow XLA path computes its view encoding and
+    merge compare in WRAPPING int8, and the rr kernel must reproduce that
+    — an unwrapped i32 `lhs` made `advance` unconditionally true, and a
+    widened view stripe stored rel - 256 (round-5 review findings, both
+    fixed via merge_pallas._wrap8).  Synthetic state: deeply negative
+    stored diagonal + large per-subject base drives shift_a ~ -245."""
+    cfg = SimConfig(
+        n=2048, topology=topology, fanout=6, remove_broadcast=False,
+        fresh_cooldown=True, t_cooldown=12, view_dtype="int8",
+        hb_dtype="int8", merge_block_c=1024, rr_resident=rr_resident,
+    )
+    st = init_state(cfg)
+    n = cfg.n
+    hb = jnp.full((n, n), -125, jnp.int8).at[jnp.arange(n), jnp.arange(n)].set(-120)
+    # basec=400 with stored diag -120: colmax_est = 281, view_base = 155,
+    # shift_a = 155 - 400 = -245 < -128 (the V_SA_ALL regime); the -119
+    # window top admits every lane here, all rel values wrap mod 256, and
+    # the diagonal (at -120) beats the -125 receivers so the wrapped
+    # merge compare must ADVANCE them — an unwrapped kernel instead drops
+    # the whole view (rel-256 loses the max to the -1 sentinel) and
+    # keeps, so the two formulations are distinguishable entry-by-entry
+    st = st._replace(hb=hb, hb_base=jnp.full((n,), 400, jnp.int32))
+    key = jax.random.PRNGKey(5)
+    out = {}
+    for kernel in ("xla", "pallas_rr_interpret"):
+        c = dataclasses.replace(cfg, merge_kernel=kernel)
+        out[kernel] = run_rounds(st, c, 3, key, crash_rate=0.01)
+    fx, cx, px = out["xla"]
+    fp, cp, pp = out["pallas_rr_interpret"]
+    assert jnp.array_equal(fx.hb, fp.hb)
+    assert jnp.array_equal(fx.age, fp.age)
+    assert jnp.array_equal(fx.status, fp.status)
     assert jnp.array_equal(px.true_detections, pp.true_detections)
     assert jnp.array_equal(px.false_positives, pp.false_positives)
 

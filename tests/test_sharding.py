@@ -141,6 +141,55 @@ class TestShardMapRunner:
         with pytest.raises(ValueError, match="ring"):
             run_rounds_sharded(st, cfg, 5, KEY, mesh)
 
+    @pytest.mark.slow  # interpreter-mode rr kernel per shard
+    @pytest.mark.parametrize("topology", ["random_arc", "random"])
+    def test_sharded_rr_matches_single_chip(self, topology):
+        """Round-5: the RESIDENT-ROUND program itself in shard_map form —
+        the same one-kernel round the single-chip headline runs, with the
+        shard's column offset feeding the kernel's diagonal mask and only
+        the [N]-vector member-count psum crossing shards.  Bit-identical
+        states, carry, and per-round metrics vs the single-chip rr scan
+        (which is itself fuzz-pinned to the XLA oracle)."""
+        from gossipfs_tpu.parallel.mesh import run_rounds_sharded
+
+        cfg = SimConfig(
+            n=2048, topology=topology, fanout=6, remove_broadcast=False,
+            fresh_cooldown=True, t_cooldown=12, view_dtype="int8",
+            hb_dtype="int8", merge_block_c=1024,
+            merge_kernel="pallas_rr_interpret",
+        )
+        base = run_rounds(init_state(cfg), cfg, 6, KEY, crash_rate=0.02)
+        mesh = make_mesh(2)  # nloc=1024 = one narrow stripe per shard
+        st = shard_state(init_state(cfg), mesh)
+        got = run_rounds_sharded(st, cfg, 6, KEY, mesh, crash_rate=0.02)
+        for a, b in zip(jax.tree.leaves(base), jax.tree.leaves(got)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_no_matrix_allgathers_on_rr_path(self):
+        """The sharded rr program must keep the row gather shard-local:
+        no all-gather anywhere in its compiled HLO (the zero-all-gather
+        assertion the projection paragraph cites, now on the rr form)."""
+        from gossipfs_tpu.core.state import RoundEvents
+        from gossipfs_tpu.parallel import mesh as pm
+
+        cfg = SimConfig(
+            n=2048, topology="random_arc", fanout=6, remove_broadcast=False,
+            fresh_cooldown=True, t_cooldown=12, view_dtype="int8",
+            hb_dtype="int8", merge_block_c=1024,
+            merge_kernel="pallas_rr_interpret",
+        )
+        m = make_mesh(2)
+        st = shard_state(init_state(cfg), m)
+        z = jnp.zeros((3, cfg.n), dtype=bool)
+        ev = RoundEvents(crash=z, leave=z, join=z)
+        fn = pm._sharded_runner(m, cfg, 0.02, 0.0, False,
+                                matrix_events=False)
+        hlo = fn.lower(
+            st.hb, st.age, st.status, st.alive, st.round, st.hb_base,
+            ev.crash, ev.leave, ev.join, KEY, jnp.ones((cfg.n,), bool),
+        ).compile().as_text()
+        assert "all-gather" not in hlo
+
 
 class TestPlacementBatch:
     def test_distinct_live_replicas(self):
