@@ -290,10 +290,12 @@ class PackedDetector:
     core/rounds._scan_rounds_rr_packed) and every ``advance`` runs ONE
     donated 1-round scan — which is what fits N=49,152+ interactively
     (the 2-D ``gossip_round`` path's doubled lanes measured 20.3 GB at
-    that size, past the chip).  Same FailureDetector seam as SimDetector
-    for the verbs the lean crash-only fault model carries: ``crash`` and
-    ``leave`` (silent death — no LEAVE broadcast on this path); ``join``
-    raises, matching ``run_rounds(crash_only_events=True)``'s contract.
+    that size, past the chip).  Same FailureDetector seam as SimDetector:
+    ``crash`` and ``leave`` (silent death — no LEAVE broadcast on this
+    path), and since round 5 ``join``/rejoin — applied as an O(N)
+    column/row rewrite on the packed lanes between donated scans, with
+    the introducer-push, fail-list-suppression, and fresh-incarnation
+    rebase semantics of the matrix path (zombie suppression intact).
     Detection events are synthesized by diffing the carried
     first-detection vector, so they match the scan path's first-observer
     semantics exactly.
@@ -312,6 +314,7 @@ class PackedDetector:
         self._mcarry = R.MetricsCarry.init(config.n)
         self._key = jax.random.PRNGKey(seed)
         self._pending_crash: set[int] = set()
+        self._pending_join: list[int] = []
         self._events: list[DetectionEvent] = []
 
         def one_round(hb4, as4, alive, hb_base, rnd, counts, mc, ev):
@@ -322,6 +325,89 @@ class PackedDetector:
             )
 
         self._step = jax.jit(one_round, donate_argnums=(0, 1))
+
+        def join_one(hb4, as4, alive, hb_base, counts, mc, j, crash_mask):
+            """One join on the packed lanes — O(N): a column rebase+add
+            pass, the joiner row copied from the introducer, an alive
+            flip, count deltas, and carry resets.  Mirrors the matrix
+            path's _apply_events join block (core/rounds.py:278-339,
+            itself addNewMember + the full-list push,
+            reference slave/slave.go:250-274, 430-439) op for op, so a
+            single join per advance is bit-identical to the matrix scan.
+            """
+            from gossipfs_tpu.core.state import UNKNOWN
+
+            nc, n, cs, lane = hb4.shape
+            c_blk = cs * lane
+            sj, scs, sl = j // c_blk, (j % c_blk) // lane, j % lane
+            intro = config.introducer
+            # matrix ordering: crashes land before joins in the same round
+            alive_eff = alive & ~crash_mask
+            ok = ~alive_eff[j] & alive_eff[intro]
+
+            # -- column j: rebase to base 0 (fresh incarnation's true hb 0
+            # must encode exactly; old lanes renormalize, clipping at the
+            # ceiling — ordinary zombies; sentinels stay sentinels)
+            col_hb = hb4[sj, :, scs, sl]
+            col_as = as4[sj, :, scs, sl]
+            base_j = hb_base[j]
+            sent = col_hb == jnp.int8(-128)
+            true32 = col_hb.astype(jnp.int32) + base_j
+            col_hb2 = jnp.where(
+                (base_j != 0) & ~sent,
+                jnp.clip(true32, -128, 127).astype(jnp.int8), col_hb,
+            )
+            # receivers add the joiner unless it sits on their fail list
+            # (FAILED = cooldown suppression); the introducer appends
+            # unconditionally
+            st_col = col_as.astype(jnp.int32) & 3
+            upd = (alive_eff & (st_col == int(UNKNOWN))) \
+                | (jnp.arange(n) == intro)
+            col_hb3 = jnp.where(upd, jnp.int8(0), col_hb2)
+            col_as3 = jnp.where(upd, jnp.int8(int(MEMBER) - 128), col_as)
+            okc = ok  # scalar gate
+            hb4 = hb4.at[sj, :, scs, sl].set(
+                jnp.where(okc, col_hb3, col_hb))
+            as4 = as4.at[sj, :, scs, sl].set(
+                jnp.where(okc, col_as3, col_as))
+            hb_base = hb_base.at[j].set(jnp.where(okc, 0, base_j))
+            counts = counts + (
+                okc & upd & (st_col != int(MEMBER))
+            ).astype(jnp.int32)
+
+            # -- joiner row := introducer's post-append row (the same
+            # full-list push the real joiner receives); fresh fail list
+            intro_hb = hb4[:, intro]
+            intro_as = as4[:, intro]
+            intro_mem = (intro_as.astype(jnp.int32) & 3) == int(MEMBER)
+            hz_c = jnp.clip(-hb_base, -128, 0).astype(jnp.int8).reshape(
+                nc, cs, lane)
+            row_hb = jnp.where(intro_mem, intro_hb, hz_c)
+            row_as = jnp.where(intro_mem, jnp.int8(int(MEMBER) - 128),
+                               jnp.int8(int(UNKNOWN) - 128))
+            # self entry always present, at the fresh base's encoded 0
+            row_hb = row_hb.at[sj, scs, sl].set(jnp.int8(0))
+            row_as = row_as.at[sj, scs, sl].set(jnp.int8(int(MEMBER) - 128))
+            hb4 = hb4.at[:, j].set(jnp.where(okc, row_hb, hb4[:, j]))
+            as4 = as4.at[:, j].set(jnp.where(okc, row_as, as4[:, j]))
+            alive = alive.at[j].set(alive[j] | okc)
+            cnt_row = jnp.sum(
+                ((row_as.astype(jnp.int32) & 3) == int(MEMBER))
+                .astype(jnp.int32))
+            counts = counts.at[j].set(jnp.where(okc, cnt_row, counts[j]))
+            # a rejoin resets the subject's detection/convergence clocks
+            # (core/rounds._update_carry's `rejoined` semantics)
+            mc = R.MetricsCarry(
+                first_detect=mc.first_detect.at[j].set(
+                    jnp.where(okc, -1, mc.first_detect[j])),
+                first_observer=mc.first_observer.at[j].set(
+                    jnp.where(okc, -1, mc.first_observer[j])),
+                converged=mc.converged.at[j].set(
+                    jnp.where(okc, -1, mc.converged[j])),
+            )
+            return hb4, as4, alive, hb_base, counts, mc
+
+        self._join_one = jax.jit(join_one, donate_argnums=(0, 1))
 
     @property
     def round(self) -> int:
@@ -346,10 +432,18 @@ class PackedDetector:
         self._pending_crash.add(self._check(node))
 
     def join(self, node: int) -> None:
-        raise NotImplementedError(
-            "PackedDetector runs the lean crash-only round; "
-            "use SimDetector for join/rejoin scenarios"
-        )
+        """Queue a (re)join, applied before the next round's scan.
+
+        Applied as an O(N) column/row rewrite on the packed lanes between
+        donated scans (see ``join_one`` in ``__init__``) — the round-4
+        frontier refused joins outright.  Joins within one round apply in
+        call order, each seeing the previous (the matrix path's batched
+        form lets simultaneous joiners see each other; one join per round
+        is bit-identical to it, which is the CLI's usage).
+        """
+        n = self._check(node)
+        if n not in self._pending_join:
+            self._pending_join.append(n)
 
     def advance(self, rounds: int = 1) -> None:
         n = self.config.n
@@ -358,6 +452,30 @@ class PackedDetector:
             if self._pending_crash:
                 mask[list(self._pending_crash)] = True
                 self._pending_crash.clear()
+            if self._pending_join:
+                hb4, as4, alive, hb_base, rnd, counts = self._carry
+                mc = self._mcarry
+                # host mirror of join_one's effectiveness predicate: an
+                # effective join clears the node's same-round crash bit —
+                # the matrix path applies crashes BEFORE joins, so a
+                # crash(j)+join(j) round must end with j alive
+                alive_h = np.asarray(alive).copy()
+                intro = self.config.introducer
+                for j in self._pending_join:
+                    cm = jnp.asarray(mask)
+                    hb4, as4, alive, hb_base, counts, mc = self._join_one(
+                        hb4, as4, alive, hb_base, counts, mc,
+                        jnp.int32(j), cm,
+                    )
+                    eff = (not (alive_h[j] and not mask[j])) and (
+                        alive_h[intro] and not mask[intro]
+                    )
+                    if eff:
+                        mask[j] = False
+                        alive_h[j] = True
+                self._pending_join.clear()
+                self._carry = (hb4, as4, alive, hb_base, rnd, counts)
+                self._mcarry = mc
             m = jnp.asarray(mask)
             z = jnp.zeros((1, n), dtype=bool)
             ev = RoundEvents(crash=m[None], leave=z, join=z)

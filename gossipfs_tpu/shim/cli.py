@@ -173,8 +173,9 @@ def dispatch(
             print(f"unknown command: {cmd}", file=out)
     except (IndexError, ValueError, FileNotFoundError, re.error,
             NotImplementedError) as e:
-        # NotImplementedError: mode-gated verbs (e.g. 'join' in --packed)
-        # must print an error, not kill a session holding GBs of state
+        # NotImplementedError: any future mode-gated verb must print an
+        # error, not kill a session holding GBs of state ('join' was such
+        # a verb until round 5 gave the packed frontier a join path)
         print(f"error: {e}", file=out)
     return True
 

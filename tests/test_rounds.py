@@ -451,9 +451,7 @@ class TestPackedDetector:
         sv = [e for e in s.drain_events() if e.subject == 5]
         assert sv and sv[0].round == 8
 
-    def test_leave_is_silent_death_and_join_raises(self):
-        import pytest
-
+    def test_leave_is_silent_death(self):
         from gossipfs_tpu.detector.sim import PackedDetector
 
         d = PackedDetector(self._cfg())
@@ -461,8 +459,123 @@ class TestPackedDetector:
         d.leave(7)
         d.advance(8)
         assert any(e.subject == 7 for e in d.drain_events())
-        with pytest.raises(NotImplementedError):
-            d.join(7)
+
+    def test_join_matches_matrix_scan_bit_for_bit(self):
+        """Round-5: PackedDetector.join — an O(N) column/row rewrite on
+        the packed lanes between donated scans — must reproduce the
+        matrix path's join semantics exactly.  Same key schedule, same
+        crash/rejoin timeline: final hb/age/status/alive bit-identical
+        to run_rounds with scheduled matrix events."""
+        import dataclasses
+
+        import jax.numpy as jnp
+
+        from gossipfs_tpu.core.rounds import run_rounds
+        from gossipfs_tpu.core.state import RoundEvents, init_state
+        from gossipfs_tpu.detector.sim import PackedDetector
+        from gossipfs_tpu.ops import merge_pallas
+
+        cfg = self._cfg()
+        rounds = 20
+        d = PackedDetector(cfg, seed=3)
+        d.advance(2)
+        d.crash(7)
+        d.advance(10)          # detection (t_fail 5) + cooldown expiry
+        d.join(7)
+        d.advance(rounds - 12)
+        hb4, as4, alive, hb_base, rnd, _ = d._carry
+        age_w, st_w = merge_pallas.unpack_age_status(as4)
+        tr = lambda a: a.transpose(1, 0, 2, 3)  # noqa: E731
+
+        ev = np.zeros((rounds, cfg.n), dtype=bool)
+        ev[2, 7] = True
+        join = np.zeros((rounds, cfg.n), dtype=bool)
+        join[12, 7] = True
+        z = jnp.zeros((rounds, cfg.n), dtype=bool)
+        events = RoundEvents(crash=jnp.asarray(ev), leave=z,
+                             join=jnp.asarray(join))
+        mcfg = dataclasses.replace(cfg, merge_kernel="xla")
+        final, carry, _ = run_rounds(
+            init_state(mcfg), mcfg, rounds, jax.random.PRNGKey(3),
+            events=events,
+        )
+        assert 7 in d.alive_nodes()
+        assert jnp.array_equal(final.hb.reshape(cfg.n, -1),
+                               tr(hb4).reshape(cfg.n, -1))
+        assert jnp.array_equal(final.status.reshape(cfg.n, -1),
+                               tr(st_w.astype(jnp.int8)).reshape(cfg.n, -1))
+        assert jnp.array_equal(final.age.reshape(cfg.n, -1),
+                               tr(age_w.astype(jnp.int8)).reshape(cfg.n, -1))
+        assert jnp.array_equal(final.alive, alive)
+        assert jnp.array_equal(final.hb_base, hb_base)
+        # rejoin resets the subject's detection clock in the carry
+        assert int(d._mcarry.first_detect[7]) == -1
+
+    def test_same_round_crash_and_join_leaves_node_alive(self):
+        """Matrix ordering: crashes land before joins, so crash(j)+join(j)
+        queued into the same advance ends with j ALIVE (fresh incarnation)
+        — the packed path must clear the honored crash bit, not kill the
+        joiner it just revived."""
+        import dataclasses
+
+        import jax.numpy as jnp
+
+        from gossipfs_tpu.core.rounds import run_rounds
+        from gossipfs_tpu.core.state import RoundEvents, init_state
+        from gossipfs_tpu.detector.sim import PackedDetector
+        from gossipfs_tpu.ops import merge_pallas
+
+        cfg = self._cfg()
+        d = PackedDetector(cfg, seed=3)
+        d.advance(2)
+        d.crash(7)
+        d.join(7)
+        d.advance(3)
+        assert 7 in d.alive_nodes()
+        hb4, _, alive, _, _, _ = d._carry
+        tr = lambda a: a.transpose(1, 0, 2, 3)  # noqa: E731
+
+        rounds = 5
+        ev = np.zeros((rounds, cfg.n), dtype=bool)
+        ev[2, 7] = True
+        join = np.zeros((rounds, cfg.n), dtype=bool)
+        join[2, 7] = True
+        z = jnp.zeros((rounds, cfg.n), dtype=bool)
+        events = RoundEvents(crash=jnp.asarray(ev), leave=z,
+                             join=jnp.asarray(join))
+        mcfg = dataclasses.replace(cfg, merge_kernel="xla")
+        final, _, _ = run_rounds(
+            init_state(mcfg), mcfg, rounds, jax.random.PRNGKey(3),
+            events=events,
+        )
+        assert jnp.array_equal(final.alive, alive)
+        assert jnp.array_equal(final.hb.reshape(cfg.n, -1),
+                               tr(hb4).reshape(cfg.n, -1))
+
+    def test_rejoin_within_cooldown_is_suppressed(self):
+        """Zombie suppression: a rejoin while receivers still hold the
+        FAILED (fail-list) entry must not be re-added by them — only the
+        introducer appends — matching the reference's RecentFailList gate
+        (slave.go:430-439)."""
+        from gossipfs_tpu.detector.sim import PackedDetector
+
+        cfg = self._cfg()
+        d = PackedDetector(cfg, seed=3)
+        d.advance(2)
+        d.crash(7)
+        d.advance(7)   # detected (crash@2 + t_fail 5 -> round 7), within
+                       # the t_cooldown=12 suppression window
+        d.join(7)
+        d.advance(1)
+        # joiner is alive and self-listed; a non-introducer receiver that
+        # holds the cooldown entry has NOT re-added it yet
+        assert 7 in d.alive_nodes()
+        assert 7 in d.membership(7)
+        others = [m for m in (1, 2, 3) if m != cfg.introducer]
+        assert any(7 not in d.membership(m) for m in others)
+        # gossip re-spreads the fresh incarnation once cooldown expires
+        d.advance(30)
+        assert 7 in d.membership(others[0])
 
     def test_membership_drops_after_convergence(self):
         from gossipfs_tpu.detector.sim import PackedDetector
