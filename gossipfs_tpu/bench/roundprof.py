@@ -79,6 +79,13 @@ def variants(n: int) -> dict[str, SimConfig]:
             cfg, topology="random_arc", merge_kernel="pallas_rr",
             merge_block_c=STRIPE_BLOCK_C, hb_dtype="int8", merge_block_r=256,
         )
+        # the round-5 headline: resident parked lanes at the narrower
+        # stripe — floor HBM traffic (bench.py's exact config)
+        out["rr_arc_resident"] = dataclasses.replace(
+            cfg, topology="random_arc", merge_kernel="pallas_rr",
+            merge_block_c=2048, hb_dtype="int8", merge_block_r=256,
+            rr_resident="on",
+        )
     return out
 
 
@@ -101,9 +108,10 @@ def round_bytes(cfg: SimConfig) -> dict:
       redefined floor.
     * ``pallas_rr``: the resident-round kernel's wire is TWO bytes per
       entry (hb int8 + the age|status packed byte); it reads each lane
-      stripe twice (view build + receiver sweep) and writes once, plus
-      the [N, nc·LANE] int32 per-receiver count side output (written by
-      the kernel, re-read by the scan's reduce).
+      stripe twice (view build + receiver sweep) — ONCE in resident mode,
+      which parks the ticked lanes in VMEM — and writes once, plus the
+      [N, nc·LANE] int16 per-receiver count side output (written by the
+      kernel, re-read by the scan's reduce).
     * ``pallas_stripe`` / ``pallas``: separate XLA tick+view pass (3 lane
       reads, 3 lane writes + 1 view write), kernel (view read — F-fold
       for the gather kernel's per-row DMAs, once for the stripe — + 3
@@ -119,15 +127,23 @@ def round_bytes(cfg: SimConfig) -> dict:
     f = cfg.fanout
     arc = cfg.topology == "random_arc"
     if cfg.merge_kernel.startswith("pallas_rr"):
-        from gossipfs_tpu.ops.merge_pallas import LANE
+        from gossipfs_tpu.ops.merge_pallas import LANE, rr_resident_supported
 
         nc = n // cfg.merge_block_c
         packed = nn * 2  # hb int8 + age|status packed into one byte
+        resident = cfg.rr_resident != "off" and rr_resident_supported(
+            n, cfg.fanout, cfg.merge_block_c
+        )
         phases = {
             "view_build_read": packed,
-            "receiver_read": packed,
+            # resident lanes park the ticked lanes in VMEM: the receiver
+            # sweep re-reads nothing from HBM (round 5)
+            "receiver_read": 0 if resident else packed,
             "lane_write": packed,
-            "recv_count_side": 2 * n * nc * LANE * 4,
+            # int16 side output (kernel write + scan re-read) — the int8
+            # narrowing shipped in round 4; modeling it at 4 B overstated
+            # rr bandwidth rows ~2% (round-5 advisor)
+            "recv_count_side": 2 * n * nc * LANE * 2,
         }
         total = sum(phases.values())
         return {"phases": phases, "total": total, "floor": floor}

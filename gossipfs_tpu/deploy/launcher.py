@@ -30,15 +30,37 @@ from gossipfs_tpu.shim.client import ShimClient
 
 
 def _free_port_base(span: int) -> int:
-    """A base port with ``span`` free ports above it (probe-and-hope; the
-    cluster binds within milliseconds of the probe)."""
+    """A base port with ``span`` free ports above it.
+
+    Probes EVERY port in the window — TCP and UDP both, since the cluster
+    binds gossip sockets on UDP and RPC servers on TCP — by bind-and-hold
+    before releasing the lot (round-5 advisor: the old single-ephemeral
+    probe let two concurrent clusters land overlapping windows and
+    cross-talk).  A race remains between release and the cluster's own
+    binds, but it is milliseconds wide instead of window-sized.
+    """
     for _ in range(64):
         s = socket.socket()
         s.bind(("127.0.0.1", 0))
         base = s.getsockname()[1]
         s.close()
-        if base + 2 * span < 65000:
-            return base
+        if base + span >= 65000:
+            continue
+        held: list[socket.socket] = []
+        try:
+            for p in range(base, base + span):
+                t = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                t.bind(("127.0.0.1", p))
+                held.append(t)
+                u = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                u.bind(("127.0.0.1", p))
+                held.append(u)
+        except OSError:
+            continue
+        finally:
+            for h in held:
+                h.close()
+        return base
     raise RuntimeError("no free port window")
 
 

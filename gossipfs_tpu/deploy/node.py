@@ -108,7 +108,11 @@ class NodeDaemon:
         # (file, version) with versions allocated past any pending one, so
         # concurrent writers to the same file each commit THEIR OWN plan
         # (a single slot would let writer A publish writer B's replica set)
-        self.pending: dict[tuple[str, int], list[int]] = {}
+        # (file, version) -> (planned replicas, plan time).  The timestamp
+        # lets GetPutInfo expire plans whose writer died before committing
+        # (round-5 advisor: an abandoned plan used to hold the write-
+        # conflict window open forever and leak the pending entry)
+        self.pending: dict[tuple[str, int], tuple[list[int], float]] = {}
         self.last_put: dict[str, tuple[float, str]] = {}  # file -> (time, callback)
         self._lost_at: dict[int, float] = {}              # node -> detect time
         self._repair_tick = 0
@@ -340,10 +344,15 @@ class NodeDaemon:
                 version=version, data_b64=payload,
             )
         # commit: the master publishes the new version only now that every
-        # replica holds the bytes (reference Update_file_version)
-        self.client(self.master_id).call(
+        # replica holds the bytes (reference Update_file_version).  A
+        # refused commit means the plan expired under us (we stalled past
+        # the conflict window) — report failure so the caller retries the
+        # whole put instead of believing unpublished bytes are durable
+        r = self.client(self.master_id).call(
             "UpdateFileVersion", node=self.idx, file=file, version=version
         )
+        if not r.get("ok"):
+            return {"ok": False, "expired": True}
         self.log("put", f"put {file} v{version}", file=file)
         return {"ok": True}
 
@@ -351,6 +360,17 @@ class NodeDaemon:
         file = req["file"]
         now = time.time()
         with self._lock:
+            # expire abandoned plans: a writer that took a plan and died
+            # without committing must not keep prompting later writers,
+            # and its pending entry must not leak.  A last_put stamp no
+            # newer than the expired plan belonged to that aborted write
+            stale = [(k, t) for k, (_r, t) in self.pending.items()
+                     if now - t >= WRITE_CONFLICT_WINDOW]
+            for k, t in stale:
+                del self.pending[k]
+                lp = self.last_put.get(k[0])
+                if lp and lp[0] <= t:
+                    del self.last_put[k[0]]
             prev = self.last_put.get(file)
         conflict = prev is not None and now - prev[0] < WRITE_CONFLICT_WINDOW
         if conflict and not req.get("confirm"):
@@ -384,7 +404,7 @@ class NodeDaemon:
             # never share a pending slot
             new_v = max([version] + [v for (f, v) in self.pending
                                      if f == file]) + 1
-            self.pending[(file, new_v)] = list(replicas)
+            self.pending[(file, new_v)] = (list(replicas), now)
             self.last_put[file] = (now, req.get("callback") or "")
         return {"ok": True, "conflict": conflict,
                 "replicas": list(replicas), "version": new_v}
@@ -420,10 +440,13 @@ class NodeDaemon:
                 )
             except grpc.RpcError:
                 continue
-            # a replica that missed the latest write (failed push, repair
-            # sourced from a stale holder) must not serve old bytes as
-            # current
-            if int(r.get("local_version", -1)) >= want >= 0:
+            # exact-version gate: a stale replica (failed push, repair
+            # from a stale holder) must not serve old bytes as current,
+            # and a NEWER-than-committed local version means a writer
+            # pushed and died before UpdateFileVersion — serving those
+            # bytes would be a dirty read of an aborted two-phase put
+            # (round-5 advisor)
+            if want >= 0 and int(r.get("local_version", -1)) == want:
                 return {"found": True, "data_b64": r.get("data_b64", "")}
         return {"found": False}
 
@@ -501,11 +524,20 @@ class NodeDaemon:
         """The writer's commit: the pushes landed, publish the placement."""
         file, version = req["file"], int(req["version"])
         with self._lock:
-            plan = self.pending.pop((file, version), None)
-            cur_v, holders = self.meta.get(file, (0, []))
-            if version >= cur_v:
-                self.meta[file] = (version, plan if plan is not None
-                                   else holders)
+            entry = self.pending.pop((file, version), None)
+            cur_v, _holders = self.meta.get(file, (0, []))
+            if entry is None or version < cur_v:
+                # the plan expired (writer stalled past the conflict
+                # window and the GetPutInfo sweep reclaimed it) or this
+                # is a stale duplicate: publishing would pin the version
+                # to holders that never took these bytes.  The writer
+                # must retry the whole put
+                return {"ok": False, "expired": True}
+            self.meta[file] = (version, entry[0])
+            # refresh the conflict stamp at commit: the window measures
+            # from the write that actually published
+            lp = self.last_put.get(file)
+            self.last_put[file] = (time.time(), lp[1] if lp else "")
         return {"ok": True}
 
     def Lsm(self, req, ctx):

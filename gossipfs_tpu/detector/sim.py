@@ -405,7 +405,7 @@ class PackedDetector:
                 converged=mc.converged.at[j].set(
                     jnp.where(okc, -1, mc.converged[j])),
             )
-            return hb4, as4, alive, hb_base, counts, mc
+            return hb4, as4, alive, hb_base, counts, mc, ok
 
         self._join_one = jax.jit(join_one, donate_argnums=(0, 1))
 
@@ -455,24 +455,20 @@ class PackedDetector:
             if self._pending_join:
                 hb4, as4, alive, hb_base, rnd, counts = self._carry
                 mc = self._mcarry
-                # host mirror of join_one's effectiveness predicate: an
-                # effective join clears the node's same-round crash bit —
-                # the matrix path applies crashes BEFORE joins, so a
-                # crash(j)+join(j) round must end with j alive
-                alive_h = np.asarray(alive).copy()
-                intro = self.config.introducer
+                # an effective join clears the node's same-round crash
+                # bit — the matrix path applies crashes BEFORE joins, so
+                # a crash(j)+join(j) round must end with j alive.  The
+                # device's own `ok` is the single source of truth (one
+                # scalar transfer per join — a rare verb)
                 for j in self._pending_join:
                     cm = jnp.asarray(mask)
-                    hb4, as4, alive, hb_base, counts, mc = self._join_one(
+                    (hb4, as4, alive, hb_base, counts, mc,
+                     ok) = self._join_one(
                         hb4, as4, alive, hb_base, counts, mc,
                         jnp.int32(j), cm,
                     )
-                    eff = (not (alive_h[j] and not mask[j])) and (
-                        alive_h[intro] and not mask[intro]
-                    )
-                    if eff:
+                    if bool(ok):
                         mask[j] = False
-                        alive_h[j] = True
                 self._pending_join.clear()
                 self._carry = (hb4, as4, alive, hb_base, rnd, counts)
                 self._mcarry = mc

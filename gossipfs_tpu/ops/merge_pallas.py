@@ -814,30 +814,33 @@ def stripe_merge_update_blocked(
 ARC_CHUNK = 1024
 
 
-def _windowmax_inplace(stripe, bufa, bufb, halo, fanout: int, nchunks: int):
+def _windowmax_inplace(stripe, bufa, bufb, halo, fanout: int, nchunks: int,
+                       rows: int = ARC_CHUNK):
     """Windowed row max, in place over the resident stripe.
 
     W[r] = max over view rows r..r+F-1 (mod N).  Shift-doubling to the
     largest power of two <= F, then one overlapped combine — O(log F)
     passes instead of F, amortized over every receiver reading the stripe.
+    ``rows`` is the per-chunk row count (callers shrink it at wide
+    stripes, where the bf16 ping-pong buffers would otherwise crowd VMEM).
     """
     halo[...] = stripe[0:fanout - 1]  # pre-overwrite wrap rows
     # largest power of two <= fanout
     p = 1 << (fanout.bit_length() - 1)
 
     def chunk_body(c, _):
-        base = c * ARC_CHUNK
-        ext = ARC_CHUNK + fanout - 1
-        bufa[0:ARC_CHUNK] = stripe[pl.ds(base, ARC_CHUNK)].astype(bufa.dtype)
+        base = c * rows
+        ext = rows + fanout - 1
+        bufa[0:rows] = stripe[pl.ds(base, rows)].astype(bufa.dtype)
 
         @pl.when(c == nchunks - 1)
         def _():
-            bufa[ARC_CHUNK:ext] = halo[...].astype(bufa.dtype)
+            bufa[rows:ext] = halo[...].astype(bufa.dtype)
 
         @pl.when(c < nchunks - 1)
         def _():
-            bufa[ARC_CHUNK:ext] = stripe[
-                pl.ds(base + ARC_CHUNK, fanout - 1)
+            bufa[rows:ext] = stripe[
+                pl.ds(base + rows, fanout - 1)
             ].astype(bufa.dtype)
 
         # shift-doubling ping-pong: after the step with shift s,
@@ -855,13 +858,13 @@ def _windowmax_inplace(stripe, bufa, bufb, halo, fanout: int, nchunks: int):
         # combine two p-windows into the F-window (overlap is fine
         # for max): W[r] = max(D_p[r], D_p[r + F - p])
         if p == fanout:
-            w = src[0:ARC_CHUNK]
+            w = src[0:rows]
         else:
             w = jnp.maximum(
-                src[0:ARC_CHUNK],
-                src[pl.ds(fanout - p, ARC_CHUNK)],
+                src[0:rows],
+                src[pl.ds(fanout - p, rows)],
             )
-        stripe[pl.ds(base, ARC_CHUNK)] = w.astype(stripe.dtype)
+        stripe[pl.ds(base, rows)] = w.astype(stripe.dtype)
         return 0
 
     lax.fori_loop(0, nchunks, chunk_body, 0, unroll=False)
@@ -1249,6 +1252,7 @@ def _rr_kernel(
     window: int, t_fail: int, t_cooldown: int, hb_min: int,
     arc: bool = False, resident: bool = False, unroll: int = 1,
     view_dt=jnp.int8, stub: frozenset = frozenset(),
+    arc_rows: int = ARC_CHUNK, vslots: int = VSLOTS,
 ):
     nchunks = n // chunk
     nblocks = n // r_blk
@@ -1351,16 +1355,16 @@ def _rr_kernel(
             # this stripe's first receiver block rides under the view build
             if not resident:
                 rissue(0, r_blk, 0)
-            for c0 in range(min(VSLOTS - 1, nchunks)):
+            for c0 in range(min(vslots - 1, nchunks)):
                 issue(c0, chunk, c0)
 
             def body(c, _):
-                slot = lax.rem(c, VSLOTS)
+                slot = lax.rem(c, vslots)
 
-                @pl.when(c + VSLOTS - 1 < nchunks)
+                @pl.when(c + vslots - 1 < nchunks)
                 def _():
-                    issue(c + VSLOTS - 1, chunk,
-                          lax.rem(c + VSLOTS - 1, VSLOTS))
+                    issue(c + vslots - 1, chunk,
+                          lax.rem(c + vslots - 1, vslots))
 
                 wait(chunk, slot)
                 if "vtick" in stub:
@@ -1439,7 +1443,7 @@ def _rr_kernel(
                 # amortized over every receiver)
                 bufa, bufb, halo = arc_scratch
                 _windowmax_inplace(stripe, bufa, bufb, halo, n_fanout,
-                                   n // ARC_CHUNK)
+                                   n // arc_rows, rows=arc_rows)
 
         # prefetch the NEXT receiver block while this one is gathered and
         # merged; the last block of a stripe prefetches nothing (the next
@@ -1682,6 +1686,10 @@ def resident_round_blocked(
         ch = min(ch, max(64, (1 << 18) // (cs * LANE)))
     while n % ch:
         ch //= 2
+    # pipeline depth: deep at narrow chunk DMAs (sub-us transfers whose
+    # latency a 2-slot ping-pong left exposed); 2 slots at c_blk=4096,
+    # where chunks are ~1 MB and the deep buffers crowd VMEM instead
+    vslots = VSLOTS if (resident or cs < 32) else 2
     r_blk = max(min(block_r, n), _FUSED_BLOCK_R_MIN)
     while n % r_blk:
         r_blk //= 2
@@ -1749,7 +1757,15 @@ def resident_round_blocked(
         (1, cs, LANE), lambda j, i: (j, 0, 0), memory_space=pltpu.VMEM
     )
     ew = 1 if arc else fanout
-    ext = ARC_CHUNK + fanout - 1
+    # window-max chunk rows scale down at wide stripes so the bf16
+    # ping-pong buffers stay ~2 MB (17 MB at c_blk=4096 otherwise — they
+    # crowded out the round-5 iota/flag scratches)
+    arc_rows = max(256, ARC_CHUNK * 1024 // (cs * LANE))
+    while arc and arc_rows < fanout - 1:
+        arc_rows *= 2  # halo rows must fit inside the next chunk
+    while n % arc_rows:
+        arc_rows //= 2
+    ext = arc_rows + fanout - 1
     arc_scratch = [
         pltpu.VMEM((ext, cs, LANE), jnp.bfloat16),
         pltpu.VMEM((ext, cs, LANE), jnp.bfloat16),
@@ -1771,7 +1787,8 @@ def resident_round_blocked(
         _rr_kernel(n, fanout, r_blk, cs, ch, member, unknown, failed,
                    age_clamp, window, t_fail, t_cooldown, hb_min, arc=arc,
                    resident=resident, unroll=u, view_dt=view_dt,
-                   stub=frozenset(s for s in _stub.split(",") if s)),
+                   stub=frozenset(s for s in _stub.split(",") if s),
+                   arc_rows=arc_rows, vslots=vslots),
         grid=(nc, n // r_blk),
         # in-place lane update: safe because every [row-block, stripe]
         # region's reads (the i==0 view-build chunk pass and the one-step-
@@ -1810,12 +1827,12 @@ def resident_round_blocked(
         scratch_shapes=[
             pltpu.VMEM((n, cs, LANE), view_dt),           # view stripe
             pltpu.VMEM((r_blk, cs, LANE), jnp.int8),      # best (narrow)
-            # view-build chunk pipeline (VSLOTS deep), then the one-time
-            # iota scratch (diagonal delta) and the materialized flag
-            # broadcast, then either the receiver-block ping-pong
-            # (non-resident) or the parked ticked lanes (resident)
-            pltpu.VMEM((VSLOTS, 2, ch, cs, LANE), jnp.int8),
-            pltpu.SemaphoreType.DMA((VSLOTS, 2)),
+            # view-build chunk pipeline, then the one-time iota scratch
+            # (diagonal delta) and the materialized flag broadcast, then
+            # either the receiver-block ping-pong (non-resident) or the
+            # parked ticked lanes (resident)
+            pltpu.VMEM((vslots, 2, ch, cs, LANE), jnp.int8),
+            pltpu.SemaphoreType.DMA((vslots, 2)),
             pltpu.VMEM((max(ch, r_blk), cs, LANE), jnp.int32),  # dbuf
             pltpu.VMEM((max(ch, r_blk), cs, LANE), jnp.int8),   # flbuf
         ] + rblock_scratch + arc_scratch,
