@@ -67,10 +67,15 @@ def _free_port_base(span: int) -> int:
 class Cluster:
     """n node processes + per-node ShimClients."""
 
-    def __init__(self, n: int, period: float = 0.1, root: str | None = None):
+    def __init__(self, n: int, period: float = 0.1, root: str | None = None,
+                 rpc_timeout: float = 5.0):
         self.n = n
         self.period = period
         self.root = root or tempfile.mkdtemp(prefix="gossipfs_deploy_")
+        # multi-MB puts fan out 4 replica pushes through the writer's RPC:
+        # on a loaded 1-core host the reference-size workload (5-10 MB,
+        # bench/ref_workflow.py) needs deadlines past the 5 s default
+        self.rpc_timeout = rpc_timeout
         base = _free_port_base(2 * n + 16)
         self.udp_base = base
         self.rpc_base = base + n + 8
@@ -81,7 +86,7 @@ class Cluster:
         c = self._clients.get(idx)
         if c is None:
             c = self._clients[idx] = ShimClient(
-                f"127.0.0.1:{self.rpc_base + idx}", timeout=5.0
+                f"127.0.0.1:{self.rpc_base + idx}", timeout=self.rpc_timeout
             )
         return c
 

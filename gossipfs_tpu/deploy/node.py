@@ -128,6 +128,13 @@ class NodeDaemon:
         with open(self.log_path, "a") as f:
             f.write(json.dumps(entry) + "\n")
 
+    # deadline for data-plane RPCs (multi-MB payloads — the reference's
+    # file5/file10 workload takes seconds per transfer on a loaded host);
+    # control-plane RPCs keep the snappy 3 s default so an election or
+    # repair scan over a stalled-but-connected peer cannot park the
+    # control loop for tens of seconds
+    DATA_RPC_TIMEOUT = 30.0
+
     def client(self, idx: int) -> ShimClient:
         # called from gRPC worker threads, the control loop, and announce
         # threads; grpc channels are thread-safe but the cache isn't
@@ -206,6 +213,7 @@ class NodeDaemon:
                         ok = bool(self.client(src).call(
                             "RemoteReput", source=src, target=tgt,
                             file=file, version=version,
+                            timeout=self.DATA_RPC_TIMEOUT,
                         ).get("ok"))
                     except grpc.RpcError as e:
                         ok = False
@@ -342,6 +350,7 @@ class NodeDaemon:
             self.client(int(replica)).call(
                 "PutFileData", node=int(replica), file=file,
                 version=version, data_b64=payload,
+                timeout=self.DATA_RPC_TIMEOUT,
             )
         # commit: the master publishes the new version only now that every
         # replica holds the bytes (reference Update_file_version).  A
@@ -436,7 +445,8 @@ class NodeDaemon:
                 continue
             try:
                 r = self.client(int(holder)).call(
-                    "GetFileData", node=int(holder), file=req["file"]
+                    "GetFileData", node=int(holder), file=req["file"],
+                    timeout=self.DATA_RPC_TIMEOUT,
                 )
             except grpc.RpcError:
                 continue
@@ -497,6 +507,7 @@ class NodeDaemon:
             "PutFileData", node=target, file=file,
             version=int(req.get("version", 1)),
             data_b64=base64.b64encode(data).decode(),
+            timeout=self.DATA_RPC_TIMEOUT,
         )
         self.log("reput", f"pushed {file} to {target}", file=file,
                  target=target)

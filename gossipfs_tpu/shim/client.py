@@ -35,7 +35,10 @@ class ShimClient:
         self.timeout = timeout
         self._methods: dict[str, grpc.UnaryUnaryMultiCallable] = {}
 
-    def call(self, method: str, **request):
+    def call(self, method: str, timeout: float | None = None, **request):
+        """One RPC; ``timeout`` overrides the client default per call
+        (bulk-data methods carry multi-MB payloads and need deadlines far
+        past the control-plane default)."""
         fn = self._methods.get(method)
         if fn is None:
             fn = self._methods[method] = self.channel.unary_unary(
@@ -43,6 +46,7 @@ class ShimClient:
                 request_serializer=wire.request_serializer(method),
                 response_deserializer=wire.response_deserializer(method),
             )
+        deadline = self.timeout if timeout is None else timeout
         # RESOURCE_EXHAUSTED is the server's explicit backpressure (its
         # Advance handlers fail fast instead of holding workers parked on
         # the election lock — service.py ShimServicer._advance_slots):
@@ -50,13 +54,13 @@ class ShimClient:
         delay = 0.05
         for _ in range(6):
             try:
-                return fn(request, timeout=self.timeout)
+                return fn(request, timeout=deadline)
             except grpc.RpcError as e:
                 if e.code() is not grpc.StatusCode.RESOURCE_EXHAUSTED:
                     raise
                 time.sleep(delay)
                 delay = min(delay * 2, 1.0)
-        return fn(request, timeout=self.timeout)
+        return fn(request, timeout=deadline)
 
     # -- convenience wrappers for the common verbs -------------------------
     def join(self, node: int) -> None:

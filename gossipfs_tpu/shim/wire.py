@@ -91,7 +91,22 @@ def request_deserializer(method: str):
 
 def response_serializer(method: str):
     cls = METHOD_TYPES[method][1]
-    return lambda obj: json_format.ParseDict(obj, cls()).SerializeToString()
+
+    def ser(obj):
+        try:
+            return json_format.ParseDict(obj, cls()).SerializeToString()
+        except Exception as e:
+            # grpc's C core reports only "Failed to serialize response!"
+            # and drops the Python cause; surface the method and shape of
+            # the offending reply before re-raising
+            import sys
+
+            keys = list(obj) if isinstance(obj, dict) else type(obj)
+            print(f"[wire] response serialize failed for {method}: "
+                  f"{e!r}; reply keys={keys}", file=sys.stderr, flush=True)
+            raise
+
+    return ser
 
 
 def response_deserializer(method: str):

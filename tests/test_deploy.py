@@ -93,3 +93,33 @@ def test_write_conflict_confirmation_crosses_processes(cluster):
     time.sleep(PERIOD * 2)
     got = cluster.client(2).get("c.txt")
     assert got == b"second"
+
+
+def test_reference_10node_workflow():
+    """The reference's real README workflow (README.md:8-30, the report's
+    file5/file10 measurement workload) across 10 OS processes: put /
+    update / get of 5 MB and 10 MB files, ls/store listings, kill -9 of a
+    replica holder mid-workload, quorum read through the failure window,
+    and a byte-identical post-repair get.  bench/ref_workflow.py is the
+    measured artifact (REFWORKFLOW.json); this pins the workflow in CI."""
+    from gossipfs_tpu.bench.ref_workflow import run
+
+    import grpc
+
+    try:
+        out = run(n=10, mb5=5, mb10=10, period=0.5, timeout=180.0)
+    except (RuntimeError, TimeoutError, grpc.RpcError):
+        # one retry, for INFRA failures only (boot/convergence/RPC
+        # deadline): booting ten processes while earlier cases' clusters
+        # tear down can starve the gossip loops on this 1-core host.
+        # Correctness failures (AssertionError — wrong bytes, bad quorum)
+        # are never retried: an intermittent data bug must fail the run
+        time.sleep(5.0)
+        out = run(n=10, mb5=5, mb10=10, period=0.5, timeout=180.0)
+    assert out["ok"] and out["post_repair_byte_identical"]
+    # correctness only: the latency-ordering claims (read < insert,
+    # latency grows with size) are REFWORKFLOW.json's to show — asserting
+    # them here flakes whenever the loaded 1-core host stalls one RPC
+    for k in ("insert5_s", "insert10_s", "update5_s", "read5_s",
+              "read10_s", "detect_s", "repair_s"):
+        assert out[k] >= 0
