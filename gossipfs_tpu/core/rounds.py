@@ -1192,9 +1192,9 @@ def rr_packed_init(config: SimConfig) -> tuple:
     lane = merge_pallas.LANE
     nc = n // config.merge_block_c
     cs = config.merge_block_c // lane
-    joined = int(merge_pallas.pack_age_status(
-        jnp.zeros((), jnp.int32), jnp.int32(int(MEMBER))
-    ))
+    # pack_age_status(age=0, MEMBER) as a Python constant — computing it
+    # through jnp breaks callers that jit around this initializer
+    joined = int(MEMBER) - 128
 
     @jax.jit
     def init():
