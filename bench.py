@@ -64,13 +64,18 @@ def main() -> None:
         # windowed-arc gossip: each receiver hears from fanout CONSECUTIVE
         # senders at a random base — the same shape as the reference's
         # consecutive ring neighbors (slave/slave.go:517-519), at
-        # fanout=log2(N) instead of 3.  Protocol-equivalent detection
-        # quality vs iid-random edges (bench/curves.py measures both);
-        # on-device it turns the F-way row gather into one windowed
-        # row-max + a single load.  BASELINE.md keeps the iid-random
-        # number alongside for continuity with rounds 1-4.
+        # fanout=16 (= log2(N) + 2) instead of 3.  Protocol-equivalent
+        # detection quality vs iid-random edges (bench/curves.py measures
+        # both); on-device it turns the F-way row gather into one windowed
+        # row-max + a single load.  TILE-ALIGNED arcs (arc_align=8: bases
+        # are multiples of 8, fanout two 8-groups) collapse that row-max
+        # to a group reduction riding the view build plus one pair-max —
+        # the shift-doubling passes disappear (~2 ms/round at N=16k).
+        # BASELINE.md keeps the iid-random number alongside for
+        # continuity with rounds 1-4.
         topology="random_arc" if use_tpu else "random",
-        fanout=SimConfig.log_fanout(n),
+        fanout=16 if use_tpu else SimConfig.log_fanout(n),
+        arc_align=8 if use_tpu else 1,
         remove_broadcast=False,
         fresh_cooldown=True,
         t_cooldown=12,
@@ -79,7 +84,7 @@ def main() -> None:
         # in ONE pallas call with in-place lane update; CPU keeps the XLA
         # path
         merge_kernel="pallas_rr" if use_tpu else "xla",
-        merge_block_r=256 if use_tpu else 128,
+        merge_block_r=512 if use_tpu else 128,
         # int8 rebased view (required by the stripe kernel's VMEM budget)
         view_dtype="int8",
         merge_block_c=2_048 if use_tpu else 16_384,
@@ -126,8 +131,8 @@ def main() -> None:
         json.dumps(
             {
                 "metric": (
-                    f"simulated gossip rounds/sec, N={n}, fanout=log2(N)"
-                    f"{' windowed-arc' if use_tpu else ''}, "
+                    f"simulated gossip rounds/sec, N={n}, "
+                    f"{'fanout=16 tile-aligned arc' if use_tpu else 'fanout=log2(N)'}, "
                     f"1% crash churn ({platform})"
                 ),
                 "value": round(rounds_per_sec, 2),

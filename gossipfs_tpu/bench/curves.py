@@ -40,7 +40,7 @@ TRACK = 8
 
 def sweep(ns=DEFAULT_NS, rounds=ROUNDS, crash_rate=0.01, seed=0,
           topology="random", donate=False, hb_dtype="int16",
-          time_rounds=False) -> dict:
+          time_rounds=False, arc_align=1, fanout=None) -> dict:
     """``topology`` sweeps "random" (iid fanout) or "random_arc" (windowed
     arc senders) — the arc rows must match the iid rows within noise, which
     is the protocol-equivalence evidence for the fast arc merge kernel.
@@ -61,7 +61,12 @@ def sweep(ns=DEFAULT_NS, rounds=ROUNDS, crash_rate=0.01, seed=0,
         cfg = SimConfig(
             n=n,
             topology=topology,
-            fanout=SimConfig.log_fanout(n),
+            # aligned arcs need fanout % align == 0: round log2(N) up so
+            # --arc-align works without an explicit --fanout
+            fanout=fanout or (
+                -(-SimConfig.log_fanout(n) // arc_align) * arc_align
+            ),
+            arc_align=arc_align,
             remove_broadcast=False,
             fresh_cooldown=True,
             t_cooldown=12,
@@ -119,7 +124,9 @@ def sweep(ns=DEFAULT_NS, rounds=ROUNDS, crash_rate=0.01, seed=0,
         )
     return {
         "metric": "time-to-detect & FPR vs N (rounds; 1 round == 1 s reference time)",
-        "protocol": f"{topology} fanout=log2(N), gossip-only dissemination, t_fail=5",
+        "protocol": f"{topology} fanout={fanout or 'log2(N)'}"
+                    f"{' align=' + str(arc_align) if arc_align > 1 else ''}"
+                    ", gossip-only dissemination, t_fail=5",
         "crash_churn": crash_rate,
         "rows": rows,
     }
@@ -179,6 +186,10 @@ def main(argv=None) -> None:
                    help="add measured rounds/s per row (second run)")
     p.add_argument("--donate", action="store_true",
                    help="buffer-donating scan (needed for N=32768 single-chip)")
+    p.add_argument("--arc-align", type=int, default=1,
+                   help="tile-aligned arc bases (random_arc only)")
+    p.add_argument("--fanout", type=int, default=None,
+                   help="override fanout (default log2(N))")
     p.add_argument("--t-fail-sweep", action="store_true",
                    help="sweep t_fail at fixed N instead of N")
     p.add_argument("--out", type=str, default=None)
@@ -189,7 +200,9 @@ def main(argv=None) -> None:
         doc = json.dumps(sweep(ns=tuple(args.ns), rounds=args.rounds,
                                topology=args.topology, donate=args.donate,
                                hb_dtype=args.hb_dtype,
-                               time_rounds=args.time_rounds))
+                               time_rounds=args.time_rounds,
+                               arc_align=args.arc_align,
+                               fanout=args.fanout))
     print(doc)
     if args.out:
         with open(args.out, "w") as f:

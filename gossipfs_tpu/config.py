@@ -59,6 +59,18 @@ class SimConfig:
     n: int = 1024                    # number of simulated nodes (fixed; churn via masks)
     fanout: int = 3                  # gossip in-degree per round
     topology: Topology = "ring"      # "ring" = reference parity; "random" = north star
+    arc_align: int = 1               # "random_arc" base granularity: bases are drawn
+                                     # as multiples of this, and fanout must be a
+                                     # multiple of it.  At 8, the rr kernel's windowed
+                                     # row-max collapses to one 8-way group reduction
+                                     # riding the view build plus a pair-max over
+                                     # N/8 group rows (~1 pass over the stripe
+                                     # instead of ~5 shift-doubling passes).  Aligned
+                                     # arcs may include the receiver itself — a
+                                     # provable merge no-op (the view is built from
+                                     # the same ticked state the receiver holds), so
+                                     # coverage is the plain arc's minus an O(F/N)
+                                     # correction; bench/curves.py measures parity
     t_fail: int = 5                  # rounds without hb advance before declaring failure
     t_cooldown: int = 5              # rounds a removed member stays on the fail list
     min_group: int = 4               # below this list size a node only refreshes timestamps
@@ -144,6 +156,19 @@ class SimConfig:
             raise ValueError(f"fanout must be in (0, n), got {self.fanout}")
         if self.topology == "ring" and self.fanout != 3:
             raise ValueError("ring (parity) topology is defined for fanout=3")
+        if self.arc_align < 1 or (self.arc_align & (self.arc_align - 1)):
+            raise ValueError(
+                f"arc_align must be a power of two >= 1, got {self.arc_align}"
+            )
+        if self.arc_align > 1:
+            if self.topology != "random_arc":
+                raise ValueError("arc_align > 1 requires topology='random_arc'")
+            if self.fanout % self.arc_align or self.n % self.arc_align:
+                raise ValueError(
+                    "arc_align must divide both fanout and n "
+                    f"(align={self.arc_align}, fanout={self.fanout}, "
+                    f"n={self.n})"
+                )
         if self.t_fail < 1 or self.t_cooldown < 0:
             raise ValueError("t_fail >= 1 and t_cooldown >= 0 required")
         if self.t_fail >= AGE_CLAMP or self.t_cooldown >= AGE_CLAMP:

@@ -1319,6 +1319,7 @@ def _scan_rounds_rr_packed(
                 t_fail=config.t_fail, t_cooldown=config.t_cooldown,
                 block_r=config.merge_block_r, interpret=interp,
                 resident=resident, col_offset=ctx.offset,
+                arc_align=config.arc_align,
             )
         )
         # rcnt is lane-replicated: summing ALL lanes and dividing by LANE

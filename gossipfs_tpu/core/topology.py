@@ -101,6 +101,31 @@ def random_arc_bases(key: jax.Array, n: int, fanout: int) -> jax.Array:
     return (jnp.arange(n, dtype=jnp.int32) + 1 + draw) % n
 
 
+def random_arc_bases_aligned(
+    key: jax.Array, n: int, fanout: int, align: int
+) -> jax.Array:
+    """int32 [N] arc bases drawn as multiples of ``align``.
+
+    The tile-aligned variant of :func:`random_arc_bases`: every base is a
+    multiple of ``align`` (and ``fanout`` a multiple of ``align``), so the
+    rr kernel's windowed row-max collapses to an ``align``-way group
+    reduction that rides the view build plus one pair-max over N/align
+    group rows — the O(log F) shift-doubling passes disappear.
+
+    Unlike the plain draw, an aligned arc MAY include the receiver
+    itself.  Self-inclusion is a merge no-op: the gossip view is built
+    from the same post-tick state the receiver sweep reads, so a
+    receiver's own row contributes values equal to what it already
+    holds and the strict ``advance`` compare rejects them
+    (core/rounds.py _membership_update).  Coverage is therefore the
+    plain arc's minus an O(F/N) self-overlap correction
+    (bench/curves.py measures detection parity).
+    """
+    nb = n // align
+    draw = jax.random.randint(key, (n,), 0, nb, dtype=jnp.int32)
+    return draw * align
+
+
 def arc_edges(bases: jax.Array, fanout: int) -> jax.Array:
     """Expand arc bases to explicit [N, F] in-edges (oracle / XLA path)."""
     n = bases.shape[0]
@@ -119,6 +144,10 @@ def in_edges(config: SimConfig, key: jax.Array, status: jax.Array) -> jax.Array:
     if config.topology == "ring":
         return ring_edges_from_status(status)
     if config.topology == "random_arc":
+        if config.arc_align > 1:
+            return random_arc_bases_aligned(
+                key, config.n, config.fanout, config.arc_align
+            )
         return random_arc_bases(key, config.n, config.fanout)
     return random_in_edges(key, config.n, config.fanout)
 

@@ -684,6 +684,12 @@ def rr_supported(n: int, fanout: int, c_blk: int,
 # 102 MB admits the headline shape (N=16,384 at c_blk<=2048) and the
 # N=32,768 frontier at c_blk=1024.
 RR_RESIDENT_MAX_BYTES = 102 * 1024 * 1024
+# combined ceiling for the parked lanes PLUS the aligned-arc window scratch
+# (102 MB already leaves room for the view-build/receiver/iota/flag
+# scratches against the 126 MB compiler limit; the aligned tbuf/wbuf may
+# use part of that slack, measured ~8 MB of fixed scratch at headline
+# shapes — the headline's 100.7 MB lanes + 12.6 MB aligned scratch compile)
+RR_RESIDENT_ALIGN_BUDGET = 118 * 1024 * 1024
 
 
 def rr_resident_supported(n: int, fanout: int, c_blk: int,
@@ -1252,7 +1258,7 @@ def _rr_kernel(
     window: int, t_fail: int, t_cooldown: int, hb_min: int,
     arc: bool = False, resident: bool = False, unroll: int = 1,
     view_dt=jnp.int8, stub: frozenset = frozenset(),
-    arc_rows: int = ARC_CHUNK, vslots: int = VSLOTS,
+    arc_rows: int = ARC_CHUNK, vslots: int = VSLOTS, arc_align: int = 1,
 ):
     nchunks = n // chunk
     nblocks = n // r_blk
@@ -1410,9 +1416,24 @@ def _rr_kernel(
                         # must wrap explicitly or deep-shift (sa_all)
                         # subjects store rel - 256 (round-5 review finding)
                         rel = _wrap8(rel)
-                    stripe[pl.ds(c * chunk, chunk)] = jnp.where(
-                        goss, rel, -1
-                    ).astype(stripe.dtype)
+                    enc = jnp.where(goss, rel, -1)
+                    stripe[pl.ds(c * chunk, chunk)] = enc.astype(stripe.dtype)
+                    if arc and arc_align > 1 and "wmax" not in stub:
+                        # aligned-arc group max rides the view build: the
+                        # encoded values are already live in registers, so
+                        # the windowed row-max's whole-stripe re-read (and
+                        # its O(log F) shift-doubling passes) never happens.
+                        # The max must run over the WRAPPED int8 values the
+                        # stripe stores (max-then-wrap != wrap-then-max for
+                        # deep-shift subjects whose rel straddles the wrap)
+                        # — for widened view dtypes rel is wrapped above
+                        encw = _wrap8(enc) if view_dt == jnp.int8 else enc
+                        tbuf = arc_scratch[0]
+                        gpc = chunk // arc_align
+                        gm = jnp.max(
+                            encw.reshape(gpc, arc_align, cs, LANE), axis=1
+                        )
+                        tbuf[pl.ds(c * gpc, gpc)] = gm.astype(tbuf.dtype)
 
                 # the diagonal crosses this stripe only in the c_blk-row
                 # band at its own columns: every other chunk skips the
@@ -1435,7 +1456,30 @@ def _rr_kernel(
                 return 0
 
             lax.fori_loop(0, nchunks, body, 0, unroll=False)
-            if arc and "wmax" not in stub:
+            if arc and arc_align > 1 and "wmax" not in stub:
+                # aligned arc: the group maxes T are already in tbuf (the
+                # view build wrote them).  One pair-max pass over the
+                # N/align group rows finishes the F-window:
+                # W[b] = max_{g < F/align} T[(b + g) mod nb]
+                tbuf, wbuf = arc_scratch
+                nb = n // arc_align
+                nw = n_fanout // arc_align
+                for g in range(nw - 1):
+                    tbuf[pl.ds(nb + g, 1)] = tbuf[pl.ds(g, 1)]  # wrap halo
+
+                def wbody(c, _):
+                    base = c * w_rows
+                    w = tbuf[pl.ds(base, w_rows)]
+                    for g in range(1, nw):
+                        w = jnp.maximum(w, tbuf[pl.ds(base + g, w_rows)])
+                    wbuf[pl.ds(base, w_rows)] = w.astype(wbuf.dtype)
+                    return 0
+
+                w_rows = min(nb, 256)
+                while nb % w_rows:
+                    w_rows //= 2
+                lax.fori_loop(0, nb // w_rows, wbody, 0, unroll=False)
+            elif arc and "wmax" not in stub:
                 # arc senders are F consecutive rows: replace the stripe
                 # with its windowed row-max once, so the per-receiver
                 # merge below is ONE vector load instead of an F-way
@@ -1469,7 +1513,17 @@ def _rr_kernel(
         # bf16 at the narrow tile-aligned widths); int8 widens (no narrow
         # vector max, and no ordered narrow compares either, on v5e)
         cd = jnp.int32 if view_dt == jnp.int8 else view_dt
-        if arc:
+        if arc and arc_align > 1:
+            shift = arc_align.bit_length() - 1
+            wb = arc_scratch[1]
+
+            def gather(t, _):
+                for k in range(unroll):
+                    r = t * unroll + k
+                    best_scratch[r] = wb[edges_ref[r, 0] >> shift].astype(
+                        best_scratch.dtype)
+                return 0
+        elif arc:
             def gather(t, _):
                 for k in range(unroll):
                     r = t * unroll + k
@@ -1589,7 +1643,7 @@ def _rr_kernel(
     static_argnames=(
         "fanout", "member", "unknown", "failed", "age_clamp", "window",
         "t_fail", "t_cooldown", "block_r", "chunk", "interpret",
-        "resident", "gather_unroll", "_stub",
+        "resident", "gather_unroll", "arc_align", "_stub",
     ),
 )
 def resident_round_blocked(
@@ -1615,6 +1669,7 @@ def resident_round_blocked(
     resident: bool = False,
     gather_unroll: int | None = None,
     col_offset: jax.Array | int = 0,
+    arc_align: int = 1,
     _stub: str = "",
 ) -> tuple[jax.Array, ...]:
     """One whole gossip round (lean crash-only fault model) in one kernel.
@@ -1665,17 +1720,38 @@ def resident_round_blocked(
         raise ValueError("resident round kernel requires int8 lanes")
     if arc and n % ARC_CHUNK:
         raise ValueError(f"arc resident round needs N % {ARC_CHUNK} == 0")
+    if arc_align > 1:
+        if not arc:
+            raise ValueError("arc_align > 1 requires the arc topology")
+        if arc_align & (arc_align - 1) or fanout % arc_align or n % arc_align:
+            raise ValueError(
+                "arc_align must be a power of two dividing fanout and n "
+                f"(align={arc_align}, fanout={fanout}, n={n})"
+            )
     if not rr_supported(n, fanout, cs * LANE, nc * cs * LANE):
         raise ValueError(
             f"resident round kernel needs lane-aligned N, cs*LANE in "
             f"{RR_BLOCK_CS} and N*cs*LANE <= {STRIPE_MAX_BYTES} B "
             f"(N={n}, blocked cols={cs * LANE}); use the stripe/XLA path"
         )
-    if resident and not rr_resident_supported(n, fanout, cs * LANE,
-                                              nc * cs * LANE):
+    # aligned-arc window scratch: bf16 group maxes (+wrap halo) + int8
+    # window maxes, ~0.375 * N * c_blk bytes — counted against the resident
+    # budget below so near-boundary shapes fail with THIS error, not a
+    # late Mosaic VMEM allocation failure
+    align_bytes = 0
+    if arc and arc_align > 1:
+        nb_ = n // arc_align
+        nw_ = fanout // arc_align
+        align_bytes = (nb_ + max(nw_ - 1, 1)) * cs * LANE * 2 + nb_ * cs * LANE
+    if resident and (
+        not rr_resident_supported(n, fanout, cs * LANE, nc * cs * LANE)
+        or 3 * n * cs * LANE + align_bytes > RR_RESIDENT_ALIGN_BUDGET
+    ):
         raise ValueError(
             f"resident lanes need 3*N*c_blk <= {RR_RESIDENT_MAX_BYTES} B "
-            f"of VMEM (N={n}, c_blk={cs * LANE})"
+            f"(+ {align_bytes} B aligned-arc scratch within "
+            f"{RR_RESIDENT_ALIGN_BUDGET} B total) of VMEM "
+            f"(N={n}, c_blk={cs * LANE})"
         )
     ch = min(chunk, n)
     if resident:
@@ -1686,6 +1762,16 @@ def resident_round_blocked(
         ch = min(ch, max(64, (1 << 18) // (cs * LANE)))
     while n % ch:
         ch //= 2
+    if arc_align > 1:
+        # view-build chunks must cover whole groups (the group max rides
+        # the chunk pass); applied AFTER the resident cap and the
+        # n-divisibility halving so neither can undo it
+        ch = max(ch, arc_align)
+        if ch % arc_align or n % ch:
+            raise ValueError(
+                f"arc_align={arc_align} incompatible with view-build "
+                f"chunk {ch} at n={n}"
+            )
     # pipeline depth: deep at narrow chunk DMAs (sub-us transfers whose
     # latency a 2-slot ping-pong left exposed); 2 slots at c_blk=4096,
     # where chunks are ~1 MB and the deep buffers crowd VMEM instead
@@ -1766,11 +1852,24 @@ def resident_round_blocked(
     while n % arc_rows:
         arc_rows //= 2
     ext = arc_rows + fanout - 1
-    arc_scratch = [
-        pltpu.VMEM((ext, cs, LANE), jnp.bfloat16),
-        pltpu.VMEM((ext, cs, LANE), jnp.bfloat16),
-        pltpu.VMEM((fanout - 1, cs, LANE), view_dt),  # stripe-dtype halo
-    ] if arc else []
+    if arc and arc_align > 1:
+        # tile-aligned arc: T (bf16 group maxes + wrap halo) and W (int8
+        # window maxes over F/align groups, what the gather reads).  The
+        # chunked view build must emit whole groups per chunk.
+        nb = n // arc_align
+        nw = fanout // arc_align
+        arc_scratch = [
+            pltpu.VMEM((nb + max(nw - 1, 1), cs, LANE), jnp.bfloat16),
+            pltpu.VMEM((nb, cs, LANE), jnp.int8),
+        ]
+    elif arc:
+        arc_scratch = [
+            pltpu.VMEM((ext, cs, LANE), jnp.bfloat16),
+            pltpu.VMEM((ext, cs, LANE), jnp.bfloat16),
+            pltpu.VMEM((fanout - 1, cs, LANE), view_dt),  # stripe-dtype halo
+        ]
+    else:
+        arc_scratch = []
     if resident:
         # parked raw lanes replace the receiver-block ping-pong: the sweep
         # reads VMEM only
@@ -1788,7 +1887,7 @@ def resident_round_blocked(
                    age_clamp, window, t_fail, t_cooldown, hb_min, arc=arc,
                    resident=resident, unroll=u, view_dt=view_dt,
                    stub=frozenset(s for s in _stub.split(",") if s),
-                   arc_rows=arc_rows, vslots=vslots),
+                   arc_rows=arc_rows, vslots=vslots, arc_align=arc_align),
         grid=(nc, n // r_blk),
         # in-place lane update: safe because every [row-block, stripe]
         # region's reads (the i==0 view-build chunk pass and the one-step-

@@ -227,13 +227,19 @@ def test_full_round_equivalence_xla_vs_stripe():
 
 
 @pytest.mark.slow  # N=4096 interpreter-mode kernel run
-@pytest.mark.parametrize("block_c,rr_resident,topology", [
-    (4096, "off", "random"),
-    (1024, "off", "random"),
-    (1024, "on", "random"),
-    (2048, "on", "random_arc"),  # the round-5 headline shape (bench.py)
+@pytest.mark.parametrize("block_c,rr_resident,topology,arc_align", [
+    (4096, "off", "random", 1),
+    (1024, "off", "random", 1),
+    (1024, "on", "random", 1),
+    (2048, "on", "random_arc", 1),
+    # the round-5 headline shape (bench.py): tile-aligned arcs — bases are
+    # multiples of 8, the kernel's window-max is a group reduction riding
+    # the view build + one pair-max, and the XLA oracle expands the same
+    # aligned bases, so the two paths must stay bit-identical
+    (2048, "on", "random_arc", 8),
 ])
-def test_full_round_equivalence_xla_vs_rr(block_c, rr_resident, topology):
+def test_full_round_equivalence_xla_vs_rr(block_c, rr_resident, topology,
+                                          arc_align):
     """The resident-round kernel (tick + view build + merge + reductions in
     ONE pallas call, with carried member counts and in-place lane update)
     reproduces the XLA scan bit-for-bit — states, carry, AND per-round
@@ -249,7 +255,8 @@ def test_full_round_equivalence_xla_vs_rr(block_c, rr_resident, topology):
     base = SimConfig(
         n=4096 if block_c == 4096 else 2048,
         topology=topology,
-        fanout=6,
+        fanout=16 if arc_align > 1 else 6,
+        arc_align=arc_align,
         remove_broadcast=False,
         fresh_cooldown=True,
         t_cooldown=12,
@@ -281,11 +288,17 @@ def test_full_round_equivalence_xla_vs_rr(block_c, rr_resident, topology):
 
 
 @pytest.mark.slow  # interpreter-mode kernel rounds
-@pytest.mark.parametrize("topology,rr_resident", [
-    ("random", "off"),       # widened (int32) view stripe at c_blk=1024
-    ("random_arc", "on"),    # resident parked lanes + window-maxed stripe
+@pytest.mark.parametrize("topology,rr_resident,arc_align", [
+    ("random", "off", 1),     # widened (int32) view stripe at c_blk=1024
+    ("random_arc", "on", 1),  # resident parked lanes + window-maxed stripe
+    # tile-aligned arc on an INT8 view stripe (c_blk=4096, cs=32): the
+    # group max must run over the WRAPPED encodings — max-then-wrap picks
+    # the wrong sender for deep-shift subjects whose rel straddles the
+    # wrap (round-5 review finding; the bf16-stripe parity test above
+    # cannot see it because widened stripes wrap rel before the max)
+    ("random_arc", "on", 8),
 ])
-def test_rr_deep_shift_regime_parity(topology, rr_resident):
+def test_rr_deep_shift_regime_parity(topology, rr_resident, arc_align):
     """The shift_a < -128 regime (reachable after a rejoin drops a
     subject's base): the narrow XLA path computes its view encoding and
     merge compare in WRAPPING int8, and the rr kernel must reproduce that
@@ -294,9 +307,13 @@ def test_rr_deep_shift_regime_parity(topology, rr_resident):
     fixed via merge_pallas._wrap8).  Synthetic state: deeply negative
     stored diagonal + large per-subject base drives shift_a ~ -245."""
     cfg = SimConfig(
-        n=2048, topology=topology, fanout=6, remove_broadcast=False,
+        n=4096 if arc_align > 1 else 2048, topology=topology,
+        fanout=16 if arc_align > 1 else 6, arc_align=arc_align,
+        remove_broadcast=False,
         fresh_cooldown=True, t_cooldown=12, view_dtype="int8",
-        hb_dtype="int8", merge_block_c=1024, rr_resident=rr_resident,
+        hb_dtype="int8",
+        merge_block_c=4096 if arc_align > 1 else 1024,
+        rr_resident=rr_resident,
     )
     st = init_state(cfg)
     n = cfg.n

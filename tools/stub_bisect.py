@@ -1,0 +1,105 @@
+"""Stage-cost bisection of the resident-round kernel (headline shapes).
+
+Times the raw kernel (100-round scan, carried lanes) with stages stubbed
+out, isolating each stage's cost in the CURRENT build:
+
+    vtick  - view-build tick + view encode replaced by a raw copy
+    wmax   - the arc windowed row-max skipped
+    gather - the per-receiver row gather skipped
+    epi    - merge epilogue + every reduction replaced by a passthrough
+    rcnt   - the per-receiver member-count side output zeroed
+
+    JAX_PLATFORMS=axon python tools/stub_bisect.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from gossipfs_tpu.ops import merge_pallas
+from gossipfs_tpu.config import AGE_CLAMP
+from gossipfs_tpu.core.state import FAILED, MEMBER, UNKNOWN
+
+LANE = merge_pallas.LANE
+
+
+def build_inputs(n, c_blk, fanout, key):
+    nc, cs = n // c_blk, c_blk // LANE
+    ks = jax.random.split(key, 4)
+    hb = jax.random.randint(ks[0], (nc, n, cs, LANE), -128, 127, jnp.int8)
+    age = jax.random.randint(ks[1], (nc, n, cs, LANE), 0, 40, jnp.int32)
+    st = jax.random.randint(ks[2], (nc, n, cs, LANE), 0, 3, jnp.int32)
+    asl = merge_pallas.pack_age_status(age, st)
+    flags = jnp.broadcast_to(
+        jnp.int8(1 + 4), (n, LANE)).astype(jnp.int8)  # active + alive
+    sa = jnp.zeros((nc, cs, LANE), jnp.int32)
+    sb = jnp.zeros((nc, cs, LANE), jnp.int32)
+    g = jnp.full((nc, cs, LANE), -120, jnp.int32)
+    bases = jax.random.randint(ks[3], (n, 1), 0, n, jnp.int32)
+    return hb, asl, flags, sa, sb, g, bases
+
+
+def time_stub(n, c_blk, block_r, fanout, stub, rounds, reps):
+    hb, asl, flags, sa, sb, g, bases = build_inputs(
+        n, c_blk, fanout, jax.random.PRNGKey(0))
+
+    kern = functools.partial(
+        merge_pallas.resident_round_blocked,
+        fanout=fanout, member=int(MEMBER), unknown=int(UNKNOWN),
+        failed=int(FAILED), age_clamp=AGE_CLAMP, window=126,
+        t_fail=5, t_cooldown=12, block_r=block_r, resident=True,
+        _stub=stub,
+    )
+
+    @jax.jit
+    def run(hb, asl):
+        def step(carry, _):
+            hb, asl = carry
+            out = kern(bases, hb, asl, flags, sa, sb, g)
+            return (out[0], out[1]), out[3].sum()
+        (hb, asl), s = lax.scan(step, (hb, asl), None, length=rounds)
+        return hb, asl, s
+
+    out = run(hb, asl)
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = run(hb, asl)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+        time.sleep(1.0)
+    return best
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--n", type=int, default=16_384)
+    p.add_argument("--block-c", type=int, default=2_048)
+    p.add_argument("--block-r", type=int, default=512)
+    p.add_argument("--rounds", type=int, default=100)
+    p.add_argument("--reps", type=int, default=3)
+    p.add_argument("--stubs", nargs="*", default=[
+        "", "rcnt", "gather", "wmax,gather", "epi", "epi,rcnt",
+        "vtick", "vtick,wmax,gather,epi,rcnt",
+    ])
+    args = p.parse_args()
+    fanout = max(1, args.n.bit_length() - 1)
+    for stub in args.stubs:
+        el = time_stub(args.n, args.block_c, args.block_r, fanout,
+                       stub, args.rounds, args.reps)
+        print(json.dumps({
+            "stub": stub or "(full)",
+            "ms_per_round": round(el / args.rounds * 1e3, 3),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,77 @@
+"""Sweep rr-kernel tuning knobs for the headline config (N=16,384).
+
+Times the EXACT bench.py program (run_rounds, tile-aligned random_arc
+fanout=16 arc_align=8, resident rr, 1% crash churn) across
+merge_block_c x merge_block_r, printing one JSON line per point.  Best-of-k timing per point to shrug off ambient chip
+contention between points (the same hygiene bench.py uses).
+
+    JAX_PLATFORMS=axon python tools/sweep_rr.py --rounds 100 --reps 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import time
+
+import jax
+
+from gossipfs_tpu.config import SimConfig
+from gossipfs_tpu.core.rounds import run_rounds
+from gossipfs_tpu.core.state import init_state
+from gossipfs_tpu.ops import merge_pallas
+
+
+def time_point(n, block_c, block_r, rounds, reps, arc_align=8, fanout=16):
+    cfg = SimConfig(
+        n=n, topology="random_arc", fanout=fanout, arc_align=arc_align,
+        remove_broadcast=False, fresh_cooldown=True, t_cooldown=12,
+        merge_kernel="pallas_rr", merge_block_r=block_r,
+        view_dtype="int8", merge_block_c=block_c,
+        rr_resident="on", hb_dtype="int8",
+    )
+    key = jax.random.PRNGKey(0)
+    state = init_state(cfg)
+    st, mc, pr = run_rounds(state, cfg, rounds, key, crash_rate=0.01)
+    jax.block_until_ready(st)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        st, mc, pr = run_rounds(state, cfg, rounds, key, crash_rate=0.01)
+        jax.block_until_ready(st)
+        best = min(best, time.perf_counter() - t0)
+        time.sleep(1.0)
+    return best
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--n", type=int, default=16_384)
+    p.add_argument("--rounds", type=int, default=100)
+    p.add_argument("--reps", type=int, default=4)
+    p.add_argument("--block-c", nargs="*", type=int,
+                   default=[1024, 2048])
+    p.add_argument("--block-r", nargs="*", type=int,
+                   default=[128, 256, 512])
+    p.add_argument("--arc-align", type=int, default=8)
+    p.add_argument("--fanout", type=int, default=16)
+    args = p.parse_args()
+
+    for bc, br in itertools.product(args.block_c, args.block_r):
+        if not merge_pallas.rr_resident_supported(
+                args.n, args.fanout, bc):
+            print(json.dumps({"block_c": bc, "block_r": br,
+                              "skipped": "no resident VMEM fit"}))
+            continue
+        el = time_point(args.n, bc, br, args.rounds, args.reps,
+                        arc_align=args.arc_align, fanout=args.fanout)
+        print(json.dumps({
+            "block_c": bc, "block_r": br,
+            "ms_per_round": round(el / args.rounds * 1e3, 3),
+            "rounds_per_sec": round(args.rounds / el, 2),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
