@@ -124,8 +124,12 @@ def sweep(ns=DEFAULT_NS, rounds=ROUNDS, crash_rate=0.01, seed=0,
         )
     return {
         "metric": "time-to-detect & FPR vs N (rounds; 1 round == 1 s reference time)",
-        "protocol": f"{topology} fanout={fanout or 'log2(N)'}"
-                    f"{' align=' + str(arc_align) if arc_align > 1 else ''}"
+        # per-row fanout is authoritative (rows[i]['fanout']); the header
+        # names the rule: explicit, or log2(N) rounded up to the alignment
+        "protocol": f"{topology} "
+                    f"fanout={fanout if fanout else 'log2(N)'}"
+                    f"{' rounded up to align=' + str(arc_align) if arc_align > 1 and not fanout else ''}"
+                    f"{' align=' + str(arc_align) if arc_align > 1 and fanout else ''}"
                     ", gossip-only dissemination, t_fail=5",
         "crash_churn": crash_rate,
         "rows": rows,

@@ -226,7 +226,9 @@ class SimConfig:
                     )
 
                     if not rr_resident_supported(
-                        self.n, self.fanout, self.merge_block_c
+                        self.n, self.fanout, self.merge_block_c,
+                        arc_align=(self.arc_align
+                                   if self.topology == "random_arc" else 1),
                     ):
                         raise ValueError(
                             "rr_resident='on' needs 3 * n * merge_block_c "

@@ -1256,7 +1256,11 @@ def _scan_rounds_rr_packed(
     # (the headline shape and the N=32,768 frontier; wider/larger shapes
     # stream receiver blocks as before)
     resident = config.rr_resident != "off" and (
-        merge_pallas.rr_resident_supported(n, config.fanout, c_blk, nloc)
+        merge_pallas.rr_resident_supported(
+            n, config.fanout, c_blk, nloc,
+            arc_align=(config.arc_align
+                       if config.topology == "random_arc" else 1),
+        )
     )
 
     def diag(arr4):  # subject j's own row entry, stripe-major layout

@@ -121,6 +121,11 @@ def random_arc_bases_aligned(
     plain arc's minus an O(F/N) self-overlap correction
     (bench/curves.py measures detection parity).
     """
+    if fanout % align or n % align:
+        raise ValueError(
+            f"aligned arc needs align | fanout and align | n "
+            f"(align={align}, fanout={fanout}, n={n})"
+        )
     nb = n // align
     draw = jax.random.randint(key, (n,), 0, nb, dtype=jnp.int32)
     return draw * align

@@ -692,12 +692,31 @@ RR_RESIDENT_MAX_BYTES = 102 * 1024 * 1024
 RR_RESIDENT_ALIGN_BUDGET = 118 * 1024 * 1024
 
 
+def rr_align_scratch_bytes(n: int, fanout: int, c_blk: int,
+                           arc_align: int) -> int:
+    """VMEM the aligned-arc window scratch needs: bf16 group maxes
+    (+wrap halo) plus the int8 window maxes the gather reads."""
+    if arc_align <= 1:
+        return 0
+    nb = n // arc_align
+    nw = fanout // arc_align
+    return (nb + max(nw - 1, 1)) * c_blk * 2 + nb * c_blk
+
+
 def rr_resident_supported(n: int, fanout: int, c_blk: int,
-                          n_cols: int | None = None) -> bool:
-    """Whether the floor-traffic resident-lanes rr variant fits VMEM."""
+                          n_cols: int | None = None,
+                          arc_align: int = 1) -> bool:
+    """Whether the floor-traffic resident-lanes rr variant fits VMEM.
+
+    With ``arc_align > 1`` the aligned-arc window scratch
+    (:func:`rr_align_scratch_bytes`) is counted against the combined
+    budget, so config-time validation agrees with the kernel's own
+    check."""
+    align_bytes = rr_align_scratch_bytes(n, fanout, c_blk, arc_align)
     return (
         rr_supported(n, fanout, c_blk, n_cols)
         and 3 * n * c_blk <= RR_RESIDENT_MAX_BYTES
+        and 3 * n * c_blk + align_bytes <= RR_RESIDENT_ALIGN_BUDGET
     )
 
 
@@ -1734,19 +1753,15 @@ def resident_round_blocked(
             f"{RR_BLOCK_CS} and N*cs*LANE <= {STRIPE_MAX_BYTES} B "
             f"(N={n}, blocked cols={cs * LANE}); use the stripe/XLA path"
         )
-    # aligned-arc window scratch: bf16 group maxes (+wrap halo) + int8
-    # window maxes, ~0.375 * N * c_blk bytes — counted against the resident
-    # budget below so near-boundary shapes fail with THIS error, not a
-    # late Mosaic VMEM allocation failure
-    align_bytes = 0
-    if arc and arc_align > 1:
-        nb_ = n // arc_align
-        nw_ = fanout // arc_align
-        align_bytes = (nb_ + max(nw_ - 1, 1)) * cs * LANE * 2 + nb_ * cs * LANE
-    if resident and (
-        not rr_resident_supported(n, fanout, cs * LANE, nc * cs * LANE)
-        or 3 * n * cs * LANE + align_bytes > RR_RESIDENT_ALIGN_BUDGET
-    ):
+    # aligned-arc window scratch (~0.375 * N * c_blk bytes) is counted
+    # against the resident budget so near-boundary shapes fail with THIS
+    # error, not a late Mosaic VMEM allocation failure; the same math
+    # backs rr_resident_supported, so config-time validation agrees
+    align_bytes = rr_align_scratch_bytes(
+        n, fanout, cs * LANE, arc_align if arc else 1)
+    if resident and not rr_resident_supported(
+            n, fanout, cs * LANE, nc * cs * LANE,
+            arc_align=arc_align if arc else 1):
         raise ValueError(
             f"resident lanes need 3*N*c_blk <= {RR_RESIDENT_MAX_BYTES} B "
             f"(+ {align_bytes} B aligned-arc scratch within "

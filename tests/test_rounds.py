@@ -451,6 +451,9 @@ class TestPackedDetector:
         sv = [e for e in s.drain_events() if e.subject == 5]
         assert sv and sv[0].round == 8
 
+    @pytest.mark.slow  # interpret-mode rr rounds; the fast lane keeps
+    # crash-detection and the join-vs-matrix oracle (the two strongest
+    # PackedDetector checks); these variations rerun the same machinery
     def test_leave_is_silent_death(self):
         from gossipfs_tpu.detector.sim import PackedDetector
 
@@ -511,6 +514,9 @@ class TestPackedDetector:
         # rejoin resets the subject's detection clock in the carry
         assert int(d._mcarry.first_detect[7]) == -1
 
+    @pytest.mark.slow  # interpret-mode rr rounds; the fast lane keeps
+    # crash-detection and the join-vs-matrix oracle (the two strongest
+    # PackedDetector checks); these variations rerun the same machinery
     def test_same_round_crash_and_join_leaves_node_alive(self):
         """Matrix ordering: crashes land before joins, so crash(j)+join(j)
         queued into the same advance ends with j ALIVE (fresh incarnation)
@@ -552,6 +558,9 @@ class TestPackedDetector:
         assert jnp.array_equal(final.hb.reshape(cfg.n, -1),
                                tr(hb4).reshape(cfg.n, -1))
 
+    @pytest.mark.slow  # interpret-mode rr rounds; the fast lane keeps
+    # crash-detection and the join-vs-matrix oracle (the two strongest
+    # PackedDetector checks); these variations rerun the same machinery
     def test_rejoin_within_cooldown_is_suppressed(self):
         """Zombie suppression: a rejoin while receivers still hold the
         FAILED (fail-list) entry must not be re-added by them — only the
@@ -577,6 +586,9 @@ class TestPackedDetector:
         d.advance(30)
         assert 7 in d.membership(others[0])
 
+    @pytest.mark.slow  # interpret-mode rr rounds; the fast lane keeps
+    # crash-detection and the join-vs-matrix oracle (the two strongest
+    # PackedDetector checks); these variations rerun the same machinery
     def test_membership_drops_after_convergence(self):
         from gossipfs_tpu.detector.sim import PackedDetector
 

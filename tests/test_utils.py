@@ -2,12 +2,16 @@
 
 from __future__ import annotations
 
+import gzip
+import json
+
 import jax
+import pytest
 
 from gossipfs_tpu.config import SimConfig
 from gossipfs_tpu.core.state import init_state
 from gossipfs_tpu.parallel import distributed
-from gossipfs_tpu.utils.profiling import time_rounds, trace
+from gossipfs_tpu.utils.profiling import op_breakdown, time_rounds, trace
 
 
 def test_time_rounds_reports_positive_rates():
@@ -21,11 +25,40 @@ def test_time_rounds_reports_positive_rates():
     assert report["dispatch_overhead_s"] >= 0
 
 
+@pytest.mark.slow  # the profiler's start/stop + TF-event flush is ~30 s on
+# this 1-core box regardless of workload size; the fast lane covers the
+# analysis path on a synthetic capture below
 def test_trace_writes_profile(tmp_path):
     cfg = SimConfig(n=16)
     with trace(tmp_path):
         jax.block_until_ready(init_state(cfg).hb)
     assert any(tmp_path.rglob("*"))  # profiler emitted something
+
+
+def test_op_breakdown_parses_synthetic_capture(tmp_path):
+    """Fast-lane coverage of the trace ANALYSIS path (op_breakdown):
+    a hand-built perfetto capture in the profiler's on-disk layout must
+    aggregate device-op durations by name.  The slow lane runs the real
+    jax.profiler end-to-end (test_trace_writes_profile)."""
+    d = tmp_path / "plugins" / "profile" / "2026_07_31"
+    d.mkdir(parents=True)
+    events = [
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 0, "dur": 1500,
+         "name": "fusion.1"},
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 2000, "dur": 500,
+         "name": "fusion.1"},
+        {"ph": "X", "pid": 1, "tid": 2, "ts": 0, "dur": 300,
+         "name": "copy.2"},
+    ]
+    with gzip.open(d / "host.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": events}, f)
+    rows = op_breakdown(tmp_path)
+    by_name = {r["name"]: r for r in rows}
+    assert by_name["fusion.1"]["count"] == 2
+    assert by_name["fusion.1"]["total_ms"] == 2.0
+    assert rows[0]["name"] == "fusion.1"  # sorted by total
 
 
 def test_initialize_noop_single_process(monkeypatch):

@@ -60,7 +60,7 @@ def main():
 
     for bc, br in itertools.product(args.block_c, args.block_r):
         if not merge_pallas.rr_resident_supported(
-                args.n, args.fanout, bc):
+                args.n, args.fanout, bc, arc_align=args.arc_align):
             print(json.dumps({"block_c": bc, "block_r": br,
                               "skipped": "no resident VMEM fit"}))
             continue
