@@ -27,7 +27,8 @@ import time
 
 
 def run(n: int, rounds: int, block_c: int, crash_at: int, track: int,
-        crash_rate: float, seed: int, topology: str, block_r: int) -> dict:
+        crash_rate: float, seed: int, topology: str, block_r: int,
+        arc_align: int = 1, fanout: int | None = None) -> dict:
     import jax
 
     from gossipfs_tpu.bench.run import tracked_crash_events
@@ -35,8 +36,15 @@ def run(n: int, rounds: int, block_c: int, crash_at: int, track: int,
     from gossipfs_tpu.core import rounds as R
     from gossipfs_tpu.metrics.detection import summarize
 
-    cfg = SimConfig.packed_rr(n, block_c, topology=topology,
-                              merge_block_r=block_r)
+    over = dict(topology=topology, merge_block_r=block_r,
+                arc_align=arc_align)
+    if fanout:
+        over["fanout"] = fanout
+    elif arc_align > 1:
+        # aligned arcs need fanout % align == 0: round log2(N) up
+        lf = SimConfig.log_fanout(n)
+        over["fanout"] = -(-lf // arc_align) * arc_align
+    cfg = SimConfig.packed_rr(n, block_c, **over)
     events, crash_rounds, churn_ok = tracked_crash_events(
         cfg, rounds, track, crash_at
     )
@@ -70,6 +78,7 @@ def run(n: int, rounds: int, block_c: int, crash_at: int, track: int,
         "entries": n * n,
         "merge_block_c": block_c,
         "fanout": cfg.fanout,
+        "arc_align": arc_align,
         "topology": topology,
         "rounds": rounds,
         "crash_churn": crash_rate,
@@ -95,10 +104,14 @@ def main(argv=None) -> None:
     p.add_argument("--crash-rate", type=float, default=0.01)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--topology", type=str, default="random")
+    p.add_argument("--arc-align", type=int, default=1,
+                   help="tile-aligned arc bases (random_arc only)")
+    p.add_argument("--fanout", type=int, default=None)
     args = p.parse_args(argv)
     print(json.dumps(run(args.n, args.rounds, args.block_c, args.crash_at,
                          args.track, args.crash_rate, args.seed,
-                         args.topology, args.block_r)))
+                         args.topology, args.block_r,
+                         arc_align=args.arc_align, fanout=args.fanout)))
 
 
 if __name__ == "__main__":

@@ -78,6 +78,11 @@ def make_parser() -> argparse.ArgumentParser:
              "rejecting (reference: server.go:172)",
     )
     p.add_argument(
+        "--arc-align", type=int, default=1,
+        help="with --packed: tile-aligned windowed-arc gossip (bases are "
+             "multiples of this; fanout rounds up to a multiple) — the "
+             "headline kernel's fastest topology at the capacity frontier")
+    p.add_argument(
         "--packed", action="store_true",
         help="capacity-frontier interactive mode: the membership state "
              "lives as the resident-round kernel's packed lanes "
@@ -185,7 +190,15 @@ def main(argv=None) -> None:
     args = parser.parse_args(argv)
     try:
         if args.packed:
-            cfg = SimConfig.packed_rr(args.n)
+            if args.arc_align > 1:
+                lf = SimConfig.log_fanout(args.n)
+                cfg = SimConfig.packed_rr(
+                    args.n, topology="random_arc",
+                    arc_align=args.arc_align,
+                    fanout=-(-lf // args.arc_align) * args.arc_align,
+                )
+            else:
+                cfg = SimConfig.packed_rr(args.n)
         else:
             cfg = SimConfig(n=args.n, topology=args.topology,
                             fanout=args.fanout)
