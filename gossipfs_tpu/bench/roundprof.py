@@ -79,11 +79,18 @@ def variants(n: int) -> dict[str, SimConfig]:
             cfg, topology="random_arc", merge_kernel="pallas_rr",
             merge_block_c=STRIPE_BLOCK_C, hb_dtype="int8", merge_block_r=256,
         )
-        # the round-5 headline: resident parked lanes at the narrower
-        # stripe — floor HBM traffic (bench.py's exact config)
         out["rr_arc_resident"] = dataclasses.replace(
             cfg, topology="random_arc", merge_kernel="pallas_rr",
             merge_block_c=2048, hb_dtype="int8", merge_block_r=256,
+            rr_resident="on",
+        )
+        # the round-5 headline: resident parked lanes + TILE-ALIGNED arcs
+        # (group max rides the view build; the shift-doubling window-max
+        # is gone) — bench.py's exact config
+        out["rr_arc_al_resident"] = dataclasses.replace(
+            cfg, topology="random_arc", fanout=16, arc_align=8,
+            merge_kernel="pallas_rr",
+            merge_block_c=2048, hb_dtype="int8", merge_block_r=512,
             rr_resident="on",
         )
     return out

@@ -142,8 +142,15 @@ class TestShardMapRunner:
             run_rounds_sharded(st, cfg, 5, KEY, mesh)
 
     @pytest.mark.slow  # interpreter-mode rr kernel per shard
-    @pytest.mark.parametrize("topology", ["random_arc", "random"])
-    def test_sharded_rr_matches_single_chip(self, topology):
+    @pytest.mark.parametrize("topology,arc_align", [
+        ("random_arc", 1), ("random", 1),
+        # tile-aligned arcs (the round-5 headline/frontier topology): the
+        # per-shard kernels run the group-max window path with global row
+        # indices, and the sharded scan must stay bit-identical to the
+        # single-chip aligned scan
+        ("random_arc", 8),
+    ])
+    def test_sharded_rr_matches_single_chip(self, topology, arc_align):
         """Round-5: the RESIDENT-ROUND program itself in shard_map form —
         the same one-kernel round the single-chip headline runs, with the
         shard's column offset feeding the kernel's diagonal mask and only
@@ -153,7 +160,9 @@ class TestShardMapRunner:
         from gossipfs_tpu.parallel.mesh import run_rounds_sharded
 
         cfg = SimConfig(
-            n=2048, topology=topology, fanout=6, remove_broadcast=False,
+            n=2048, topology=topology,
+            fanout=16 if arc_align > 1 else 6, arc_align=arc_align,
+            remove_broadcast=False,
             fresh_cooldown=True, t_cooldown=12, view_dtype="int8",
             hb_dtype="int8", merge_block_c=1024,
             merge_kernel="pallas_rr_interpret",
