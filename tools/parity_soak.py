@@ -1,0 +1,92 @@
+"""On-chip parity soak: the COMPILED rr kernel vs the XLA path.
+
+The test suite pins kernel parity in interpreter mode on CPU; this tool
+runs the actual Mosaic-compiled kernel on the TPU against the XLA
+formulation over a long crash-churn horizon and asserts bit-equality of
+every state lane and metric — hardware-level evidence the interpret
+tests cannot give.
+
+    JAX_PLATFORMS=axon python tools/parity_soak.py --rounds 300
+
+Round-5 artifact (2026-07-31): 300 rounds, N=16,384, aligned-arc
+headline config, 0.5% churn -> all lanes + metrics bit-equal, 118.6M
+detection events exercised.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import argparse
+import dataclasses
+import json
+
+import numpy as np
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--n", type=int, default=16_384)
+    p.add_argument("--rounds", type=int, default=300)
+    p.add_argument("--crash-rate", type=float, default=0.005)
+    p.add_argument("--block-c", type=int, default=2_048)
+    p.add_argument("--block-r", type=int, default=512)
+    p.add_argument("--arc-align", type=int, default=8)
+    p.add_argument("--fanout", type=int, default=16)
+    p.add_argument("--seed", type=int, default=42)
+    args = p.parse_args(argv)
+
+    import jax
+
+    from gossipfs_tpu.config import SimConfig
+    from gossipfs_tpu.core.rounds import run_rounds
+    from gossipfs_tpu.core.state import init_state
+
+    base = SimConfig(
+        n=args.n, topology="random_arc", fanout=args.fanout,
+        arc_align=args.arc_align,
+        remove_broadcast=False, fresh_cooldown=True, t_cooldown=12,
+        merge_kernel="pallas_rr", merge_block_r=args.block_r,
+        view_dtype="int8", merge_block_c=args.block_c, rr_resident="auto",
+        hb_dtype="int8",
+    )
+    key = jax.random.PRNGKey(args.seed)
+    out = {}
+    for kernel in ("pallas_rr", "xla"):
+        cfg = dataclasses.replace(base, merge_kernel=kernel)
+        st, mc, pr = run_rounds(
+            init_state(cfg), cfg, args.rounds, key,
+            crash_rate=args.crash_rate,
+        )
+        out[kernel] = (jax.device_get(st), jax.device_get(mc),
+                       jax.device_get(pr))
+    (sr, mr, prr) = out["pallas_rr"]
+    (sx, mx, prx) = out["xla"]
+    checks = {
+        "hb": np.array_equal(sr.hb, sx.hb),
+        "age": np.array_equal(sr.age, sx.age),
+        "status": np.array_equal(sr.status, sx.status),
+        "alive": np.array_equal(sr.alive, sx.alive),
+        "hb_base": np.array_equal(sr.hb_base, sx.hb_base),
+        "first_detect": np.array_equal(mr.first_detect, mx.first_detect),
+        "converged": np.array_equal(mr.converged, mx.converged),
+        "true_detections": np.array_equal(
+            prr.true_detections, prx.true_detections),
+        "false_positives": np.array_equal(
+            prr.false_positives, prx.false_positives),
+    }
+    doc = {
+        "n": args.n, "rounds": args.rounds, "arc_align": args.arc_align,
+        **checks,
+        "all_equal": all(checks.values()),
+        "total_detections": int(prr.true_detections.sum()),
+    }
+    print(json.dumps(doc))
+    return 0 if doc["all_equal"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

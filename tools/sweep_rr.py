@@ -10,6 +10,11 @@ contention between points (the same hygiene bench.py uses).
 
 from __future__ import annotations
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import argparse
 import itertools
 import json
