@@ -51,7 +51,8 @@ def build_inputs(n, c_blk, fanout, key):
     return hb, asl, flags, sa, sb, g, bases
 
 
-def time_stub(n, c_blk, block_r, fanout, stub, rounds, reps):
+def time_stub(n, c_blk, block_r, fanout, stub, rounds, reps,
+              arc_align=1):
     hb, asl, flags, sa, sb, g, bases = build_inputs(
         n, c_blk, fanout, jax.random.PRNGKey(0))
 
@@ -60,7 +61,7 @@ def time_stub(n, c_blk, block_r, fanout, stub, rounds, reps):
         fanout=fanout, member=int(MEMBER), unknown=int(UNKNOWN),
         failed=int(FAILED), age_clamp=AGE_CLAMP, window=126,
         t_fail=5, t_cooldown=12, block_r=block_r, resident=True,
-        _stub=stub,
+        arc_align=arc_align, _stub=stub,
     )
 
     @jax.jit
@@ -91,6 +92,7 @@ def main():
     p.add_argument("--block-r", type=int, default=512)
     p.add_argument("--rounds", type=int, default=100)
     p.add_argument("--reps", type=int, default=3)
+    p.add_argument("--arc-align", type=int, default=1)
     p.add_argument("--stubs", nargs="*", default=[
         "", "rcnt", "gather", "wmax,gather", "epi", "epi,rcnt",
         "vtick", "vtick,wmax,gather,epi,rcnt",
@@ -99,7 +101,8 @@ def main():
     fanout = max(1, args.n.bit_length() - 1)
     for stub in args.stubs:
         el = time_stub(args.n, args.block_c, args.block_r, fanout,
-                       stub, args.rounds, args.reps)
+                       stub, args.rounds, args.reps,
+                       arc_align=args.arc_align)
         print(json.dumps({
             "stub": stub or "(full)",
             "ms_per_round": round(el / args.rounds * 1e3, 3),
