@@ -663,7 +663,7 @@ def stripe_supported(n: int, fanout: int, n_cols: int | None = None) -> bool:
 # stripe is N x 1024 bytes, which is what admits N=65,536 on one chip
 # (64 MB stripe) — measured unpadded (Mosaic packs (8, 128) int8 scratch
 # without rounding the sublane dim up to the (32, 128) tile).
-RR_BLOCK_CS = (1024, 2048, 4096)
+RR_BLOCK_CS = (512, 1024, 2048, 4096)
 
 
 def rr_supported(n: int, fanout: int, c_blk: int,
@@ -690,6 +690,12 @@ RR_RESIDENT_MAX_BYTES = 102 * 1024 * 1024
 # use part of that slack, measured ~8 MB of fixed scratch at headline
 # shapes — the headline's 100.7 MB lanes + 12.6 MB aligned scratch compile)
 RR_RESIDENT_ALIGN_BUDGET = 118 * 1024 * 1024
+
+# Stripe count above which the rr kernel switches its per-receiver count
+# output from per-stripe partial blocks ([N, nc*LANE], write hidden under
+# compute) to the in-kernel accumulated form ([N, LANE] + a VMEM scratch)
+# — see the count section of _rr_kernel for the A/B numbers behind both.
+RR_ACC_STRIPES = 16
 
 
 def rr_align_scratch_bytes(n: int, fanout: int, c_blk: int,
@@ -1278,7 +1284,11 @@ def _rr_kernel(
     arc: bool = False, resident: bool = False, unroll: int = 1,
     view_dt=jnp.int8, stub: frozenset = frozenset(),
     arc_rows: int = ARC_CHUNK, vslots: int = VSLOTS, arc_align: int = 1,
+    rcnt_acc: bool = False, *, nstripes: int,
 ):
+    # nstripes is the GRID's stripe count — the local nc under column
+    # sharding, where deriving it from the global n would be wrong (the
+    # last-stripe count flush would never fire); callers pass it
     nchunks = n // chunk
     nblocks = n // r_blk
 
@@ -1297,6 +1307,8 @@ def _rr_kernel(
         # round's detection (stored ages are always >= 1 — the epilogue
         # advances every age before store), so the sweep reconstructs the
         # fail mask with one compare.
+        rest = list(rest)
+        racc = rest.pop() if rcnt_acc else None
         if resident:
             hb_res, as_res, *arc_scratch = rest
         else:
@@ -1624,10 +1636,16 @@ def _rr_kernel(
         fobs_part = jnp.where(
             jnp.any(fail, axis=0), dmin + col_s + i * r_blk, n
         )[None]
-        # per-RECEIVER member count (next round's group-size input),
-        # indexed (j, i): every block written exactly once.  The sublane
-        # dim is padded to 8 (Mosaic's minimum tile) — consumers read
-        # row 0 only
+        # per-RECEIVER member count (next round's group-size input).
+        # Default (rcnt_acc=False): per-stripe partials leave as an
+        # [N, nc*LANE] block indexed (j, i) — every block written exactly
+        # once, the write fully hidden under the compute-bound kernel
+        # (the round-5 A/B that rejected accumulation at headline nc).
+        # rcnt_acc=True (deep-stripe shapes, nc > RR_ACC_STRIPES): the
+        # partials ACCUMULATE in a VMEM scratch across j and only the
+        # completed [N, LANE] counts flush on the last stripe pass —
+        # at N=81,920/c_blk=512 (nc=160) the per-stripe form would be a
+        # 3.4 GB int16 side output that cannot fit HBM beside the lanes.
         # reductions stay >= 2-D throughout: a rank-1 intermediate here
         # crashes the TPU lowering (layout.h implicit_dim check)
         if "rcnt" in stub:
@@ -1635,12 +1653,25 @@ def _rr_kernel(
         else:
             rc = jnp.sum(st_mem.astype(jnp.int32), axis=2)
             rc = jnp.sum(rc, axis=1, keepdims=True)
-            # int16 output: a per-stripe partial count is <= cs*LANE <=
-            # 4096.  At the N=65,536 frontier this buffer is [N, nc*LANE]
-            # — int16 halves a gigabyte-class side output
-            rcnt_out[...] = jnp.broadcast_to(
-                rc, (rc.shape[0], LANE)
-            ).astype(rcnt_out.dtype)
+            # int16: a per-stripe partial is <= cs*LANE <= 4096; the
+            # accumulated form widens via the output dtype at N >= 32,768
+            bc = jnp.broadcast_to(rc, (rc.shape[0], LANE))
+            if not rcnt_acc:
+                rcnt_out[...] = bc.astype(rcnt_out.dtype)
+            else:
+                rrows_c = pl.ds(i * r_blk, r_blk)
+
+                @pl.when(j == 0)
+                def _():
+                    racc[rrows_c] = bc.astype(racc.dtype)
+
+                @pl.when(j > 0)
+                def _():
+                    racc[rrows_c] = racc[rrows_c] + bc.astype(racc.dtype)
+
+                @pl.when(j == nstripes - 1)
+                def _():
+                    rcnt_out[...] = racc[rrows_c].astype(rcnt_out.dtype)
 
         @pl.when(i == 0)
         def _():
@@ -1662,7 +1693,7 @@ def _rr_kernel(
     static_argnames=(
         "fanout", "member", "unknown", "failed", "age_clamp", "window",
         "t_fail", "t_cooldown", "block_r", "chunk", "interpret",
-        "resident", "gather_unroll", "arc_align", "_stub",
+        "resident", "gather_unroll", "arc_align", "rcnt_acc", "_stub",
     ),
 )
 def resident_round_blocked(
@@ -1689,6 +1720,7 @@ def resident_round_blocked(
     gather_unroll: int | None = None,
     col_offset: jax.Array | int = 0,
     arc_align: int = 1,
+    rcnt_acc: bool | None = None,
     _stub: str = "",
 ) -> tuple[jax.Array, ...]:
     """One whole gossip round (lean crash-only fault model) in one kernel.
@@ -1723,10 +1755,12 @@ def resident_round_blocked(
     * statics: the protocol constants; ``window`` is the int8 rebase window.
 
     Returns (hb', asl', member_cnt [nc,cs,LANE], n_det, first_obs,
-    recv_cnt [N, nc*LANE] — per-receiver per-stripe partial member counts,
-    lane-replicated: ``recv_cnt.reshape(n, nc, LANE)[:, :, 0].sum(1)`` is
-    the post-merge membership-list size of each receiver, which feeds the
-    NEXT round's active/refresher split (carried by the scan — the
+    recv_cnt — per-receiver member counts, lane-replicated, in one of two
+    forms (both reduce with ``recv_cnt.reshape(n, -1).sum(1) // LANE``):
+    [N, nc*LANE] per-stripe partials (default, nc <= RR_ACC_STRIPES) or
+    [N, LANE] stripe-complete counts (deep-stripe shapes; accumulated in
+    VMEM, ``rcnt_acc`` overrides the choice).  The counts feed the NEXT
+    round's active/refresher split (carried by the scan — the
     member-count XLA pass is gone too).
     """
     nc, n, cs, _ = hb.shape
@@ -1822,6 +1856,16 @@ def resident_round_blocked(
     if n * cs * LANE * vbytes + resident_extra > RR_RESIDENT_MAX_BYTES:
         view_dt, vbytes = jnp.int8, 1
 
+    # per-receiver count output form: per-stripe partial blocks by default
+    # (the write hides under the compute-bound kernel — round-5 A/B), the
+    # in-kernel accumulator at deep stripe counts, where the per-stripe
+    # side output grows with nc and stops fitting HBM beside the lanes
+    # (N=81,920 at c_blk=512: nc=160 -> 3.4 GB int16).  Per-stripe partials
+    # (<= cs*LANE <= 4096) always fit int16; the accumulated form holds
+    # full counts <= N and widens at the capacity frontier.
+    use_acc = rcnt_acc if rcnt_acc is not None else nc > RR_ACC_STRIPES
+    cnt_dt = jnp.int32 if (use_acc and n >= 32_768) else jnp.int16
+
     # per-subject int8 threshold stack for the packed in-kernel arithmetic
     # (see the module comment above _rr_tick_packed); the int8 casts wrap
     # mod 2^8 — exactly the narrow XLA formulation's casts
@@ -1902,7 +1946,8 @@ def resident_round_blocked(
                    age_clamp, window, t_fail, t_cooldown, hb_min, arc=arc,
                    resident=resident, unroll=u, view_dt=view_dt,
                    stub=frozenset(s for s in _stub.split(",") if s),
-                   arc_rows=arc_rows, vslots=vslots, arc_align=arc_align),
+                   arc_rows=arc_rows, vslots=vslots, arc_align=arc_align,
+                   rcnt_acc=use_acc, nstripes=nc),
         grid=(nc, n // r_blk),
         # in-place lane update: safe because every [row-block, stripe]
         # region's reads (the i==0 view-build chunk pass and the one-step-
@@ -1927,8 +1972,18 @@ def resident_round_blocked(
         out_specs=[
             lane_blk, lane_blk,
             subj_spec, subj_spec, subj_spec,
-            pl.BlockSpec((r_blk, LANE), lambda j, i: (i, j),
-                         memory_space=pltpu.VMEM),
+            # per-receiver counts: per-stripe partial blocks (default), or
+            # — accumulated form — a write-only window parked on block
+            # (0, 0) until the last stripe pass walks the receiver blocks
+            # and flushes the completed counts (earlier retirements write
+            # scratch garbage to block (0, 0); the final i=0 visit
+            # overwrites it — grid steps execute in order)
+            pl.BlockSpec(
+                (r_blk, LANE),
+                (lambda j, i: (jnp.where(j == nc - 1, i, 0), 0))
+                if use_acc else (lambda j, i: (i, j)),
+                memory_space=pltpu.VMEM,
+            ),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((nc, n, cs, LANE), jnp.int8),
@@ -1936,7 +1991,8 @@ def resident_round_blocked(
             jax.ShapeDtypeStruct((nc, cs, LANE), jnp.int32),
             jax.ShapeDtypeStruct((nc, cs, LANE), jnp.int32),
             jax.ShapeDtypeStruct((nc, cs, LANE), jnp.int32),
-            jax.ShapeDtypeStruct((n, nc * LANE), jnp.int16),
+            jax.ShapeDtypeStruct(
+                (n, LANE) if use_acc else (n, nc * LANE), cnt_dt),
         ],
         scratch_shapes=[
             pltpu.VMEM((n, cs, LANE), view_dt),           # view stripe
@@ -1949,7 +2005,10 @@ def resident_round_blocked(
             pltpu.SemaphoreType.DMA((vslots, 2)),
             pltpu.VMEM((max(ch, r_blk), cs, LANE), jnp.int32),  # dbuf
             pltpu.VMEM((max(ch, r_blk), cs, LANE), jnp.int8),   # flbuf
-        ] + rblock_scratch + arc_scratch,
+        ] + rblock_scratch + arc_scratch + (
+            # the accumulated form's per-receiver count scratch (persists
+            # across the whole grid; flushed on the last stripe pass)
+            [pltpu.VMEM((n, LANE), cnt_dt)] if use_acc else []),
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=126 * 1024 * 1024),
         interpret=interpret,

@@ -340,6 +340,51 @@ def test_rr_deep_shift_regime_parity(topology, rr_resident, arc_align):
     assert jnp.array_equal(px.false_positives, pp.false_positives)
 
 
+def test_rr_rcnt_accumulated_form_matches_per_stripe():
+    """The deep-stripe count form (rcnt_acc=True: per-stripe partials
+    accumulate in VMEM, one [N, LANE] flush on the last stripe pass —
+    what the N=81,920/c_blk=512 capacity frontier needs, where the
+    per-stripe output would be a 3.4 GB side buffer) must produce the
+    same lane outputs and the same reduced per-receiver counts as the
+    default per-stripe form, on identical inputs."""
+    import numpy as np
+
+    from gossipfs_tpu.config import AGE_CLAMP
+    from gossipfs_tpu.core.state import FAILED, MEMBER, UNKNOWN
+    from gossipfs_tpu.ops import merge_pallas as mp
+
+    n, c_blk, fanout = 1024, 512, 8
+    nc, cs = n // c_blk, c_blk // mp.LANE
+    key = jax.random.PRNGKey(3)
+    ks = jax.random.split(key, 5)
+    hb = jax.random.randint(ks[0], (nc, n, cs, mp.LANE), -128, 127, jnp.int8)
+    age = jax.random.randint(ks[1], (nc, n, cs, mp.LANE), 1, 40, jnp.int32)
+    st = jax.random.randint(ks[2], (nc, n, cs, mp.LANE), 0, 3, jnp.int32)
+    asl = mp.pack_age_status(age, st)
+    flags = jnp.broadcast_to(jnp.int8(1 + 4), (n, mp.LANE)).astype(jnp.int8)
+    sa = jnp.zeros((nc, cs, mp.LANE), jnp.int32)
+    sb = jnp.zeros((nc, cs, mp.LANE), jnp.int32)
+    g = jnp.full((nc, cs, mp.LANE), -120, jnp.int32)
+    bases = (jax.random.randint(ks[3], (n,), 0, n // 8, jnp.int32) * 8
+             ).reshape(n, 1)
+    kw = dict(fanout=fanout, member=int(MEMBER), unknown=int(UNKNOWN),
+              failed=int(FAILED), age_clamp=AGE_CLAMP, window=126,
+              t_fail=5, t_cooldown=12, block_r=128, arc_align=8,
+              interpret=True)
+    out_ps = mp.resident_round_blocked(bases, hb, asl, flags, sa, sb, g,
+                                       rcnt_acc=False, **kw)
+    out_ac = mp.resident_round_blocked(bases, hb, asl, flags, sa, sb, g,
+                                       rcnt_acc=True, **kw)
+    for a, b, name in zip(out_ps[:5], out_ac[:5],
+                          ("hb", "asl", "cnt", "ndet", "fobs")):
+        assert jnp.array_equal(a, b), name
+    assert out_ps[5].shape == (n, nc * mp.LANE)
+    assert out_ac[5].shape == (n, mp.LANE)
+    red = lambda r: np.asarray(  # noqa: E731
+        jnp.sum(r.reshape(n, -1), axis=1, dtype=jnp.int32) // mp.LANE)
+    np.testing.assert_array_equal(red(out_ps[5]), red(out_ac[5]))
+
+
 def test_stripe_and_arc_kernel_smoke():
     """Fast-lane coverage for the stripe/arc production kernels: ONE
     interpret-mode round each against the XLA round (the slow lane runs
