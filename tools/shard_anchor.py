@@ -1,0 +1,123 @@
+"""Measure ONE chip's share of the sharded N=131,072 rr round, for real.
+
+The v5e-8 config-4 projection (BASELINE.md) rests on the sharded
+resident-round program: each chip runs the SAME rr kernel over
+[N global rows x N/8 local columns], and the only cross-chip traffic is
+an [N]-vector psum (< 2 MB/round).  This tool runs exactly that
+per-chip program — full-N-row stripes, a shard's column count, the
+shard's global column offset — on the single real chip and times it,
+replacing the compute-scaling extrapolation with a measured per-chip
+anchor.  The 512-wide stripe (round 5) is what admits N=131,072 rows:
+N x c_blk = 67 MB fits the 72 MB VMEM stripe budget.
+
+    JAX_PLATFORMS=axon python tools/shard_anchor.py \
+        --n 131072 --shards 8 --block-c 512
+
+Round-5 artifact: see BASELINE.md's projection section.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import argparse
+import functools
+import json
+import time
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--n", type=int, default=131_072)
+    p.add_argument("--shards", type=int, default=8)
+    p.add_argument("--block-c", type=int, default=512)
+    p.add_argument("--block-r", type=int, default=512)
+    p.add_argument("--arc-align", type=int, default=8)
+    p.add_argument("--fanout", type=int, default=24)
+    p.add_argument("--rounds", type=int, default=30)
+    p.add_argument("--reps", type=int, default=3)
+    p.add_argument("--shard", type=int, default=0,
+                   help="which shard's column offset to run")
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from gossipfs_tpu.config import AGE_CLAMP
+    from gossipfs_tpu.core.state import FAILED, MEMBER, UNKNOWN
+    from gossipfs_tpu.ops import merge_pallas as mp
+
+    n, lane = args.n, mp.LANE
+    nloc = n // args.shards
+    nc, cs = nloc // args.block_c, args.block_c // lane
+    if not mp.rr_supported(n, args.fanout, args.block_c, nloc):
+        raise SystemExit(f"shape not rr-admissible: n={n}, nloc={nloc}, "
+                         f"c_blk={args.block_c}")
+    key = jax.random.PRNGKey(0)
+    ks = jax.random.split(key, 4)
+    hb = jax.random.randint(ks[0], (nc, n, cs, lane), -128, 127, jnp.int8)
+
+    # build the packed age|status lane stripe by stripe under jit: an
+    # eager full-array int32 intermediate is 8.6 GB at this shape and
+    # OOMs HBM next to the lanes
+    @jax.jit
+    def mk_asl(k):
+        k1, k2 = jax.random.split(k)
+        age = jax.random.randint(k1, (n, cs, lane), 1, 40, jnp.int32)
+        st = jax.random.randint(k2, (n, cs, lane), 0, 3, jnp.int32)
+        return mp.pack_age_status(age, st)
+
+    asl = jnp.stack([mk_asl(jax.random.fold_in(ks[1], j))
+                     for j in range(nc)])
+    flags = jnp.broadcast_to(jnp.int8(1 + 4), (n, lane)).astype(jnp.int8)
+    sa = jnp.zeros((nc, cs, lane), jnp.int32)
+    sb = jnp.zeros((nc, cs, lane), jnp.int32)
+    g = jnp.full((nc, cs, lane), -120, jnp.int32)
+    bases = (jax.random.randint(ks[3], (n,), 0, n // args.arc_align,
+                                jnp.int32) * args.arc_align).reshape(n, 1)
+
+    kern = functools.partial(
+        mp.resident_round_blocked,
+        fanout=args.fanout, member=int(MEMBER), unknown=int(UNKNOWN),
+        failed=int(FAILED), age_clamp=AGE_CLAMP, window=126,
+        t_fail=5, t_cooldown=12, block_r=args.block_r,
+        arc_align=args.arc_align, col_offset=args.shard * nloc,
+    )
+
+    @jax.jit
+    def run(hb, asl):
+        def step(carry, _):
+            hb, asl = carry
+            out = kern(bases, hb, asl, flags, sa, sb, g)
+            return (out[0], out[1]), out[3].sum()
+        (hb, asl), s = lax.scan(step, (hb, asl), None, length=args.rounds)
+        return hb, asl, s
+
+    out = run(hb, asl)
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(args.reps):
+        t0 = time.perf_counter()
+        out = run(hb, asl)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+        time.sleep(2.0)
+    ms = best / args.rounds * 1e3
+    print(json.dumps({
+        "n_global": n, "shards": args.shards, "local_cols": nloc,
+        "entries_per_chip": n * nloc, "merge_block_c": args.block_c,
+        "fanout": args.fanout, "arc_align": args.arc_align,
+        "ms_per_round_per_chip": round(ms, 2),
+        "implied_rounds_per_sec_v5e8": round(1e3 / ms, 2),
+        "note": "per-chip share of the sharded rr round, measured on one "
+                "real chip; the sharded program's only cross-chip traffic "
+                "is an [N]-vector psum (< 2 MB/round over ICI)",
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
