@@ -139,7 +139,9 @@ def round_bytes(cfg: SimConfig) -> dict:
         nc = n // cfg.merge_block_c
         packed = nn * 2  # hb int8 + age|status packed into one byte
         resident = cfg.rr_resident != "off" and rr_resident_supported(
-            n, cfg.fanout, cfg.merge_block_c
+            n, cfg.fanout, cfg.merge_block_c,
+            arc_align=(cfg.arc_align
+                       if cfg.topology == "random_arc" else 1),
         )
         phases = {
             "view_build_read": packed,

@@ -212,7 +212,11 @@ class SimConfig:
                 # the rr kernel accepts narrower resident stripes — the
                 # capacity lever: N * merge_block_c bytes must fit VMEM,
                 # so N=65,536 runs at merge_block_c=1024
-                if not rr_supported(self.n, self.fanout, self.merge_block_c):
+                if not rr_supported(
+                    self.n, self.fanout, self.merge_block_c,
+                    arc_align=(self.arc_align
+                               if self.topology == "random_arc" else 1),
+                ):
                     raise ValueError(
                         f"merge_kernel={self.merge_kernel!r} needs "
                         f"merge_block_c in {RR_BLOCK_CS} with "

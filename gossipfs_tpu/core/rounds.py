@@ -1101,8 +1101,9 @@ def _use_rr(config: SimConfig, n: int, nloc: int) -> bool:
         or not 1 < config.fanout <= merge_pallas.ARC_CHUNK
     ):
         return False
-    if not merge_pallas.rr_supported(n, config.fanout, config.merge_block_c,
-                                     nloc):
+    if not merge_pallas.rr_supported(
+            n, config.fanout, config.merge_block_c, nloc,
+            config.arc_align if config.topology == "random_arc" else 1):
         return False
     return (
         config.merge_kernel.endswith("interpret")

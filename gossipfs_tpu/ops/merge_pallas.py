@@ -667,9 +667,26 @@ RR_BLOCK_CS = (512, 1024, 2048, 4096)
 
 
 def rr_supported(n: int, fanout: int, c_blk: int,
-                 n_cols: int | None = None) -> bool:
+                 n_cols: int | None = None, arc_align: int = 1) -> bool:
     if n_cols is None:
         n_cols = n
+    if arc_align > 1:
+        # aligned-arc mode materializes no view stripe (write-only — the
+        # gather reads the window maxes); the VMEM row cost is the
+        # T (bf16) + W (int8) group-row buffers PLUS the per-row buffers
+        # that scale with N regardless of stripe width: the flags block
+        # and, on deep-stripe shapes, the count accumulator (int32 at
+        # N >= 32,768).  Omitting those admitted a 16-way N=262,144
+        # shape whose scratch demanded 225 MB (round-5 review).
+        row_bytes = 3 * (n // arc_align) * c_blk + n * LANE
+        if n_cols // c_blk > RR_ACC_STRIPES:
+            row_bytes += n * LANE * (4 if n >= 32_768 else 2)
+        return (
+            supported(n, fanout, n_cols)
+            and c_blk in RR_BLOCK_CS
+            and n_cols % c_blk == 0
+            and row_bytes <= RR_ALIGN_VMEM_BUDGET
+        )
     return (
         supported(n, fanout, n_cols)
         and c_blk in RR_BLOCK_CS
@@ -690,6 +707,14 @@ RR_RESIDENT_MAX_BYTES = 102 * 1024 * 1024
 # use part of that slack, measured ~8 MB of fixed scratch at headline
 # shapes — the headline's 100.7 MB lanes + 12.6 MB aligned scratch compile)
 RR_RESIDENT_ALIGN_BUDGET = 118 * 1024 * 1024
+
+# Combined VMEM budget for the aligned-arc (stripe-free) row costs: the
+# T/W window buffers + flags + the deep-stripe count accumulator must
+# leave room for the view-build/receiver/iota/flag scratches inside the
+# 126 MB compiler limit.  112 MB admits the measured 8-way N=131,072
+# anchor (109 MB of row costs) and rejects the 16-way N=262,144 shape
+# (218 MB) eagerly instead of via a late Mosaic allocation failure.
+RR_ALIGN_VMEM_BUDGET = 112 * 1024 * 1024
 
 # Stripe count above which the rr kernel switches its per-receiver count
 # output from per-stripe partial blocks ([N, nc*LANE], write hidden under
@@ -718,11 +743,24 @@ def rr_resident_supported(n: int, fanout: int, c_blk: int,
     (:func:`rr_align_scratch_bytes`) is counted against the combined
     budget, so config-time validation agrees with the kernel's own
     check."""
+    if n_cols is None:
+        n_cols = n
     align_bytes = rr_align_scratch_bytes(n, fanout, c_blk, arc_align)
+    # aligned mode materializes no stripe: resident VMEM is the two
+    # parked lanes + the T/W window scratch
+    lane_bytes = (2 if arc_align > 1 else 3) * n * c_blk
+    # per-row VMEM that scales with N regardless of stripe width: the
+    # flags block, plus the count accumulator on deep-stripe shapes
+    # (int32 at N >= 32,768) — omitting these admitted a resident
+    # N=86,016 aligned shape that demanded 165 MB of VMEM
+    row_extra = n * LANE
+    if n_cols // c_blk > RR_ACC_STRIPES:
+        row_extra += n * LANE * (4 if n >= 32_768 else 2)
     return (
-        rr_supported(n, fanout, c_blk, n_cols)
-        and 3 * n * c_blk <= RR_RESIDENT_MAX_BYTES
-        and 3 * n * c_blk + align_bytes <= RR_RESIDENT_ALIGN_BUDGET
+        rr_supported(n, fanout, c_blk, n_cols, arc_align)
+        and lane_bytes <= RR_RESIDENT_MAX_BYTES
+        and lane_bytes + align_bytes + row_extra
+        <= RR_RESIDENT_ALIGN_BUDGET
     )
 
 
@@ -1291,6 +1329,10 @@ def _rr_kernel(
     # last-stripe count flush would never fire); callers pass it
     nchunks = n // chunk
     nblocks = n // r_blk
+    # aligned-arc mode never reads the view stripe (the gather consumes
+    # the window maxes), so it is not materialized; any stub keeps the
+    # real stripe so the bisect tool's stubbed paths stay valid
+    no_stripe = arc and arc_align > 1 and not stub
 
     mx = max(chunk, r_blk)
 
@@ -1448,16 +1490,25 @@ def _rr_kernel(
                         # subjects store rel - 256 (round-5 review finding)
                         rel = _wrap8(rel)
                     enc = jnp.where(goss, rel, -1)
-                    stripe[pl.ds(c * chunk, chunk)] = enc.astype(stripe.dtype)
+                    if not no_stripe:
+                        stripe[pl.ds(c * chunk, chunk)] = enc.astype(
+                            stripe.dtype)
                     if arc and arc_align > 1 and "wmax" not in stub:
                         # aligned-arc group max rides the view build: the
                         # encoded values are already live in registers, so
                         # the windowed row-max's whole-stripe re-read (and
                         # its O(log F) shift-doubling passes) never happens.
                         # The max must run over the WRAPPED int8 values the
-                        # stripe stores (max-then-wrap != wrap-then-max for
-                        # deep-shift subjects whose rel straddles the wrap)
-                        # — for widened view dtypes rel is wrapped above
+                        # stripe would store (max-then-wrap != wrap-then-max
+                        # for deep-shift subjects whose rel straddles the
+                        # wrap) — for widened view dtypes rel is wrapped
+                        # above.  The gather below reads ONLY the window
+                        # maxes, so in aligned mode the stripe itself is
+                        # write-only and is not materialized at all
+                        # (no_stripe): that frees N x c_blk bytes of VMEM —
+                        # the rr row bound drops to the T/W buffers'
+                        # 0.375 x N x c_blk — and deletes one full store
+                        # pass from the view build
                         encw = _wrap8(enc) if view_dt == jnp.int8 else enc
                         tbuf = arc_scratch[0]
                         gpc = chunk // arc_align
@@ -1781,10 +1832,12 @@ def resident_round_blocked(
                 "arc_align must be a power of two dividing fanout and n "
                 f"(align={arc_align}, fanout={fanout}, n={n})"
             )
-    if not rr_supported(n, fanout, cs * LANE, nc * cs * LANE):
+    if not rr_supported(n, fanout, cs * LANE, nc * cs * LANE,
+                        arc_align if (arc and not _stub) else 1):
         raise ValueError(
             f"resident round kernel needs lane-aligned N, cs*LANE in "
-            f"{RR_BLOCK_CS} and N*cs*LANE <= {STRIPE_MAX_BYTES} B "
+            f"{RR_BLOCK_CS} and its VMEM row cost within "
+            f"{STRIPE_MAX_BYTES} B "
             f"(N={n}, blocked cols={cs * LANE}); use the stripe/XLA path"
         )
     # aligned-arc window scratch (~0.375 * N * c_blk bytes) is counted
@@ -1855,6 +1908,11 @@ def resident_round_blocked(
     resident_extra = 2 * n * cs * LANE if resident else 0
     if n * cs * LANE * vbytes + resident_extra > RR_RESIDENT_MAX_BYTES:
         view_dt, vbytes = jnp.int8, 1
+
+    # aligned-arc mode materializes no view stripe (matches the kernel
+    # factory's decision; any stub keeps the real stripe for the bisect
+    # tool)
+    no_stripe = arc and arc_align > 1 and not _stub
 
     # per-receiver count output form: per-stripe partial blocks by default
     # (the write hides under the compute-bound kernel — round-5 A/B), the
@@ -1995,7 +2053,10 @@ def resident_round_blocked(
                 (n, LANE) if use_acc else (n, nc * LANE), cnt_dt),
         ],
         scratch_shapes=[
-            pltpu.VMEM((n, cs, LANE), view_dt),           # view stripe
+            # aligned-arc mode never reads the stripe (write-only): a
+            # token allocation keeps the kernel signature; the real
+            # window data lives in the T/W arc scratch
+            pltpu.VMEM((8 if no_stripe else n, cs, LANE), view_dt),
             pltpu.VMEM((r_blk, cs, LANE), jnp.int8),      # best (narrow)
             # view-build chunk pipeline, then the one-time iota scratch
             # (diagonal delta) and the materialized flag broadcast, then

@@ -54,16 +54,20 @@ def main(argv=None):
     n, lane = args.n, mp.LANE
     nloc = n // args.shards
     nc, cs = nloc // args.block_c, args.block_c // lane
-    if not mp.rr_supported(n, args.fanout, args.block_c, nloc):
+    if not mp.rr_supported(n, args.fanout, args.block_c, nloc,
+                       arc_align=args.arc_align):
         raise SystemExit(f"shape not rr-admissible: n={n}, nloc={nloc}, "
                          f"c_blk={args.block_c}")
     key = jax.random.PRNGKey(0)
     ks = jax.random.split(key, 4)
-    hb = jax.random.randint(ks[0], (nc, n, cs, lane), -128, 127, jnp.int8)
 
-    # build the packed age|status lane stripe by stripe under jit: an
-    # eager full-array int32 intermediate is 8.6 GB at this shape and
-    # OOMs HBM next to the lanes
+    # build both lanes stripe by stripe under jit: eager full-array RNG
+    # materializes a 4 B/element bits buffer (17 GB at the 16-way
+    # N=262,144 shape) and int32 intermediates of the same size
+    @jax.jit
+    def mk_hb(k):
+        return jax.random.randint(k, (n, cs, lane), -128, 127, jnp.int8)
+
     @jax.jit
     def mk_asl(k):
         k1, k2 = jax.random.split(k)
@@ -71,8 +75,19 @@ def main(argv=None):
         st = jax.random.randint(k2, (n, cs, lane), 0, 3, jnp.int32)
         return mp.pack_age_status(age, st)
 
-    asl = jnp.stack([mk_asl(jax.random.fold_in(ks[1], j))
-                     for j in range(nc)])
+    # assemble with donated in-place writes: a stack() keeps pieces AND
+    # the stacked copy live, which together with the other lane exceeds
+    # HBM at the biggest anchor shapes
+    @functools.partial(jax.jit, donate_argnums=0)
+    def put(buf, piece, j):
+        return lax.dynamic_update_index_in_dim(buf, piece, j, 0)
+
+    hb = jnp.zeros((nc, n, cs, lane), jnp.int8)
+    for j in range(nc):
+        hb = put(hb, mk_hb(jax.random.fold_in(ks[0], j)), j)
+    asl = jnp.zeros((nc, n, cs, lane), jnp.int8)
+    for j in range(nc):
+        asl = put(asl, mk_asl(jax.random.fold_in(ks[1], j)), j)
     flags = jnp.broadcast_to(jnp.int8(1 + 4), (n, lane)).astype(jnp.int8)
     sa = jnp.zeros((nc, cs, lane), jnp.int32)
     sb = jnp.zeros((nc, cs, lane), jnp.int32)
@@ -88,7 +103,10 @@ def main(argv=None):
         arc_align=args.arc_align, col_offset=args.shard * nloc,
     )
 
-    @jax.jit
+    # donate the lanes (matching the real sharded runner): without
+    # donation XLA holds input + output lane copies, which alone exceed
+    # HBM at the 16-way N=262,144 shape (2 x 8.6 GB)
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def run(hb, asl):
         def step(carry, _):
             hb, asl = carry
@@ -97,13 +115,13 @@ def main(argv=None):
         (hb, asl), s = lax.scan(step, (hb, asl), None, length=args.rounds)
         return hb, asl, s
 
-    out = run(hb, asl)
-    jax.block_until_ready(out)
+    hb, asl, s = run(hb, asl)
+    jax.block_until_ready(asl)
     best = float("inf")
     for _ in range(args.reps):
         t0 = time.perf_counter()
-        out = run(hb, asl)
-        jax.block_until_ready(out)
+        hb, asl, s = run(hb, asl)
+        jax.block_until_ready(asl)
         best = min(best, time.perf_counter() - t0)
         time.sleep(2.0)
     ms = best / args.rounds * 1e3
