@@ -201,6 +201,7 @@ class SimConfig:
                 raise ValueError(f"merge_kernel={self.merge_kernel!r} "
                                  "requires view_dtype='int8'")
             from gossipfs_tpu.ops.merge_pallas import (
+                RR_ACC_STRIPES,
                 RR_BLOCK_CS,
                 STRIPE_BLOCK_C,
                 STRIPE_MAX_BYTES,
@@ -212,6 +213,14 @@ class SimConfig:
                 # the rr kernel accepts narrower resident stripes — the
                 # capacity lever: N * merge_block_c bytes must fit VMEM,
                 # so N=65,536 runs at merge_block_c=1024
+                if (self.n // self.merge_block_c > RR_ACC_STRIPES
+                        and self.merge_block_r % 128):
+                    raise ValueError(
+                        "deep-stripe rr shapes (n/merge_block_c > "
+                        f"{RR_ACC_STRIPES}) use the lane-compacted count "
+                        "accumulator, which needs merge_block_r % 128 == 0 "
+                        f"(got {self.merge_block_r})"
+                    )
                 if not rr_supported(
                     self.n, self.fanout, self.merge_block_c,
                     arc_align=(self.arc_align

@@ -1327,14 +1327,21 @@ def _scan_rounds_rr_packed(
                 arc_align=config.arc_align,
             )
         )
-        # rcnt is lane-replicated: summing ALL lanes and dividing by LANE
-        # is a contiguous reduce (the [:, :, 0] slice formulation was a
+        # two count forms (merge_pallas.resident_round_blocked): the
+        # LANE-COMPACTED [N/LANE, LANE] block (deep-stripe shapes) IS the
+        # count vector; the lane-replicated per-stripe [N, nc*LANE] form
+        # reduces by summing ALL lanes and dividing by LANE — a
+        # contiguous reduce (the [:, :, 0] slice formulation was a
         # strided gather, ~7x slower over the 33 MB buffer).  Sharded:
         # each shard's rcnt covers its own stripes — the psum completes
         # the per-receiver count (the scan's one [N]-vector collective)
-        counts_next = ctx.psum(jnp.sum(
-            rcnt.reshape(n, -1), axis=1, dtype=jnp.int32
-        ) // lane)
+        if rcnt.size == n:
+            counts_local = rcnt.reshape(n).astype(jnp.int32)
+        else:
+            counts_local = jnp.sum(
+                rcnt.reshape(n, -1), axis=1, dtype=jnp.int32
+            ) // lane
+        counts_next = ctx.psum(counts_local)
         cols = _Cols(alive=alive, n=n)
         n_det = ndet.reshape(nloc)
         first_obs = fobs.reshape(nloc)

@@ -680,7 +680,9 @@ def rr_supported(n: int, fanout: int, c_blk: int,
         # shape whose scratch demanded 225 MB (round-5 review).
         row_bytes = 3 * (n // arc_align) * c_blk + n * LANE
         if n_cols // c_blk > RR_ACC_STRIPES:
-            row_bytes += n * LANE * (4 if n >= 32_768 else 2)
+            # lane-compacted int32 count accumulator + the grid-resident
+            # compact count OUTPUT block (both [N/LANE, LANE] int32)
+            row_bytes += n * 8
         return (
             supported(n, fanout, n_cols)
             and c_blk in RR_BLOCK_CS
@@ -718,8 +720,9 @@ RR_ALIGN_VMEM_BUDGET = 112 * 1024 * 1024
 
 # Stripe count above which the rr kernel switches its per-receiver count
 # output from per-stripe partial blocks ([N, nc*LANE], write hidden under
-# compute) to the in-kernel accumulated form ([N, LANE] + a VMEM scratch)
-# — see the count section of _rr_kernel for the A/B numbers behind both.
+# compute) to the LANE-COMPACTED accumulated form ([N/LANE, LANE] int32,
+# 4 B/receiver scratch + same-shape output) — see the count section of
+# _rr_kernel for the A/B numbers behind both.
 RR_ACC_STRIPES = 16
 
 
@@ -755,7 +758,9 @@ def rr_resident_supported(n: int, fanout: int, c_blk: int,
     # N=86,016 aligned shape that demanded 165 MB of VMEM
     row_extra = n * LANE
     if n_cols // c_blk > RR_ACC_STRIPES:
-        row_extra += n * LANE * (4 if n >= 32_768 else 2)
+        # lane-compacted int32 count accumulator + the grid-resident
+        # compact count OUTPUT block (both [N/LANE, LANE] int32)
+        row_extra += n * 8
     return (
         rr_supported(n, fanout, c_blk, n_cols, arc_align)
         and lane_bytes <= RR_RESIDENT_MAX_BYTES
@@ -1693,10 +1698,15 @@ def _rr_kernel(
         # once, the write fully hidden under the compute-bound kernel
         # (the round-5 A/B that rejected accumulation at headline nc).
         # rcnt_acc=True (deep-stripe shapes, nc > RR_ACC_STRIPES): the
-        # partials ACCUMULATE in a VMEM scratch across j and only the
-        # completed [N, LANE] counts flush on the last stripe pass —
-        # at N=81,920/c_blk=512 (nc=160) the per-stripe form would be a
-        # 3.4 GB int16 side output that cannot fit HBM beside the lanes.
+        # partials ACCUMULATE in a LANE-COMPACTED VMEM scratch
+        # [N/LANE, LANE] — the (r_blk, 1) per-row sums relayout into
+        # lanes (128 receivers per scratch row), so the accumulator is
+        # 4 B/receiver instead of the lane-replicated form's 512 B (a
+        # 67 MB VMEM hog at N=131,072 that blocked wide stripes) — and
+        # the whole compact count block flushes once at the final grid
+        # step.  At N=81,920/c_blk=512 (nc=160) the per-stripe form
+        # would be a 3.4 GB int16 side output that cannot fit HBM
+        # beside the lanes.
         # reductions stay >= 2-D throughout: a rank-1 intermediate here
         # crashes the TPU lowering (layout.h implicit_dim check)
         if "rcnt" in stub:
@@ -1704,25 +1714,27 @@ def _rr_kernel(
         else:
             rc = jnp.sum(st_mem.astype(jnp.int32), axis=2)
             rc = jnp.sum(rc, axis=1, keepdims=True)
-            # int16: a per-stripe partial is <= cs*LANE <= 4096; the
-            # accumulated form widens via the output dtype at N >= 32,768
-            bc = jnp.broadcast_to(rc, (rc.shape[0], LANE))
             if not rcnt_acc:
-                rcnt_out[...] = bc.astype(rcnt_out.dtype)
+                # int16 output: a per-stripe partial is <= cs*LANE <= 4096
+                rcnt_out[...] = jnp.broadcast_to(
+                    rc, (rc.shape[0], LANE)
+                ).astype(rcnt_out.dtype)
             else:
-                rrows_c = pl.ds(i * r_blk, r_blk)
+                rpl = r_blk // LANE
+                rc2 = rc.reshape(rpl, LANE)   # sublane -> lane relayout
+                arows = pl.ds(i * rpl, rpl)
 
                 @pl.when(j == 0)
                 def _():
-                    racc[rrows_c] = bc.astype(racc.dtype)
+                    racc[arows] = rc2
 
                 @pl.when(j > 0)
                 def _():
-                    racc[rrows_c] = racc[rrows_c] + bc.astype(racc.dtype)
+                    racc[arows] = racc[arows] + rc2
 
-                @pl.when(j == nstripes - 1)
+                @pl.when((j == nstripes - 1) & (i == nblocks - 1))
                 def _():
-                    rcnt_out[...] = racc[rrows_c].astype(rcnt_out.dtype)
+                    rcnt_out[...] = racc[...]
 
         @pl.when(i == 0)
         def _():
@@ -1806,12 +1818,14 @@ def resident_round_blocked(
     * statics: the protocol constants; ``window`` is the int8 rebase window.
 
     Returns (hb', asl', member_cnt [nc,cs,LANE], n_det, first_obs,
-    recv_cnt — per-receiver member counts, lane-replicated, in one of two
-    forms (both reduce with ``recv_cnt.reshape(n, -1).sum(1) // LANE``):
-    [N, nc*LANE] per-stripe partials (default, nc <= RR_ACC_STRIPES) or
-    [N, LANE] stripe-complete counts (deep-stripe shapes; accumulated in
-    VMEM, ``rcnt_acc`` overrides the choice).  The counts feed the NEXT
-    round's active/refresher split (carried by the scan — the
+    recv_cnt — per-receiver member counts, in one of two forms:
+    [N, nc*LANE] lane-replicated per-stripe partials (default,
+    nc <= RR_ACC_STRIPES; reduce with
+    ``recv_cnt.reshape(n, -1).sum(1) // LANE``) or [N/LANE, LANE]
+    LANE-COMPACTED stripe-complete counts (deep-stripe shapes,
+    accumulated in VMEM at 4 B/receiver; ``recv_cnt.reshape(n)`` IS the
+    count vector; ``rcnt_acc`` overrides the choice).  The counts feed
+    the NEXT round's active/refresher split (carried by the scan — the
     member-count XLA pass is gone too).
     """
     nc, n, cs, _ = hb.shape
@@ -1916,13 +1930,18 @@ def resident_round_blocked(
 
     # per-receiver count output form: per-stripe partial blocks by default
     # (the write hides under the compute-bound kernel — round-5 A/B), the
-    # in-kernel accumulator at deep stripe counts, where the per-stripe
-    # side output grows with nc and stops fitting HBM beside the lanes
-    # (N=81,920 at c_blk=512: nc=160 -> 3.4 GB int16).  Per-stripe partials
-    # (<= cs*LANE <= 4096) always fit int16; the accumulated form holds
-    # full counts <= N and widens at the capacity frontier.
+    # lane-compacted in-kernel accumulator at deep stripe counts, where
+    # the per-stripe side output grows with nc and stops fitting HBM
+    # beside the lanes (N=81,920 at c_blk=512: nc=160 -> 3.4 GB int16).
+    # compact accumulated counts are full per-receiver counts (<= N):
+    # always int32; the per-stripe partials (<= cs*LANE <= 4096) ship int16
     use_acc = rcnt_acc if rcnt_acc is not None else nc > RR_ACC_STRIPES
-    cnt_dt = jnp.int32 if (use_acc and n >= 32_768) else jnp.int16
+    cnt_dt = jnp.int32 if use_acc else jnp.int16
+    if use_acc and (r_blk % LANE or n % LANE):
+        raise ValueError(
+            f"accumulated count form needs LANE-divisible block_r and N "
+            f"(block_r={r_blk}, N={n})"
+        )
 
     # per-subject int8 threshold stack for the packed in-kernel arithmetic
     # (see the module comment above _rr_tick_packed); the int8 casts wrap
@@ -2030,16 +2049,16 @@ def resident_round_blocked(
         out_specs=[
             lane_blk, lane_blk,
             subj_spec, subj_spec, subj_spec,
-            # per-receiver counts: per-stripe partial blocks (default), or
-            # — accumulated form — a write-only window parked on block
-            # (0, 0) until the last stripe pass walks the receiver blocks
-            # and flushes the completed counts (earlier retirements write
-            # scratch garbage to block (0, 0); the final i=0 visit
-            # overwrites it — grid steps execute in order)
+            # per-receiver counts: per-stripe partial blocks (default),
+            # or — accumulated form — the whole LANE-COMPACTED count
+            # block (N/LANE rows: 4 B/receiver, small enough to stay
+            # resident for the entire grid), written once at the final
+            # step from the compact accumulator
             pl.BlockSpec(
-                (r_blk, LANE),
-                (lambda j, i: (jnp.where(j == nc - 1, i, 0), 0))
-                if use_acc else (lambda j, i: (i, j)),
+                (n // LANE, LANE), lambda j, i: (0, 0),
+                memory_space=pltpu.VMEM,
+            ) if use_acc else pl.BlockSpec(
+                (r_blk, LANE), lambda j, i: (i, j),
                 memory_space=pltpu.VMEM,
             ),
         ],
@@ -2050,7 +2069,7 @@ def resident_round_blocked(
             jax.ShapeDtypeStruct((nc, cs, LANE), jnp.int32),
             jax.ShapeDtypeStruct((nc, cs, LANE), jnp.int32),
             jax.ShapeDtypeStruct(
-                (n, LANE) if use_acc else (n, nc * LANE), cnt_dt),
+                (n // LANE, LANE) if use_acc else (n, nc * LANE), cnt_dt),
         ],
         scratch_shapes=[
             # aligned-arc mode never reads the stripe (write-only): a
@@ -2067,9 +2086,9 @@ def resident_round_blocked(
             pltpu.VMEM((max(ch, r_blk), cs, LANE), jnp.int32),  # dbuf
             pltpu.VMEM((max(ch, r_blk), cs, LANE), jnp.int8),   # flbuf
         ] + rblock_scratch + arc_scratch + (
-            # the accumulated form's per-receiver count scratch (persists
-            # across the whole grid; flushed on the last stripe pass)
-            [pltpu.VMEM((n, LANE), cnt_dt)] if use_acc else []),
+            # the accumulated form's LANE-COMPACTED count scratch
+            # (persists across the whole grid; flushed at the final step)
+            [pltpu.VMEM((n // LANE, LANE), cnt_dt)] if use_acc else []),
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=126 * 1024 * 1024),
         interpret=interpret,

@@ -342,9 +342,10 @@ def test_rr_deep_shift_regime_parity(topology, rr_resident, arc_align):
 
 def test_rr_rcnt_accumulated_form_matches_per_stripe():
     """The deep-stripe count form (rcnt_acc=True: per-stripe partials
-    accumulate in VMEM, one [N, LANE] flush on the last stripe pass —
-    what the N=81,920/c_blk=512 capacity frontier needs, where the
-    per-stripe output would be a 3.4 GB side buffer) must produce the
+    accumulate in a LANE-COMPACTED [N/LANE, LANE] VMEM scratch, flushed
+    once at the final grid step — what the capacity frontier needs,
+    where the per-stripe output would be a 3.4 GB side buffer; NOT
+    lane-replicated, so reshape(n) IS the count vector) must produce the
     same lane outputs and the same reduced per-receiver counts as the
     default per-stripe form, on identical inputs."""
     import numpy as np
@@ -379,10 +380,12 @@ def test_rr_rcnt_accumulated_form_matches_per_stripe():
                           ("hb", "asl", "cnt", "ndet", "fobs")):
         assert jnp.array_equal(a, b), name
     assert out_ps[5].shape == (n, nc * mp.LANE)
-    assert out_ac[5].shape == (n, mp.LANE)
-    red = lambda r: np.asarray(  # noqa: E731
-        jnp.sum(r.reshape(n, -1), axis=1, dtype=jnp.int32) // mp.LANE)
-    np.testing.assert_array_equal(red(out_ps[5]), red(out_ac[5]))
+    assert out_ac[5].shape == (n // mp.LANE, mp.LANE)
+    red_ps = np.asarray(
+        jnp.sum(out_ps[5].reshape(n, -1), axis=1, dtype=jnp.int32)
+        // mp.LANE)
+    red_ac = np.asarray(out_ac[5].reshape(n)).astype(np.int32)
+    np.testing.assert_array_equal(red_ps, red_ac)
 
 
 def test_stripe_and_arc_kernel_smoke():
