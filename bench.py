@@ -41,6 +41,45 @@ def probe_tpu(timeout_s: float = 120.0) -> bool:
         return False
 
 
+_SWAR_PROBE = """
+import dataclasses, jax, jax.numpy as jnp
+from gossipfs_tpu.config import SimConfig
+from gossipfs_tpu.core.rounds import run_rounds
+from gossipfs_tpu.core.state import init_state
+outs = {}
+for ew in ("lanes", "swar"):
+    cfg = SimConfig(n=4096, topology="random_arc", fanout=16, arc_align=8,
+                    remove_broadcast=False, fresh_cooldown=True,
+                    t_cooldown=12, merge_kernel="pallas_rr",
+                    merge_block_c=2048, view_dtype="int8", hb_dtype="int8",
+                    rr_resident="on", merge_block_r=512, elementwise=ew)
+    out = run_rounds(init_state(cfg), cfg, 4, jax.random.PRNGKey(0),
+                     crash_rate=0.01)
+    outs[ew] = jax.tree.leaves(out)
+assert all(bool(jnp.array_equal(a, b))
+           for a, b in zip(outs["lanes"], outs["swar"]))
+"""
+
+
+def probe_swar(timeout_s: float = 600.0) -> bool:
+    """Compiled-Mosaic validation of the SWAR elementwise path before the
+    headline uses it: 4 aligned-arc rr rounds at N=4,096, swar vs lanes
+    bit-equal ON THE CHIP.  The interpret-mode parity suite pins the
+    semantics on CPU; this probe is what gates the COMPILED form (Mosaic
+    lowering of the packed-word ops) into the headline config, in a
+    subprocess so a lowering failure costs the lanes fallback, not the
+    bench run."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _SWAR_PROBE],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main() -> None:
     use_tpu = os.environ.get("JAX_PLATFORMS", "") == "axon" and probe_tpu()
     if not use_tpu:
@@ -99,13 +138,32 @@ def main() -> None:
         # The 126-round int8 rebase window is certified by the 50k-round
         # churn soak (bench/soak_hb16.py, int8 lane)
         hb_dtype="int8",
+        # SWAR packed-word elementwise (ops/swar.py): 4 subjects per i32
+        # VPU op for the tick/view/merge compare-select chains — the
+        # round-6 attack on the ~7 ms/round VPU compute wall the round-5
+        # stub bisection quantified.  Gated on probe_swar(): the compiled
+        # Mosaic form must prove bit-equality on-chip before the headline
+        # trusts it (CPU interpret parity is pinned by the test suite,
+        # but this session had no TPU to validate the compiled lowering)
+        elementwise="swar" if use_tpu and probe_swar() else "lanes",
     )
     key = jax.random.PRNGKey(0)
     state = init_state(cfg)
 
-    # warmup: compile + one short run
-    st, mc, pr = run_rounds(state, cfg, ROUNDS, key, crash_rate=CRASH_RATE)
-    jax.block_until_ready(st)
+    # warmup: compile + one short run (falls back to the widened lanes
+    # path if the SWAR headline-shape compile fails where the small-shape
+    # probe passed)
+    try:
+        st, mc, pr = run_rounds(state, cfg, ROUNDS, key, crash_rate=CRASH_RATE)
+        jax.block_until_ready(st)
+    except Exception:
+        if cfg.elementwise != "swar":
+            raise
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, elementwise="lanes")
+        st, mc, pr = run_rounds(state, cfg, ROUNDS, key, crash_rate=CRASH_RATE)
+        jax.block_until_ready(st)
 
     # best over a sampling window: the axon chip is pooled and can be
     # time-/bandwidth-shared with other tenants for minutes at a stretch
@@ -118,24 +176,32 @@ def main() -> None:
     # contention-suppressed (> 3x the quiet-window rate this build
     # measures, documented in BASELINE.md), sampling extends up to 300 s
     # total to find an uncontended slot.
-    elapsed = float("inf")
+    samples: list[float] = []  # per-attempt elapsed seconds
     start = time.monotonic()
     deadline = start + 90.0
     hard_deadline = start + 300.0
-    attempts = 0
-    while attempts < 3 or (time.monotonic() < deadline and attempts < 60):
+    while len(samples) < 3 or (time.monotonic() < deadline
+                               and len(samples) < 60):
         t0 = time.perf_counter()
         st, mc, pr = run_rounds(state, cfg, ROUNDS, key, crash_rate=CRASH_RATE)
         jax.block_until_ready(st)
-        elapsed = min(elapsed, time.perf_counter() - t0)
-        attempts += 1
+        samples.append(time.perf_counter() - t0)
         if (use_tpu and time.monotonic() >= deadline
-                and ROUNDS / elapsed < 30.0 and deadline < hard_deadline):
+                and ROUNDS / min(samples) < 30.0 and deadline < hard_deadline):
             deadline = min(deadline + 60.0, hard_deadline)
-        if attempts < 60 and time.monotonic() < deadline - 3.0:
+        if len(samples) < 60 and time.monotonic() < deadline - 3.0:
             time.sleep(3.0)
 
-    rounds_per_sec = ROUNDS / elapsed
+    # honest headline: the MEDIAN attempt is the canonical value (what a
+    # typical window delivers); the best attempt is reported alongside —
+    # it remains the right lens for "the framework's rate on the chip"
+    # under neighbor contention, but it no longer IS the headline
+    # (VERDICT r5 "what's weak" #1)
+    rates = sorted(ROUNDS / s for s in samples)
+    median = rates[len(rates) // 2] if len(rates) % 2 else (
+        (rates[len(rates) // 2 - 1] + rates[len(rates) // 2]) / 2.0
+    )
+    best = rates[-1]
     platform = jax.devices()[0].platform
     print(
         json.dumps(
@@ -145,10 +211,15 @@ def main() -> None:
                     f"{'fanout=16 tile-aligned arc' if use_tpu else 'fanout=log2(N)'}, "
                     f"1% crash churn ({platform})"
                 ),
-                "value": round(rounds_per_sec, 2),
+                "value": round(median, 2),
+                "median": round(median, 2),
+                "best": round(best, 2),
+                "attempts": len(samples),
+                "window_s": round(time.monotonic() - start, 1),
+                "elementwise": cfg.elementwise,
                 "unit": "rounds/s",
                 # reference heartbeat loop = 1 round/s of wall clock
-                "vs_baseline": round(rounds_per_sec, 2),
+                "vs_baseline": round(median, 2),
             }
         )
     )

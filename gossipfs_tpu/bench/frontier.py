@@ -28,7 +28,8 @@ import time
 
 def run(n: int, rounds: int, block_c: int, crash_at: int, track: int,
         crash_rate: float, seed: int, topology: str, block_r: int,
-        arc_align: int = 1, fanout: int | None = None) -> dict:
+        arc_align: int = 1, fanout: int | None = None,
+        elementwise: str = "lanes") -> dict:
     import jax
 
     from gossipfs_tpu.bench.run import tracked_crash_events
@@ -37,7 +38,7 @@ def run(n: int, rounds: int, block_c: int, crash_at: int, track: int,
     from gossipfs_tpu.metrics.detection import summarize
 
     over = dict(topology=topology, merge_block_r=block_r,
-                arc_align=arc_align)
+                arc_align=arc_align, elementwise=elementwise)
     if fanout:
         over["fanout"] = fanout
     elif arc_align > 1:
@@ -82,6 +83,7 @@ def run(n: int, rounds: int, block_c: int, crash_at: int, track: int,
         "topology": topology,
         "rounds": rounds,
         "crash_churn": crash_rate,
+        "elementwise": elementwise,
         "tracked_crashes": len(crash_rounds),
         "detected": len(ttd_f),
         "ttd_first_median": statistics.median(ttd_f) if ttd_f else None,
@@ -107,11 +109,16 @@ def main(argv=None) -> None:
     p.add_argument("--arc-align", type=int, default=1,
                    help="tile-aligned arc bases (random_arc only)")
     p.add_argument("--fanout", type=int, default=None)
+    p.add_argument("--elementwise", choices=("lanes", "swar"),
+                   default="lanes",
+                   help="packed-word SWAR elementwise (ops/swar.py) vs "
+                        "the widened default")
     args = p.parse_args(argv)
     print(json.dumps(run(args.n, args.rounds, args.block_c, args.crash_at,
                          args.track, args.crash_rate, args.seed,
                          args.topology, args.block_r,
-                         arc_align=args.arc_align, fanout=args.fanout)))
+                         arc_align=args.arc_align, fanout=args.fanout,
+                         elementwise=args.elementwise)))
 
 
 if __name__ == "__main__":

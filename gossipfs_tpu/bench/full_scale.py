@@ -149,12 +149,28 @@ def main(argv=None) -> None:
     p.add_argument("--out", type=str, default=None)
     args = p.parse_args(argv)
     _force_cpu_mesh(args.devices)
-    doc = json.dumps(run(args.n, args.rounds, args.crash_at, args.track,
-                         args.crash_rate, args.devices, args.seed))
-    print(doc)
+    result = run(args.n, args.rounds, args.crash_at, args.track,
+                 args.crash_rate, args.devices, args.seed)
+    print(json.dumps(result))
     if args.out:
-        with open(args.out, "w") as f:
-            f.write(doc + "\n")
+        # the committed artifact keeps ONE canonical filename: the newest
+        # run is "current", superseded runs accumulate in "history" (a
+        # round-5 review found the obvious filename holding a stale run
+        # while the newest hid in a suffixed file)
+        doc = {"current": result, "history": []}
+        if os.path.exists(args.out):
+            with open(args.out) as f:
+                prev = json.load(f)
+            if "current" in prev:
+                doc["history"] = [prev["current"]] + prev.get("history", [])
+            else:  # legacy single-run file
+                doc["history"] = [prev]
+        # atomic replace: these runs cost hours — a kill mid-write must
+        # not destroy the accumulated artifact
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(json.dumps(doc) + "\n")
+        os.replace(tmp, args.out)
 
 
 if __name__ == "__main__":

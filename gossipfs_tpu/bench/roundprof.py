@@ -49,6 +49,12 @@ def variants(n: int) -> dict[str, SimConfig]:
     out = {
         "xla": cfg,
         "pallas_gather": dataclasses.replace(cfg, merge_kernel="pallas"),
+        # all-int8 XLA rounds, widened vs SWAR packed-word elementwise
+        # (ops/swar.py) — these two run compiled on ANY backend, so the
+        # lanes-vs-swar elementwise delta is measurable even off-TPU
+        "xla_hb8": dataclasses.replace(cfg, hb_dtype="int8"),
+        "xla_hb8_swar": dataclasses.replace(
+            cfg, hb_dtype="int8", elementwise="swar"),
     }
     from gossipfs_tpu.ops.merge_pallas import STRIPE_BLOCK_C, stripe_supported
 
@@ -92,6 +98,14 @@ def variants(n: int) -> dict[str, SimConfig]:
             merge_kernel="pallas_rr",
             merge_block_c=2048, hb_dtype="int8", merge_block_r=512,
             rr_resident="on",
+        )
+        # the round-6 headline candidate: the same resident aligned-arc
+        # kernel with the SWAR packed-word elementwise stages (4 subjects
+        # per i32 VPU op) — the delta vs rr_arc_al_resident is the
+        # recovered share of the ~7 ms/round VPU wall the round-5 stub
+        # bisection measured
+        out["rr_arc_al_resident_swar"] = dataclasses.replace(
+            out["rr_arc_al_resident"], elementwise="swar",
         )
     return out
 
@@ -212,6 +226,8 @@ def main(argv=None) -> None:
         rows[name] = {
             "ms_per_round": round(per_round * 1e3, 3),
             "rounds_per_sec": round(1.0 / per_round, 1),
+            "elementwise": cfg.elementwise,
+            "backend": jax.default_backend(),
             **bandwidth_row(cfg, per_round),
         }
         print(json.dumps({"config": name, "n": args.n, **rows[name]}), flush=True)

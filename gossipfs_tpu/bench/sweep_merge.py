@@ -9,6 +9,7 @@ Run: JAX_PLATFORMS=axon python -m gossipfs_tpu.bench.sweep_merge
 
 from __future__ import annotations
 
+import argparse
 import itertools
 
 import jax
@@ -28,30 +29,43 @@ def timed(cfg: SimConfig, key: jax.Array) -> float:
     ]
 
 
-def main() -> None:
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--hb-dtype", choices=("int32", "int16", "int8"),
+                   default="int32")
+    p.add_argument("--elementwise", nargs="*", choices=("lanes", "swar"),
+                   default=["lanes"],
+                   help="epilogue formulations to sweep (swar needs "
+                        "--hb-dtype int8; see config.SimConfig.elementwise)")
+    args = p.parse_args(argv)
+
     key = jax.random.PRNGKey(0)
     results = []
-    for br, bc, slots in itertools.product(
-        (64, 128, 256), (4096, 8192, 16384), (2, 4, 8)
+    for (br, bc, slots), ew in itertools.product(
+        itertools.product((64, 128, 256), (4096, 8192, 16384), (2, 4, 8)),
+        args.elementwise,
     ):
-        cfg = SimConfig(
-            n=N, topology="random", fanout=SimConfig.log_fanout(N),
-            remove_broadcast=False, fresh_cooldown=True, t_cooldown=12,
-            merge_kernel="pallas", merge_block_r=br, merge_block_c=bc,
-            merge_slots=slots,
-        )
+        tag = f"br={br} bc={bc} slots={slots} ew={ew}"
         try:
+            cfg = SimConfig(
+                n=N, topology="random", fanout=SimConfig.log_fanout(N),
+                remove_broadcast=False, fresh_cooldown=True, t_cooldown=12,
+                merge_kernel="pallas", merge_block_r=br, merge_block_c=bc,
+                merge_slots=slots, hb_dtype=args.hb_dtype,
+                view_dtype="int8" if args.hb_dtype == "int8" else "int16",
+                elementwise=ew,
+            )
             rps = timed(cfg, key)
         except Exception as e:  # VMEM exhaustion at large out blocks
-            print(f"br={br} bc={bc} slots={slots}: FAIL {type(e).__name__}", flush=True)
+            print(f"{tag}: FAIL {type(e).__name__}", flush=True)
             continue
-        results.append((rps, br, bc, slots))
-        print(f"br={br} bc={bc} slots={slots}: {rps:.1f} rounds/s", flush=True)
+        results.append((rps, tag))
+        print(f"{tag}: {rps:.1f} rounds/s", flush=True)
     if not results:
         print("no configuration succeeded")
         return
-    rps, br, bc, slots = max(results)
-    print(f"best: {rps:.1f} rounds/s at br={br} bc={bc} slots={slots}")
+    rps, tag = max(results)
+    print(f"best: {rps:.1f} rounds/s at {tag}")
 
 
 if __name__ == "__main__":

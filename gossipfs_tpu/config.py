@@ -129,6 +129,21 @@ class SimConfig:
                                      # ALU-bound round 4x denser AND fuse
                                      # the epilogue's outputs into one pass;
                                      # requires view_dtype="int8")
+    elementwise: str = "lanes"       # implementation of the round's elementwise
+                                     # compare/select/age math over int8 lanes:
+                                     # "lanes" widens every int8 element to its
+                                     # own i32 VPU slot (ordered compares exist
+                                     # only at i32 width on v5e Mosaic — see
+                                     # BASELINE.md round-5 probes); "swar" packs
+                                     # 4 subjects per i32 word and runs the
+                                     # compares/selects with carry-safe bitwise
+                                     # arithmetic (ops/swar.py) — 4 subjects per
+                                     # VPU op, same bits (pinned by the swar
+                                     # parity tests + golden fuzz).  Applies to
+                                     # the XLA membership-update/tick epilogues
+                                     # and the resident-round pallas kernel;
+                                     # requires the all-int8 state
+                                     # (hb_dtype="int8")
     rr_resident: str = "auto"        # resident-lanes mode of the rr kernel:
                                      # park the raw lanes in VMEM during the
                                      # view-build read so the receiver sweep
@@ -267,6 +282,12 @@ class SimConfig:
                     )
         if self.rr_resident not in ("auto", "on", "off"):
             raise ValueError(f"unknown rr_resident: {self.rr_resident!r}")
+        if self.elementwise not in ("lanes", "swar"):
+            raise ValueError(f"unknown elementwise: {self.elementwise!r}")
+        if self.elementwise == "swar" and self.hb_dtype != "int8":
+            # the SWAR word math packs 4 int8 subjects per i32 and relies
+            # on every lane (hb, age, status, view) being one byte
+            raise ValueError("elementwise='swar' requires hb_dtype='int8'")
         if self.fused_tick not in ("auto", "off"):
             raise ValueError(f"unknown fused_tick: {self.fused_tick!r}")
         if self.view_dtype not in ("int16", "int8"):

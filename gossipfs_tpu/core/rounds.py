@@ -42,7 +42,15 @@ from gossipfs_tpu.config import (
     SimConfig,
 )
 from gossipfs_tpu.core import topology
-from gossipfs_tpu.core.state import FAILED, MEMBER, UNKNOWN, RoundEvents, SimState
+from gossipfs_tpu.core.state import (
+    FAILED,
+    MEMBER,
+    UNKNOWN,
+    RoundEvents,
+    SimState,
+    swar_lanes_ok,
+)
+from gossipfs_tpu.ops import swar
 
 # ---------------------------------------------------------------------------
 # Blocked layout.
@@ -392,6 +400,11 @@ def _tick(
 
     Returns (state, fail_events [N,N] bool).
     """
+    if config.elementwise == "swar" and swar_lanes_ok(state.hb):
+        # packed-word formulation of the all-int8 tick: 4 subjects per
+        # i32 op, bit-identical per byte (see _tick_swar)
+        return _tick_swar(state, config, ctx, active=active,
+                          refresher=refresher)
     n = state.n
     hb, age, status, alive = state.hb, state.age, state.status, state.alive
     nd, shp = hb.ndim, hb.shape
@@ -463,6 +476,100 @@ def _tick(
     status = jnp.where(expire, UNKNOWN, status)
 
     return state._replace(hb=hb, age=age, status=status, alive=alive), fail
+
+
+def _eye_words(n: int, shape: tuple[int, ...], ctx: ShardCtx = LOCAL_CTX) -> jax.Array:
+    """Packed-word diagonal mask: byte set (0xFF) where receiver == subject.
+
+    The SWAR path packs 4 subjects per i32 word along the minor axis
+    (ops/swar.py), so the diagonal differs per byte: byte k of word g
+    covers subject ``4g + k``.  Built from 4 word-width compares — the
+    same op count as ONE byte-width compare over the unpacked lanes.
+    """
+    nd = len(shape)
+    cols = ctx.offset + jnp.arange(_nsubj(shape), dtype=jnp.int32)
+    colw = cols.reshape(shape[1:-1] + (shape[-1] // 4, 4))[..., 0][None]
+    rows = _rx(jnp.arange(n, dtype=jnp.int32), nd)
+    out = None
+    for k, bm in enumerate(swar.BYTE):
+        m = jnp.where(rows == colw + k, jnp.int32(bm), jnp.int32(0))
+        out = m if out is None else out | m
+    return out
+
+
+def _tick_swar(
+    state: SimState,
+    config: SimConfig,
+    ctx: ShardCtx = LOCAL_CTX,
+    *,
+    active: jax.Array,
+    refresher: jax.Array,
+) -> tuple[SimState, jax.Array]:
+    """SWAR formulation of :func:`_tick`'s all-int8 narrow branch.
+
+    Identical semantics, 4 subjects per i32 word (ops/swar.py): the
+    refresh/bump selects, the clipped grace compare, the t_fail/t_cooldown
+    threshold compares and the FAILED/UNKNOWN status writes all run as
+    carry-safe bitwise word ops.  Per-receiver masks (active/refresher/
+    alive) are uniform across a word's 4 bytes, so they enter as -1/0
+    whole-word masks; per-subject thresholds pack 4 to a word; only the
+    diagonal (bump) mask differs per byte (:func:`_eye_words`).  Pinned
+    bit-equal to the lanes branch by the swar parity tests and the golden
+    fuzz suite.
+    """
+    n = state.n
+    hb, age, status, alive = state.hb, state.age, state.status, state.alive
+    nd, shp = hb.ndim, hb.shape
+    MEM = swar.word(int(MEMBER))
+    FLW = swar.word(int(FAILED))
+    SENT = swar.word(0x80)  # the -128 floor-sentinel byte
+    hbw, agew, stw = swar.pack(hb), swar.pack(age), swar.pack(status)
+
+    def rowm(v: jax.Array) -> jax.Array:
+        return swar.bool_mask(v).reshape((n,) + (1,) * (nd - 1))
+
+    act_m, ref_m = rowm(active), rowm(refresher)
+    eye_b = _eye_words(n, shp, ctx)
+    stm_b = swar.to_bytes(swar.eq(stw, MEM))
+
+    # small groups only refresh timestamps
+    agew = swar.sel(ref_m & stm_b, jnp.int32(0), agew)
+    # sentinel-sticky diagonal bump + stamp
+    bump_b = eye_b & act_m & stm_b & swar.to_bytes(swar.ne(hbw, SENT))
+    hbw = swar.add(hbw, bump_b & swar.L)
+    agew = swar.sel(bump_b, jnp.int32(0), agew)
+
+    # detection: per-subject clipped grace threshold (i32 vector math,
+    # packed once) over the post-bump lanes
+    basec = state.hb_base.reshape(shp[1:])
+    thr8 = jnp.clip(config.hb_grace - basec + 1, -128, 127).astype(jnp.int8)
+    thrw = swar.pack(thr8)[None]
+    past_h = swar.ges(hbw, thrw) & swar.ne(hbw, SENT)
+    fail_b = (
+        act_m & stm_b & ~eye_b
+        & swar.to_bytes(past_h & swar.gts(agew, swar.word(config.t_fail)))
+    )
+    stw = swar.sel(fail_b, FLW, stw)
+    if config.fresh_cooldown:
+        agew = swar.sel(fail_b, jnp.int32(0), agew)
+
+    if config.remove_broadcast:
+        # one detection removes j everywhere this round: OR the full-byte
+        # fail masks over receivers (word-level reduce, byte-exact)
+        removed = lax.reduce(fail_b, jnp.int32(0), lax.bitwise_or, (0,))
+        mark_b = rowm(alive) & swar.to_bytes(swar.eq(stw, MEM)) & removed[None]
+        stw = swar.sel(mark_b, FLW, stw)
+        if config.fresh_cooldown:
+            agew = swar.sel(mark_b, jnp.int32(0), agew)
+
+    expire_b = swar.to_bytes(
+        swar.eq(stw, FLW) & swar.gts(agew, swar.word(config.t_cooldown))
+    )
+    stw = stw & ~expire_b  # UNKNOWN == 0
+    fail = swar.unpack(fail_b) != 0
+    return state._replace(
+        hb=swar.unpack(hbw), age=swar.unpack(agew), status=swar.unpack(stw)
+    ), fail
 
 
 def _rebase_shifts(
@@ -577,6 +684,10 @@ def _membership_update(
     hb, age, status, alive = state.hb, state.age, state.status, state.alive
     nd = hb.ndim
     narrow = hb.dtype != jnp.int32
+    if narrow and config.elementwise == "swar" and swar_lanes_ok(hb):
+        # packed-word formulation of the all-int8 epilogue (4 subjects
+        # per i32 op) — complete, including the age advance
+        return _membership_update_swar(state, best_rel, shift_a, shift_b)
     vdtype = jnp.int8 if config.view_dtype == "int8" else jnp.int16
     any_member = best_rel >= 0
     recv = _rx(alive, nd)
@@ -655,6 +766,70 @@ def _membership_update(
     status = jnp.where(add, MEMBER, status)
     age = jnp.minimum(age + 1, AGE_CLAMP).astype(jnp.int8)
     return hb, age, status
+
+
+def _membership_update_swar(
+    state: SimState,
+    best_rel: jax.Array,
+    shift_a: jax.Array,
+    shift_b: jax.Array,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """SWAR formulation of :func:`_membership_update`'s all-int8 branch.
+
+    Term-for-term mirror of the narrow (int8-stored, int8-view) epilogue
+    — max-merge advance, UNKNOWN add, floor/ceiling saturation selects,
+    fresh stamp, age advance — over packed words (4 subjects per i32 op,
+    ops/swar.py).  The per-subject saturation thresholds are the narrow
+    branch's exact clip math (i32 vector ops, packed once); byte adds and
+    subs wrap mod 2^8 exactly like the narrow branch's int8 arithmetic.
+    Pinned bit-equal by the swar parity tests and the golden fuzz suite.
+    """
+    hb, age, status, alive = state.hb, state.age, state.status, state.alive
+    n, nd, shp = state.n, hb.ndim, hb.shape
+    MEM = swar.word(int(MEMBER))
+    FLOOR = swar.word(0x80)  # the int8 storage floor, -128
+    sb32 = shift_b
+    d32 = shift_a - shift_b
+
+    def vecw(v8: jax.Array) -> jax.Array:
+        return swar.pack(v8.reshape(shp[1:]))[None]
+
+    sa_nw = vecw(shift_a.astype(jnp.int8))
+    cmp_deepw = vecw(jnp.clip(-129 - shift_a, -2, 127).astype(jnp.int8))
+    d8w = vecw(d32.astype(jnp.int8))
+    up_deepw = vecw(jnp.clip(-129 - d32, -2, 127).astype(jnp.int8))
+    keep_thrw = vecw(jnp.clip(sb32 - 129, -128, 127).astype(jnp.int8))
+    hi_thrw = vecw(jnp.clip(sb32 + 128, -128, 127).astype(jnp.int8))
+    has_hi_b = vecw(jnp.where(sb32 < 0, -1, 0).astype(jnp.int8))
+    sb8w = vecw(sb32.astype(jnp.int8))
+
+    hbw, agew, stw = swar.pack(hb), swar.pack(age), swar.pack(status)
+    bestw = swar.pack(best_rel)
+    recv_m = swar.bool_mask(alive).reshape((n,) + (1,) * (nd - 1))
+    anym_h = ~bestw & swar.H  # best_rel >= 0: sign bit clear
+    adv_b = recv_m & swar.to_bytes(
+        swar.eq(stw, MEM) & anym_h
+        & swar.gts(bestw, cmp_deepw)
+        & swar.gts(swar.add(bestw, sa_nw), hbw)  # the wrapping int8 lhs
+    )
+    add_b = recv_m & swar.to_bytes(swar.eq(stw, 0) & anym_h)
+    upd_b = adv_b | add_b
+    up_val = swar.sel(
+        swar.to_bytes(swar.les(bestw, up_deepw)), FLOOR,
+        swar.add(bestw, d8w),
+    )
+    keep_val = swar.sel(
+        has_hi_b & swar.to_bytes(swar.ges(hbw, hi_thrw)),
+        swar.word(127), swar.sub(hbw, sb8w),
+    )
+    keep_val = swar.sel(
+        swar.to_bytes(swar.les(hbw, keep_thrw)), FLOOR, keep_val
+    )
+    hbw = swar.sel(upd_b, up_val, keep_val)
+    agew = swar.sel(upd_b, jnp.int32(0), agew)
+    stw = swar.sel(add_b, MEM, stw)
+    agew = swar.mins(swar.add(agew, swar.L), swar.word(AGE_CLAMP))
+    return swar.unpack(hbw), swar.unpack(agew), swar.unpack(stw)
 
 
 def _merge_best(
@@ -1325,6 +1500,7 @@ def _scan_rounds_rr_packed(
                 block_r=config.merge_block_r, interpret=interp,
                 resident=resident, col_offset=ctx.offset,
                 arc_align=config.arc_align,
+                elementwise=config.elementwise,
             )
         )
         # two count forms (merge_pallas.resident_round_blocked): the

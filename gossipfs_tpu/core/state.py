@@ -114,3 +114,16 @@ def init_state(config: SimConfig, member_mask: jax.Array | None = None) -> SimSt
 def member_counts(state: SimState) -> jax.Array:
     """Size of each node's membership list (int32 [N])."""
     return jnp.sum((state.status == MEMBER).astype(jnp.int32), axis=1)
+
+
+def swar_lanes_ok(hb: jax.Array) -> bool:
+    """Whether the SWAR elementwise path can pack this state's lanes.
+
+    The packed-word formulation (``config.elementwise="swar"``,
+    ops/swar.py) runs the round's compares/selects on 4 subjects per i32
+    word; it needs all-int8 storage and a minor (subject) axis divisible
+    by the 4-byte word — true for every lane-aligned shape (the minor
+    axis is LANE=128 blocked, or the lane-aligned column count 2-D).
+    Static (trace-time) predicate: shapes and dtypes only.
+    """
+    return hb.dtype == jnp.int8 and hb.shape[-1] % 4 == 0

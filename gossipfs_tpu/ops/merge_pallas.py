@@ -39,7 +39,21 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from gossipfs_tpu.ops import swar
+
 LANE = 128
+
+# jax-version compat: the Mosaic compiler-params dataclass was named
+# TPUCompilerParams before jax 0.5; resolve whichever this runtime ships.
+# Fail HERE, by name, if neither exists — a silent None would surface as
+# an opaque "'NoneType' object is not callable" at first kernel call
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+if _CompilerParams is None:  # pragma: no cover - future jax renames only
+    raise ImportError(
+        "pallas TPU exposes neither CompilerParams nor TPUCompilerParams "
+        "on this jax version — update the compat shim in ops/merge_pallas.py"
+    )
 
 # Narrowest column block the COMPILED kernel can move: the int8 lanes'
 # native tile is (32, 128), so a DMA unit (C/128, 128) needs C >= 32*128.
@@ -488,7 +502,7 @@ def fused_merge_update_blocked(
         # temporaries put peak scoped-VMEM at ~85 MB with 16k-wide blocks —
         # far above Mosaic's 16 MB default but inside the v5e's 128 MB
         # physical VMEM
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024),
+        compiler_params=_CompilerParams(vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(edges, view4, hb, age, status, alive_lanes, shift_a, shift_b)
     return tuple(out)
@@ -677,8 +691,14 @@ def rr_supported(n: int, fanout: int, c_blk: int,
         # that scale with N regardless of stripe width: the flags block
         # and, on deep-stripe shapes, the count accumulator (int32 at
         # N >= 32,768).  Omitting those admitted a 16-way N=262,144
-        # shape whose scratch demanded 225 MB (round-5 review).
-        row_bytes = 3 * (n // arc_align) * c_blk + n * LANE
+        # shape whose scratch demanded 225 MB (round-5 review).  The T/W
+        # bytes come from rr_align_scratch_bytes — the SAME function the
+        # kernel's own resident check and rr_resident_supported use —
+        # so the two validation paths cannot disagree near the boundary
+        # (an inlined 3*nb*c_blk approximation here used to drop the
+        # wrap-halo rows, (fanout/align - 1) * c_blk * 2 bytes).
+        row_bytes = rr_align_scratch_bytes(n, fanout, c_blk, arc_align) \
+            + n * LANE
         if n_cols // c_blk > RR_ACC_STRIPES:
             # lane-compacted int32 count accumulator + the grid-resident
             # compact count OUTPUT block (both [N/LANE, LANE] int32)
@@ -875,7 +895,7 @@ def stripe_merge_update_blocked(
             pltpu.SemaphoreType.DMA,
             pltpu.SemaphoreType.DMA((3,)),
         ],
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=110 * 1024 * 1024),
+        compiler_params=_CompilerParams(vmem_limit_bytes=110 * 1024 * 1024),
         interpret=interpret,
     )(edges, view, hb, age, status, alive_lanes, shift_a, shift_b)
     return tuple(out)
@@ -1113,7 +1133,7 @@ def arc_merge_update_blocked(
             pltpu.SemaphoreType.DMA,
             pltpu.SemaphoreType.DMA((3,)),
         ],
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=110 * 1024 * 1024),
+        compiler_params=_CompilerParams(vmem_limit_bytes=110 * 1024 * 1024),
         interpret=interpret,
     )(bases.reshape(n, 1), view, hb, age, status, alive_lanes,
       shift_a, shift_b)
@@ -1320,6 +1340,98 @@ def _rr_merge_packed(hb, asl, best, recv, vec, member, unknown, age_clamp):
     return new_hb, new_asl
 
 
+# ---------------------------------------------------------------------------
+# SWAR variants of the packed-byte stages (config.elementwise="swar").
+#
+# The widened formulations above give every int8 element its own i32 VPU
+# slot — unavoidable for ORDERED compares per the round-5 Mosaic probes
+# (i8/i16/bf16 ordered compares don't legalize), but 4x the slots the data
+# needs.  The SWAR forms reinterpret the int8 blocks as i32 words of 4
+# packed subjects (``pltpu.bitcast`` along the sublane axis — a register
+# reinterpret on the TPU's (32, 128) int8 tile, not a shuffle) and run
+# the same compares/selects with carry-safe bitwise word arithmetic
+# (ops/swar.py): ~2x the ops per word, 1/4 the words — and no
+# widen/narrow relayouts at the block edges.  Byte semantics are the
+# widened path's mod-2^8 semantics exactly; parity is pinned by the
+# swar-vs-lanes rr tests and the golden fuzz suite.  Masks travel as
+# hmasks (0x80 per true byte) until a select needs full bytes.
+# ---------------------------------------------------------------------------
+
+
+def _rr_tick_view_swar(hb, asl, act_h, ref_h, vec, member, failed,
+                       t_fail, t_cooldown):
+    """SWAR mirror of :func:`_rr_tick_packed` (diagonal-free chunks) plus
+    the gossip-view encode, over packed words.
+
+    The caller guarantees the diagonal does not cross this block (the
+    in-band chunks run the widened path — the bump chain needs the
+    per-byte eye mask and covers at most c_blk of N rows per stripe), so
+    the whole bump chain drops out exactly as in the widened eye=None
+    branch.  Returns (hb, asl', fail_h, enc) — ``enc`` the encoded view
+    words (absent lanes 0xFF = -1), ``fail_h`` an hmask.
+    """
+    st_bits = asl & swar.word(3)
+    stm_h = swar.eq(st_bits, swar.word(member))
+    nsent_h = swar.ne(hb, swar.H)
+    asl = swar.sel(swar.to_bytes(ref_h & stm_h), st_bits | swar.H, asl)
+    past_h = swar.ges(hb, vec[V_THR_G]) & nsent_h
+    fail_h = (
+        act_h & stm_h & past_h
+        & swar.gts(asl, swar.word(((t_fail << 2) | member) - 128))
+    )
+    asl = swar.sel(swar.to_bytes(fail_h), swar.word(failed - 128), asl)
+    expire_h = (
+        swar.eq(asl & swar.word(3), swar.word(failed))
+        & swar.gts(asl, swar.word(((t_cooldown << 2) | failed) - 128))
+    )
+    asl = swar.sel(swar.to_bytes(expire_h), asl & swar.word(0xFC), asl)
+    stm_out = stm_h & ~fail_h
+    goss_h = (
+        stm_out & act_h
+        & (swar.ges(hb, vec[V_SA_N]) | swar.ne(vec[V_SA_ALL], 0))
+        & swar.les(hb, vec[V_HI_N])
+        & nsent_h
+    )
+    enc = swar.sel(swar.to_bytes(goss_h), swar.sub(hb, vec[V_SA_N]),
+                   swar.word(0xFF))
+    return hb, asl, fail_h, enc
+
+
+def _rr_merge_swar(hb, asl, best, recv_b, vec, member, unknown, age_clamp):
+    """SWAR mirror of :func:`_rr_merge_packed` over packed words.
+
+    ``recv_b`` is a full-byte receiver mask (uniform across a word's 4
+    subjects); ``vec`` holds the per-subject threshold stack as packed
+    words.  Byte adds/subs wrap mod 2^8 — the widened path's store-wrap
+    (and its explicit ``_wrap8`` on ``lhs``) for free.
+    """
+    st = asl & swar.word(3)
+    anym_h = ~best & swar.H  # best >= 0: sign bit clear
+    adv_b = recv_b & swar.to_bytes(
+        swar.eq(st, swar.word(member)) & anym_h
+        & swar.gts(best, vec[V_CMP_DEEP])
+        & swar.gts(swar.add(best, vec[V_SA_N]), hb)
+    )
+    add_b = recv_b & swar.to_bytes(swar.eq(st, swar.word(unknown)) & anym_h)
+    upd_b = adv_b | add_b
+    up_val = swar.sel(swar.to_bytes(swar.les(best, vec[V_UP_DEEP])),
+                      swar.H, swar.add(best, vec[V_D8]))
+    keep_val = swar.sel(
+        swar.to_bytes(swar.ne(vec[V_HAS_HI], 0) & swar.ges(hb, vec[V_HI_THR])),
+        swar.word(127), swar.sub(hb, vec[V_SB8]),
+    )
+    keep_val = swar.sel(swar.to_bytes(swar.les(hb, vec[V_KEEP_THR])),
+                        swar.H, keep_val)
+    new_hb = swar.sel(upd_b, up_val, keep_val)
+    base = swar.sel(add_b, swar.word(member - 128),
+                    swar.sel(adv_b, st | swar.H, asl))
+    new_asl = swar.sel(
+        swar.to_bytes(swar.ges(base, swar.word((age_clamp << 2) - 128))),
+        base, swar.add(base, swar.word(4)),
+    )
+    return new_hb, new_asl
+
+
 def _rr_kernel(
     n: int, n_fanout: int, r_blk: int, cs: int, chunk: int,
     member: int, unknown: int, failed: int, age_clamp: int,
@@ -1327,8 +1439,13 @@ def _rr_kernel(
     arc: bool = False, resident: bool = False, unroll: int = 1,
     view_dt=jnp.int8, stub: frozenset = frozenset(),
     arc_rows: int = ARC_CHUNK, vslots: int = VSLOTS, arc_align: int = 1,
-    rcnt_acc: bool = False, *, nstripes: int,
+    rcnt_acc: bool = False, swar_mode: bool = False, *, nstripes: int,
 ):
+    # swar_mode: run the elementwise stages over packed 4-subject words
+    # (see the SWAR section above _rr_tick_view_swar).  The view-build
+    # chunks that the diagonal crosses, and the non-resident receiver
+    # sweep (whose tick needs the per-byte eye mask), stay on the widened
+    # path — both formulations are bit-equal, so mixing is invisible.
     # nstripes is the GRID's stripe count — the local nc under column
     # sharding, where deriving it from the global n would be wrong (the
     # last-stripe count flush would never fire); callers pass it
@@ -1380,6 +1497,11 @@ def _rr_kernel(
         # this stripe's per-subject threshold slab, (cs, LANE) rows widened
         # once per grid step — broadcasts against (rows, cs, LANE) blocks
         vec = [vecs_ref[k, 0].astype(jnp.int32) for k in range(N_VEC)]
+        if swar_mode:
+            # the same slab as packed words (register reinterpret along
+            # the sublane axis) for the SWAR stages
+            vecw = [pltpu.bitcast(vecs_ref[k, 0], jnp.int32)
+                    for k in range(N_VEC)]
 
         # One-time iota scratch (first grid step): per-element iotas are
         # NOT hoisted by Mosaic out of the chunk loop — recomputing the
@@ -1398,12 +1520,17 @@ def _rr_kernel(
         def load_flags(start, size):
             # materialize the (size, 1, LANE) -> (size, cs, LANE) flag
             # broadcast ONCE through scratch: Mosaic otherwise re-runs the
-            # sublane-broadcast relayout at every use (~1.6 ms/round)
+            # sublane-broadcast relayout at every use (~1.6 ms/round).
+            # Returns the raw int8 block; the widened path casts at the
+            # use site, the SWAR path bitcasts to packed words (a word's
+            # 4 bytes span the cs axis, where flags are uniform, so flag
+            # words are the row's byte replicated — masks fall out of
+            # plain word bit-tests)
             flbuf[pl.ds(0, size)] = jnp.broadcast_to(
                 flags_all[pl.ds(start, size)].reshape(size, 1, LANE),
                 (size, cs, LANE),
             )
-            return flbuf[pl.ds(0, size)].astype(jnp.int32)
+            return flbuf[pl.ds(0, size)]
 
         def issue_into(buf, sems, blk_rows, rows_per, slot):
             rows = pl.ds(blk_rows * rows_per, rows_per)
@@ -1458,14 +1585,59 @@ def _rr_kernel(
                     stripe[pl.ds(c * chunk, chunk)] = (
                         vbuf[slot, 0].astype(stripe.dtype))
                     return 0
-                if "noflags" in stub:
-                    act_r = ref_r = jnp.bool_(True)
-                else:
-                    flb = load_flags(c * chunk, chunk)
-                    act_r = (flb & 1) != 0
-                    ref_r = (flb & 2) != 0
+
+                def tick_view_swar():
+                    # packed-word tick + view encode (diagonal-free
+                    # chunks only — see _rr_tick_view_swar)
+                    hbw = pltpu.bitcast(vbuf[slot, 0], jnp.int32)
+                    aslw = pltpu.bitcast(vbuf[slot, 1], jnp.int32)
+                    if "noflags" in stub:
+                        act_h = ref_h = jnp.int32(-1)
+                    else:
+                        flw = pltpu.bitcast(
+                            load_flags(c * chunk, chunk), jnp.int32)
+                        act_h = swar.ne(flw & swar.word(1), 0)
+                        ref_h = swar.ne(flw & swar.word(2), 0)
+                    hbw, aslw, _fail, enc = _rr_tick_view_swar(
+                        hbw, aslw, act_h, ref_h, vecw, member, failed,
+                        t_fail, t_cooldown,
+                    )
+                    if resident and "park" not in stub:
+                        hb_res[pl.ds(c * chunk, chunk)] = pltpu.bitcast(
+                            hbw, jnp.int8)
+                        as_res[pl.ds(c * chunk, chunk)] = pltpu.bitcast(
+                            aslw, jnp.int8)
+                    if not no_stripe:
+                        # enc bytes are the stored-wrapped values; widened
+                        # stripes (cs < 32) get the same value the widened
+                        # path's _wrap8 + astype produces
+                        enc8 = pltpu.bitcast(enc, jnp.int8)
+                        stripe[pl.ds(c * chunk, chunk)] = (
+                            enc8 if view_dt == jnp.int8
+                            else enc8.astype(stripe.dtype))
+                    if arc and arc_align > 1 and "wmax" not in stub:
+                        # aligned-arc group max on the packed words (byte
+                        # max over WRAPPED encodings, as the widened path)
+                        tbuf = arc_scratch[0]
+                        gpc = chunk // arc_align
+                        gw = enc.reshape(gpc, arc_align, cs // 4, LANE)
+                        vals = [gw[:, t] for t in range(arc_align)]
+                        while len(vals) > 1:
+                            nxt = [swar.maxs(vals[m], vals[m + 1])
+                                   for m in range(0, len(vals) - 1, 2)]
+                            if len(vals) % 2:
+                                nxt.append(vals[-1])
+                            vals = nxt
+                        tbuf[pl.ds(c * gpc, gpc)] = pltpu.bitcast(
+                            vals[0], jnp.int8).astype(tbuf.dtype)
 
                 def tick_view(eye):
+                    if "noflags" in stub:
+                        act_r = ref_r = jnp.bool_(True)
+                    else:
+                        flb = load_flags(c * chunk, chunk).astype(jnp.int32)
+                        act_r = (flb & 1) != 0
+                        ref_r = (flb & 2) != 0
                     hb = vbuf[slot, 0].astype(jnp.int32)
                     asl = vbuf[slot, 1].astype(jnp.int32)
                     hb, asl, _fail, stm = _rr_tick_packed(
@@ -1539,7 +1711,12 @@ def _rr_kernel(
 
                     @pl.when(~in_band)
                     def _():
-                        tick_view(None)
+                        # the off-band bulk (nchunks - 1 or - 2 of nchunks)
+                        # is where the SWAR density pays
+                        if swar_mode:
+                            tick_view_swar()
+                        else:
+                            tick_view(None)
                 return 0
 
             lax.fori_loop(0, nchunks, body, 0, unroll=False)
@@ -1636,8 +1813,7 @@ def _rr_kernel(
             lax.fori_loop(0, r_blk // unroll, gather, 0, unroll=False)
 
         # --- tick + merge epilogue on the receiver block ----------------
-        flb = load_flags(i * r_blk, r_blk)
-        recv = (flb & 4) != 0
+        flb8 = load_flags(i * r_blk, r_blk)
         if resident:
             rrows = pl.ds(i * r_blk, r_blk)
             raw_hb, raw_as = hb_res[rrows], as_res[rrows]
@@ -1656,31 +1832,58 @@ def _rr_kernel(
                 fobs_out[...] = jnp.zeros_like(fobs_out)
 
             return
-        if resident:
-            # parked lanes are already ticked; (FAILED, age 0) identifies
-            # this round's detections (see the parking comment above)
-            hb = raw_hb.astype(jnp.int32)
-            asl = raw_as.astype(jnp.int32)
-            fail = asl == failed - 128
-        else:
-            act_r = (flb & 1) != 0
-            ref_r = (flb & 2) != 0
-            eye = dbuf[pl.ds(0, r_blk)] == j * cs * LANE + col0 - i * r_blk
-            hb, asl, fail, _stm = _rr_tick_packed(
-                raw_hb.astype(jnp.int32), raw_as.astype(jnp.int32),
-                act_r, ref_r, eye, vec[V_THR_G],
-                member, failed, t_fail, t_cooldown,
+        if swar_mode and resident:
+            # SWAR sweep: the parked lanes reinterpret as packed words, the
+            # merge runs 4 subjects per op (_rr_merge_swar), and the
+            # reduction masks come back as -1/0 bytes via one bitcast each.
+            # (The non-resident sweep re-runs the tick, whose bump chain
+            # needs the per-byte eye mask — it stays on the widened path.)
+            hbw = pltpu.bitcast(raw_hb, jnp.int32)
+            aslw = pltpu.bitcast(raw_as, jnp.int32)
+            fail_h = swar.eq(aslw, swar.word(failed - 128))
+            flw = pltpu.bitcast(flb8, jnp.int32)
+            recv_b = swar.to_bytes(swar.ne(flw & swar.word(4), 0))
+            bestw = pltpu.bitcast(best_scratch[...], jnp.int32)
+            new_hbw, new_aslw = _rr_merge_swar(
+                hbw, aslw, bestw, recv_b, vecw, member, unknown, age_clamp,
             )
+            hb_out[0] = pltpu.bitcast(new_hbw, jnp.int8)
+            as_out[0] = pltpu.bitcast(new_aslw, jnp.int8)
+            recv = (flb8 & 4) != 0  # int8 bit-test (native per the probes)
+            st_mem = pltpu.bitcast(
+                swar.to_bytes(swar.eq(new_aslw & swar.word(3),
+                                      swar.word(member))), jnp.int8) != 0
+            fail = pltpu.bitcast(swar.to_bytes(fail_h), jnp.int8) != 0
+        else:
+            flb = flb8.astype(jnp.int32)
+            recv = (flb & 4) != 0
+            if resident:
+                # parked lanes are already ticked; (FAILED, age 0)
+                # identifies this round's detections (see the parking
+                # comment above)
+                hb = raw_hb.astype(jnp.int32)
+                asl = raw_as.astype(jnp.int32)
+                fail = asl == failed - 128
+            else:
+                act_r = (flb & 1) != 0
+                ref_r = (flb & 2) != 0
+                eye = dbuf[pl.ds(0, r_blk)] == (j * cs * LANE + col0
+                                                - i * r_blk)
+                hb, asl, fail, _stm = _rr_tick_packed(
+                    raw_hb.astype(jnp.int32), raw_as.astype(jnp.int32),
+                    act_r, ref_r, eye, vec[V_THR_G],
+                    member, failed, t_fail, t_cooldown,
+                )
 
-        best = best_scratch[...].astype(jnp.int32)
-        new_hb, new_asl = _rr_merge_packed(
-            hb, asl, best, recv, vec, member, unknown, age_clamp,
-        )
-        hb_out[0] = new_hb.astype(hb_out.dtype)
-        as_out[0] = new_asl.astype(as_out.dtype)
+            best = best_scratch[...].astype(jnp.int32)
+            new_hb, new_asl = _rr_merge_packed(
+                hb, asl, best, recv, vec, member, unknown, age_clamp,
+            )
+            hb_out[0] = new_hb.astype(hb_out.dtype)
+            as_out[0] = new_asl.astype(as_out.dtype)
+            st_mem = (new_asl & 3) == member
 
         # per-subject reductions, accumulated across consecutive i steps
-        st_mem = (new_asl & 3) == member
         cnt_part = jnp.sum((recv & st_mem).astype(jnp.int32),
                            axis=0)[None]
         ndet_part = jnp.sum(fail.astype(jnp.int32), axis=0)[None]
@@ -1756,7 +1959,8 @@ def _rr_kernel(
     static_argnames=(
         "fanout", "member", "unknown", "failed", "age_clamp", "window",
         "t_fail", "t_cooldown", "block_r", "chunk", "interpret",
-        "resident", "gather_unroll", "arc_align", "rcnt_acc", "_stub",
+        "resident", "gather_unroll", "arc_align", "rcnt_acc", "elementwise",
+        "_stub",
     ),
 )
 def resident_round_blocked(
@@ -1784,6 +1988,7 @@ def resident_round_blocked(
     col_offset: jax.Array | int = 0,
     arc_align: int = 1,
     rcnt_acc: bool | None = None,
+    elementwise: str = "lanes",
     _stub: str = "",
 ) -> tuple[jax.Array, ...]:
     """One whole gossip round (lean crash-only fault model) in one kernel.
@@ -1794,8 +1999,11 @@ def resident_round_blocked(
     read once, written once).  Requires
     :func:`rr_resident_supported` — 3 x N x c_blk bytes of VMEM.
     ``gather_unroll`` overrides the per-iteration row count of the merge
-    gather (default: auto by stripe width).  Bit-identical outputs across
-    both knobs (pinned by tests/test_merge_pallas.py).
+    gather (default: auto by stripe width).  ``elementwise``
+    ("lanes" | "swar") picks the widened-i32 or the packed-4-subjects-
+    per-word formulation of the tick/view/merge stages (see the SWAR
+    section above :func:`_rr_tick_view_swar`).  Bit-identical outputs
+    across all knobs (pinned by tests/test_merge_pallas.py).
 
     Contract (two int8 lanes per entry, STRIPE-MAJOR ``[nc, N, cs, LANE]``
     layout — ``blocked_shape`` transposed so each stripe's rows are
@@ -1836,6 +2044,13 @@ def resident_round_blocked(
         edges = edges.reshape(n, 1)
     if hb.dtype != jnp.int8:
         raise ValueError("resident round kernel requires int8 lanes")
+    if elementwise not in ("lanes", "swar"):
+        raise ValueError(f"unknown elementwise: {elementwise!r}")
+    if elementwise == "swar" and cs % 4:
+        raise ValueError(
+            f"elementwise='swar' packs 4 subjects per word along the "
+            f"sublane axis and needs cs % 4 == 0 (got cs={cs})"
+        )
     if arc and n % ARC_CHUNK:
         raise ValueError(f"arc resident round needs N % {ARC_CHUNK} == 0")
     if arc_align > 1:
@@ -2024,7 +2239,8 @@ def resident_round_blocked(
                    resident=resident, unroll=u, view_dt=view_dt,
                    stub=frozenset(s for s in _stub.split(",") if s),
                    arc_rows=arc_rows, vslots=vslots, arc_align=arc_align,
-                   rcnt_acc=use_acc, nstripes=nc),
+                   rcnt_acc=use_acc, swar_mode=elementwise == "swar",
+                   nstripes=nc),
         grid=(nc, n // r_blk),
         # in-place lane update: safe because every [row-block, stripe]
         # region's reads (the i==0 view-build chunk pass and the one-step-
@@ -2089,7 +2305,7 @@ def resident_round_blocked(
             # the accumulated form's LANE-COMPACTED count scratch
             # (persists across the whole grid; flushed at the final step)
             [pltpu.VMEM((n // LANE, LANE), cnt_dt)] if use_acc else []),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=126 * 1024 * 1024),
         interpret=interpret,
     )(edges, jnp.asarray(col_offset, jnp.int32).reshape(1, 1), flags, vecs,

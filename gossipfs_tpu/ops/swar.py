@@ -1,0 +1,151 @@
+"""SWAR (SIMD-within-a-register) int8 arithmetic over packed int32 words.
+
+The round's elementwise work — threshold compares, status selects, age
+advance — runs over all-int8 lanes, but the v5e VPU exposes ordered
+compares only at i32 width (BASELINE.md round-5 Mosaic probes: int8
+vectors support bitwise + equality only; int16 adds legalize but ordered
+compares don't).  The lanes formulation therefore widens every int8
+element to its own i32 slot: one subject per VPU lane, 4x the register
+pressure the data needs.  This module implements the same per-byte
+semantics on WORDS of four packed int8 subjects using carry-safe bitwise
+arithmetic (Hacker's Delight ch. 2/6 style), so each ordered compare,
+select, and wrap-around add touches 4 subjects per i32 op.
+
+Conventions:
+
+* A "word" is an int32 carrying 4 independent int8 lanes (bytes,
+  little-endian: byte 0 = lowest subject index of the group).
+* An "hmask" is a word whose bytes are 0x80 (true) / 0x00 (false) — the
+  natural output of the compare primitives.  hmasks compose with
+  ``&``/``|``/``~...&H``; expand to a full-byte mask (0xFF/0x00) with
+  :func:`to_bytes` only when a select needs it.
+* All byte arithmetic WRAPS mod 2^8 — exactly the semantics of the
+  narrow (int8-stored) XLA formulation in core/rounds.py, whose adds and
+  subs wrap on the int8 store and whose compares read sign-extended
+  bytes.  Bit-equality per byte is pinned exhaustively (all 256 x 256
+  operand pairs) by tests/test_swar.py.
+
+Two packing layouts share this word math:
+
+* The XLA paths (core/rounds.py) pack along the MINOR (subject) axis via
+  :func:`pack` / :func:`unpack` (``lax.bitcast_convert_type`` over
+  trailing groups of 4).
+* The pallas resident-round kernel packs along the SUBLANE axis via
+  ``pltpu.bitcast`` (ops/merge_pallas.py), which matches the TPU's
+  physical int8 tile packing so the bitcast is a register reinterpret,
+  not a shuffle.  The word math is packing-agnostic: bytes never
+  interact across lanes.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def word(b: int) -> int:
+    """The Python int32 value whose 4 bytes all equal ``b`` (mod 256)."""
+    v = (b & 0xFF) * 0x01010101
+    return v - (1 << 32) if v >= (1 << 31) else v
+
+
+H = word(0x80)    # per-byte sign bits
+L = word(0x01)    # per-byte ones
+B7F = word(0x7F)  # per-byte low-7 mask (~H)
+
+# single-byte select masks, index k = byte k of the word (int32-safe)
+BYTE = (0x000000FF, 0x0000FF00, 0x00FF0000, -16777216)
+
+
+def pack(x: jnp.ndarray) -> jnp.ndarray:
+    """int8 [..., 4k] -> int32 words [..., k] (byte i = element 4w+i)."""
+    if x.dtype != jnp.int8:
+        raise ValueError(f"pack expects int8, got {x.dtype}")
+    if x.shape[-1] % 4:
+        raise ValueError(f"pack needs a minor axis % 4 == 0, got {x.shape}")
+    g = x.reshape(*x.shape[:-1], x.shape[-1] // 4, 4)
+    return lax.bitcast_convert_type(g, jnp.int32)
+
+
+def unpack(w: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of :func:`pack`: int32 words [..., k] -> int8 [..., 4k]."""
+    b = lax.bitcast_convert_type(w, jnp.int8)
+    return b.reshape(*w.shape[:-1], w.shape[-1] * 4)
+
+
+def eq(x, y):
+    """Per-byte x == y -> hmask.  (Zero-byte detect on x ^ y; the low-7
+    add cannot carry across bytes: 0x7F + 0x7F < 0x100.)"""
+    z = x ^ y
+    return ~(((z & B7F) + B7F) | z) & H
+
+
+def ne(x, y):
+    """Per-byte x != y -> hmask."""
+    z = x ^ y
+    return (((z & B7F) + B7F) | z) & H
+
+
+def ges(x, y):
+    """Per-byte SIGNED x >= y -> hmask.
+
+    Unsigned compare of the sign-flipped bytes: ``t``'s high bit per byte
+    is (low7(x) >= low7(y)) — the per-byte subtraction cannot borrow
+    across bytes because every byte of ``x | H`` is >= 0x80 and every
+    byte of ``y & B7F`` is <= 0x7F.  The sign-flip folds into the
+    high-bit fixup: signed x >= y is (~x & y) | (x ~^ y) & (xl >= yl)
+    at the sign bit.
+    """
+    t = (x | H) - (y & B7F)
+    return ((~x & y) | (~(x ^ y) & t)) & H
+
+
+def gts(x, y):
+    """Per-byte SIGNED x > y -> hmask."""
+    return ~ges(y, x) & H
+
+
+def les(x, y):
+    """Per-byte SIGNED x <= y -> hmask."""
+    return ges(y, x)
+
+
+def to_bytes(m):
+    """hmask -> full-byte mask (0xFF per true byte).  The multiply by 255
+    cannot carry: each byte of the 0/1 word contributes < 256."""
+    return ((m >> 7) & L) * 0xFF
+
+
+def sel(m, x, y):
+    """Byte-wise select: x where full-byte mask ``m`` else y."""
+    return y ^ ((x ^ y) & m)
+
+
+def add(x, y):
+    """Per-byte wrap-around add (no carries cross byte boundaries)."""
+    return ((x & B7F) + (y & B7F)) ^ ((x ^ y) & H)
+
+
+def sub(x, y):
+    """Per-byte wrap-around subtract (no borrows cross byte boundaries)."""
+    return ((x | H) - (y & B7F)) ^ ((x ^ ~y) & H)
+
+
+def maxs(x, y):
+    """Per-byte SIGNED max."""
+    return sel(to_bytes(ges(x, y)), x, y)
+
+
+def mins(x, y):
+    """Per-byte SIGNED min."""
+    return sel(to_bytes(les(x, y)), x, y)
+
+
+def bool_mask(b) -> jnp.ndarray:
+    """bool array -> word-shaped select mask (-1/0: every byte set).
+
+    For masks that are uniform across the 4 packed subjects (per-receiver
+    row flags, scalar conditions) — the word is all-ones or all-zeros, so
+    it serves directly as a full-byte mask and as an hmask operand.
+    """
+    return jnp.where(b, jnp.int32(-1), jnp.int32(0))

@@ -31,6 +31,16 @@ from gossipfs_tpu.core.state import SimState
 
 AXIS = "shard"
 
+# jax-version compat: shard_map moved to the jax namespace (and its
+# replication-check kwarg was renamed check_rep -> check_vma) in 0.5+
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+    _SM_NOCHECK = {"check_vma": False}
+else:  # pragma: no cover - exercised only on older jax runtimes
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _SM_NOCHECK = {"check_rep": False}
+
 
 def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     """1-D mesh over available devices (v5e-8 -> 8-way column sharding)."""
@@ -101,14 +111,14 @@ def _sharded_runner(mesh, config, crash_rate, rejoin_rate, has_churn_ok,
             st = rounds._from_blocked(st)
         return st.hb, st.age, st.status, st.alive, st.round, st.hb_base, mc, pr
 
-    fn = jax.shard_map(
+    fn = _shard_map(
         local_run,
         mesh=mesh,
         in_specs=(mat, mat, mat, rep, rep, P(AXIS), rep, rep, rep, rep, rep),
         out_specs=(mat, mat, mat, rep, rep, P(AXIS),
                    rounds.MetricsCarry(P(AXIS), P(AXIS), P(AXIS)),
                    rounds.RoundMetrics(rep, rep, rep)),
-        check_vma=False,
+        **_SM_NOCHECK,
     )
     if donate:
         # in-place [N, N] lanes: the 100k-class runs don't fit with

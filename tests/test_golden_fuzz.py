@@ -116,6 +116,18 @@ CONFIGS = [
     ("arc-i8-v8-introkill", dict(n=64, topology="random_arc", fanout=6,
                                  remove_broadcast=False, fresh_cooldown=True,
                                  hb_dtype="int8", view_dtype="int8"), True),
+    # the SWAR packed-word elementwise path (config.elementwise="swar",
+    # ops/swar.py) against the same per-node oracle: crash/leave/join
+    # storms drive the swar tick (remove-broadcast OR-reduce included)
+    # and the swar membership epilogue through the rebase/zombie corners
+    ("rand-i8-v8-swar", dict(n=32, topology="random", fanout=5,
+                             hb_dtype="int8", view_dtype="int8",
+                             elementwise="swar"), False),
+    ("arc-i8-v8-swar-introkill", dict(n=64, topology="random_arc", fanout=6,
+                                      remove_broadcast=False,
+                                      fresh_cooldown=True,
+                                      hb_dtype="int8", view_dtype="int8",
+                                      elementwise="swar"), True),
 ]
 
 

@@ -227,19 +227,24 @@ def test_full_round_equivalence_xla_vs_stripe():
 
 
 @pytest.mark.slow  # N=4096 interpreter-mode kernel run
-@pytest.mark.parametrize("block_c,rr_resident,topology,arc_align", [
-    (4096, "off", "random", 1),
-    (1024, "off", "random", 1),
-    (1024, "on", "random", 1),
-    (2048, "on", "random_arc", 1),
+@pytest.mark.parametrize("block_c,rr_resident,topology,arc_align,elementwise", [
+    (4096, "off", "random", 1, "lanes"),
+    (1024, "off", "random", 1, "lanes"),
+    (1024, "on", "random", 1, "lanes"),
+    (2048, "on", "random_arc", 1, "lanes"),
     # the round-5 headline shape (bench.py): tile-aligned arcs — bases are
     # multiples of 8, the kernel's window-max is a group reduction riding
     # the view build + one pair-max, and the XLA oracle expands the same
     # aligned bases, so the two paths must stay bit-identical
-    (2048, "on", "random_arc", 8),
+    (2048, "on", "random_arc", 8, "lanes"),
+    # SWAR packed-word elementwise on BOTH sides (the XLA swar epilogue
+    # vs the rr kernel's swar stages) — the round-6 headline candidate
+    # shape plus the streaming form
+    (1024, "off", "random", 1, "swar"),
+    (2048, "on", "random_arc", 8, "swar"),
 ])
 def test_full_round_equivalence_xla_vs_rr(block_c, rr_resident, topology,
-                                          arc_align):
+                                          arc_align, elementwise):
     """The resident-round kernel (tick + view build + merge + reductions in
     ONE pallas call, with carried member counts and in-place lane update)
     reproduces the XLA scan bit-for-bit — states, carry, AND per-round
@@ -264,6 +269,7 @@ def test_full_round_equivalence_xla_vs_rr(block_c, rr_resident, topology,
         hb_dtype="int8",
         merge_block_c=block_c,
         rr_resident=rr_resident,
+        elementwise=elementwise,
     )
     key = jax.random.PRNGKey(17)
     out = {}
@@ -288,17 +294,22 @@ def test_full_round_equivalence_xla_vs_rr(block_c, rr_resident, topology,
 
 
 @pytest.mark.slow  # interpreter-mode kernel rounds
-@pytest.mark.parametrize("topology,rr_resident,arc_align", [
-    ("random", "off", 1),     # widened (int32) view stripe at c_blk=1024
-    ("random_arc", "on", 1),  # resident parked lanes + window-maxed stripe
+@pytest.mark.parametrize("topology,rr_resident,arc_align,elementwise", [
+    ("random", "off", 1, "lanes"),  # widened (int32) view stripe, c_blk=1024
+    ("random_arc", "on", 1, "lanes"),  # resident lanes + window-maxed stripe
     # tile-aligned arc on an INT8 view stripe (c_blk=4096, cs=32): the
     # group max must run over the WRAPPED encodings — max-then-wrap picks
     # the wrong sender for deep-shift subjects whose rel straddles the
     # wrap (round-5 review finding; the bf16-stripe parity test above
     # cannot see it because widened stripes wrap rel before the max)
-    ("random_arc", "on", 8),
+    ("random_arc", "on", 8, "lanes"),
+    # the SWAR path in the same regime: its byte adds/subs wrap by
+    # construction, which must reproduce the _wrap8 semantics exactly
+    ("random", "off", 1, "swar"),
+    ("random_arc", "on", 8, "swar"),
 ])
-def test_rr_deep_shift_regime_parity(topology, rr_resident, arc_align):
+def test_rr_deep_shift_regime_parity(topology, rr_resident, arc_align,
+                                     elementwise):
     """The shift_a < -128 regime (reachable after a rejoin drops a
     subject's base): the narrow XLA path computes its view encoding and
     merge compare in WRAPPING int8, and the rr kernel must reproduce that
@@ -314,6 +325,7 @@ def test_rr_deep_shift_regime_parity(topology, rr_resident, arc_align):
         hb_dtype="int8",
         merge_block_c=4096 if arc_align > 1 else 1024,
         rr_resident=rr_resident,
+        elementwise=elementwise,
     )
     st = init_state(cfg)
     n = cfg.n
@@ -389,10 +401,14 @@ def test_rr_rcnt_accumulated_form_matches_per_stripe():
 
 
 def test_stripe_and_arc_kernel_smoke():
-    """Fast-lane coverage for the stripe/arc production kernels: ONE
-    interpret-mode round each against the XLA round (the slow lane runs
-    the deep 6-8 round versions above; one round still crosses every
-    kernel stage — tick, view build, merge, reductions)."""
+    """Fast-lane coverage for the stripe/arc production kernels against
+    the XLA round (the slow lane runs the deep 6-8 round versions above).
+
+    The rr variant runs TWO rounds: single-round parity cannot catch bugs
+    that only manifest on carried state — e.g. the in-place lane update
+    feeding round 2 (ADVICE r5 #4).  The stripe variants stay at one
+    round (no carried kernel state beyond the lanes themselves, and
+    interpret-mode rounds at n=4096 are the lane's priciest seconds)."""
     for topology in ("random", "random_arc"):
         base = SimConfig(
             n=4096, topology=topology, fanout=6,
@@ -404,20 +420,56 @@ def test_stripe_and_arc_kernel_smoke():
         # round-4 headline path) serves both random topologies: explicit
         # edges, or arc bases via the in-stripe windowed row-max.  The
         # rr-random pairing is covered by the deeper equivalence test
-        # above, so the fast lane runs it only on the arc topology —
-        # interpret-mode rounds at n=4096 are the lane's priciest seconds
-        kernels = ["pallas_stripe_interpret"]
+        # above, so the fast lane runs it only on the arc topology
+        kernels = {"pallas_stripe_interpret": 1}
         if topology == "random_arc":
-            kernels.append("pallas_rr_interpret")
-        out = {}
-        for kernel in ["xla"] + kernels:
-            cfg = dataclasses.replace(base, merge_kernel=kernel)
-            out[kernel] = run_rounds(init_state(cfg), cfg, 1, key,
-                                     crash_rate=0.02)
-        fx, cx, _ = out["xla"]
-        for kernel in kernels:
+            kernels["pallas_rr_interpret"] = 2
+        for kernel, rounds in kernels.items():
+            out = {}
+            for k in ("xla", kernel):
+                cfg = dataclasses.replace(base, merge_kernel=k)
+                out[k] = run_rounds(init_state(cfg), cfg, rounds, key,
+                                    crash_rate=0.02)
+            fx, cx, _ = out["xla"]
             fp, cp, _ = out[kernel]
             assert jnp.array_equal(fx.hb, fp.hb), (topology, kernel)
             assert jnp.array_equal(fx.status, fp.status), (topology, kernel)
             assert jnp.array_equal(cx.first_detect, cp.first_detect), (
                 topology, kernel)
+
+
+@pytest.mark.parametrize("topology,rr_resident,arc_align", [
+    ("random", "off", 1),
+    ("random", "on", 1),
+    ("random_arc", "on", 8),
+])
+def test_rr_swar_matches_lanes_multi_round(topology, rr_resident, arc_align):
+    """Fast lane: the SWAR packed-word elementwise path
+    (config.elementwise="swar", ops/swar.py) is bit-equal to the widened
+    lanes path through the resident-round kernel over a MULTI-ROUND scan
+    with crash churn — states, metrics carry, and per-round metrics.
+    Multi-round matters: the carried in-place lanes feed round 2+, and
+    detections/cooldowns only cross the threshold compares after a few
+    rounds of aging.  Small n keeps the interpret-mode cost off the fast
+    lane's critical path; the slow lane runs the n=2048/4096 XLA-oracle
+    versions above with a swar case in the parameter grid."""
+    base = SimConfig(
+        n=1024, topology=topology, fanout=16 if arc_align > 1 else 6,
+        arc_align=arc_align, remove_broadcast=False, fresh_cooldown=True,
+        t_cooldown=12, view_dtype="int8", hb_dtype="int8",
+        merge_kernel="pallas_rr_interpret", merge_block_c=512,
+        rr_resident=rr_resident,
+    )
+    key = jax.random.PRNGKey(23)
+    out = {}
+    for ew in ("lanes", "swar"):
+        cfg = dataclasses.replace(base, elementwise=ew)
+        out[ew] = run_rounds(init_state(cfg), cfg, 6, key, crash_rate=0.03)
+    (fl, cl, pl_), (fs, cs_, ps) = out["lanes"], out["swar"]
+    for name in ("hb", "age", "status", "alive", "hb_base"):
+        assert jnp.array_equal(getattr(fl, name), getattr(fs, name)), name
+    assert jnp.array_equal(cl.first_detect, cs_.first_detect)
+    assert jnp.array_equal(cl.first_observer, cs_.first_observer)
+    assert jnp.array_equal(cl.converged, cs_.converged)
+    assert jnp.array_equal(pl_.true_detections, ps.true_detections)
+    assert jnp.array_equal(pl_.false_positives, ps.false_positives)

@@ -1,0 +1,157 @@
+"""Exhaustive per-byte verification of the SWAR word primitives.
+
+Every compare/select/arithmetic primitive in ops/swar.py is checked over
+ALL 256 x 256 int8 operand pairs (packed 4 per word) against the plain
+numpy int8 semantics the lanes formulation uses — the ground truth the
+SWAR elementwise path (config.elementwise="swar") must reproduce
+bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from gossipfs_tpu.ops import swar
+
+
+def _all_pairs():
+    """Every (x, y) int8 byte pair, packed 4 pairs per word."""
+    b = np.arange(-128, 128, dtype=np.int8)
+    x = np.repeat(b, 256)           # 65,536 bytes
+    y = np.tile(b, 256)
+    return x, y
+
+
+X8, Y8 = _all_pairs()
+XW = swar.pack(jnp.asarray(X8).reshape(1, -1))
+YW = swar.pack(jnp.asarray(Y8).reshape(1, -1))
+
+
+def _bytes(w) -> np.ndarray:
+    return np.asarray(swar.unpack(w)).reshape(-1)
+
+
+def _mask_bytes(h) -> np.ndarray:
+    """hmask word -> per-byte bool."""
+    return (_bytes(h).view(np.uint8) & 0x80) != 0
+
+
+@pytest.mark.parametrize("name,fn,ref", [
+    ("eq", swar.eq, lambda x, y: x == y),
+    ("ne", swar.ne, lambda x, y: x != y),
+    ("ges", swar.ges, lambda x, y: x >= y),
+    ("gts", swar.gts, lambda x, y: x > y),
+    ("les", swar.les, lambda x, y: x <= y),
+])
+def test_compares_exhaustive(name, fn, ref):
+    got = _mask_bytes(fn(XW, YW))
+    np.testing.assert_array_equal(got, ref(X8, Y8), err_msg=name)
+
+
+@pytest.mark.parametrize("name,fn,ref", [
+    ("add", swar.add, lambda x, y: (x + y).astype(np.int8)),
+    ("sub", swar.sub, lambda x, y: (x - y).astype(np.int8)),
+    ("maxs", swar.maxs, np.maximum),
+    ("mins", swar.mins, np.minimum),
+])
+def test_arith_exhaustive(name, fn, ref):
+    got = _bytes(fn(XW, YW))
+    with np.errstate(over="ignore"):
+        want = ref(X8.astype(np.int16), Y8.astype(np.int16)).astype(np.int8) \
+            if name in ("add", "sub") else ref(X8, Y8)
+    np.testing.assert_array_equal(got, want, err_msg=name)
+
+
+def test_select_exhaustive():
+    m = swar.to_bytes(swar.ges(XW, YW))
+    got = _bytes(swar.sel(m, XW, YW))
+    np.testing.assert_array_equal(got, np.where(X8 >= Y8, X8, Y8))
+
+
+def test_pack_unpack_roundtrip():
+    rng = np.random.default_rng(0)
+    x = rng.integers(-128, 128, size=(3, 5, 16), dtype=np.int8)
+    w = swar.pack(jnp.asarray(x))
+    assert w.shape == (3, 5, 4) and w.dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(swar.unpack(w)), x)
+
+
+def test_word_constants():
+    assert swar.word(0x80) == swar.H
+    assert swar.word(0xFF) == -1
+    assert swar.word(3) == 0x03030303
+    # the int32 range is respected (no Python-int overflow leaking in)
+    assert -(1 << 31) <= swar.word(0xFE) < (1 << 31)
+
+
+def test_run_rounds_swar_matches_lanes_xla_path():
+    """Fast lane: the XLA swar epilogues (_tick_swar /
+    _membership_update_swar, core/rounds.py) reproduce the lanes scan
+    bit-for-bit over a churn + rejoin horizon — matrix events included,
+    so the introducer pushes, rebase-shift renormalization, and the
+    remove-broadcast-free cooldown chain all cross the packed-word ops."""
+    import dataclasses
+
+    import jax
+
+    from gossipfs_tpu.config import SimConfig
+    from gossipfs_tpu.core.rounds import run_rounds
+    from gossipfs_tpu.core.state import init_state
+
+    base = SimConfig(n=256, topology="random", fanout=6,
+                     remove_broadcast=False, fresh_cooldown=True,
+                     t_cooldown=12, view_dtype="int8", hb_dtype="int8")
+    key = jax.random.PRNGKey(7)
+    out = {}
+    for ew in ("lanes", "swar"):
+        cfg = dataclasses.replace(base, elementwise=ew)
+        out[ew] = run_rounds(init_state(cfg), cfg, 12, key,
+                             crash_rate=0.02, rejoin_rate=0.01)
+    (fl, cl, pl), (fs, cs, ps) = out["lanes"], out["swar"]
+    for name in ("hb", "age", "status", "alive", "hb_base"):
+        assert jnp.array_equal(getattr(fl, name), getattr(fs, name)), name
+    assert jnp.array_equal(cl.first_detect, cs.first_detect)
+    assert jnp.array_equal(cl.converged, cs.converged)
+    assert jnp.array_equal(pl.true_detections, ps.true_detections)
+    assert jnp.array_equal(pl.false_positives, ps.false_positives)
+
+
+def test_run_rounds_swar_matches_lanes_remove_broadcast():
+    """The reference-faithful fault model (remove_broadcast on): the swar
+    tick's cross-receiver OR-reduce of the packed fail masks must match
+    the lanes formulation's jnp.any over the bool fail matrix."""
+    import dataclasses
+
+    import jax
+
+    from gossipfs_tpu.config import SimConfig
+    from gossipfs_tpu.core.rounds import run_rounds
+    from gossipfs_tpu.core.state import init_state
+
+    base = SimConfig(n=128, topology="random", fanout=5,
+                     view_dtype="int8", hb_dtype="int8")
+    key = jax.random.PRNGKey(3)
+    out = {}
+    for ew in ("lanes", "swar"):
+        cfg = dataclasses.replace(base, elementwise=ew)
+        out[ew] = run_rounds(init_state(cfg), cfg, 10, key,
+                             crash_rate=0.03, rejoin_rate=0.02)
+    (fl, _, pl), (fs, _, ps) = out["lanes"], out["swar"]
+    for name in ("hb", "age", "status", "alive"):
+        assert jnp.array_equal(getattr(fl, name), getattr(fs, name)), name
+    assert jnp.array_equal(pl.true_detections, ps.true_detections)
+    assert jnp.array_equal(pl.false_positives, ps.false_positives)
+
+
+def test_bool_mask_uniform_words():
+    m = swar.bool_mask(jnp.asarray([True, False]))
+    np.testing.assert_array_equal(np.asarray(m), [-1, 0])
+    # serves as a full-byte select mask directly
+    a = swar.pack(jnp.arange(8, dtype=jnp.int8).reshape(1, 8))
+    got = swar.sel(m.reshape(1, 2), a, jnp.zeros_like(a))
+    np.testing.assert_array_equal(
+        np.asarray(swar.unpack(got)).reshape(-1),
+        [0, 1, 2, 3, 0, 0, 0, 0],
+    )

@@ -37,6 +37,12 @@ def main(argv=None):
     p.add_argument("--arc-align", type=int, default=8)
     p.add_argument("--fanout", type=int, default=16)
     p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--elementwise", choices=("lanes", "swar"),
+                   default="lanes",
+                   help="elementwise formulation for BOTH paths (swar = "
+                        "packed 4-subject words, ops/swar.py) — run once "
+                        "per value to certify the compiled SWAR kernel "
+                        "on-chip before bench.py's probe trusts it")
     args = p.parse_args(argv)
 
     import jax
@@ -51,7 +57,7 @@ def main(argv=None):
         remove_broadcast=False, fresh_cooldown=True, t_cooldown=12,
         merge_kernel="pallas_rr", merge_block_r=args.block_r,
         view_dtype="int8", merge_block_c=args.block_c, rr_resident="auto",
-        hb_dtype="int8",
+        hb_dtype="int8", elementwise=args.elementwise,
     )
     key = jax.random.PRNGKey(args.seed)
     out = {}
@@ -80,6 +86,7 @@ def main(argv=None):
     }
     doc = {
         "n": args.n, "rounds": args.rounds, "arc_align": args.arc_align,
+        "elementwise": args.elementwise,
         **checks,
         "all_equal": all(checks.values()),
         "total_detections": int(prr.true_detections.sum()),

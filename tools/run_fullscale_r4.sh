@@ -2,8 +2,10 @@
 # Round-4 full-scale evidence runs (VERDICT r3 task 3): the exact sharded
 # BASELINE-config-4 program on the 8-way virtual CPU mesh, at sizes the
 # committed FULLSCALE artifact has never shown.  Sequential — one host core —
-# and nice'd so interactive work keeps priority.  Each run writes its own
-# artifact as soon as it completes.
+# and nice'd so interactive work keeps priority.  Every run folds into the
+# ONE canonical FULLSCALE.json as soon as it completes (newest run becomes
+# "current", the previous current moves into the "history" array — see
+# bench/full_scale.py main()).
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p /tmp/fullscale_r4
@@ -15,11 +17,11 @@ export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 unset PALLAS_AXON_POOL_IPS PALLAS_AXON_REMOTE_COMPILE PALLAS_AXON_TPU_GEN
 echo "[$(date -u +%FT%TZ)] start N=65536" >> /tmp/fullscale_r4/progress.log
 nice -n 19 python -m gossipfs_tpu.bench.full_scale \
-  --n 65536 --rounds 16 --out FULLSCALE_65536.json \
+  --n 65536 --rounds 16 --out FULLSCALE.json \
   > /tmp/fullscale_r4/n65536.out 2>&1
 echo "[$(date -u +%FT%TZ)] done N=65536 rc=$?" >> /tmp/fullscale_r4/progress.log
 echo "[$(date -u +%FT%TZ)] start N=98304" >> /tmp/fullscale_r4/progress.log
 nice -n 19 python -m gossipfs_tpu.bench.full_scale \
-  --n 98304 --rounds 12 --out FULLSCALE_98304.json \
+  --n 98304 --rounds 12 --out FULLSCALE.json \
   > /tmp/fullscale_r4/n98304.out 2>&1
 echo "[$(date -u +%FT%TZ)] done N=98304 rc=$?" >> /tmp/fullscale_r4/progress.log

@@ -10,6 +10,18 @@ out, isolating each stage's cost in the CURRENT build:
     rcnt   - the per-receiver member-count side output zeroed
 
     JAX_PLATFORMS=axon python tools/stub_bisect.py
+    JAX_PLATFORMS=axon python tools/stub_bisect.py --arc-align 8
+    JAX_PLATFORMS=axon python tools/stub_bisect.py --elementwise swar
+    JAX_PLATFORMS=cpu  python tools/stub_bisect.py --interpret --n 1024 \
+        --block-c 512 --block-r 128 --rounds 2 --reps 1
+
+``--elementwise swar`` times the packed-word SWAR stages
+(config.elementwise, ops/swar.py) against the widened default — the
+"(full)" row's delta between the two runs is the recovered elementwise
+time.  ``--interpret`` runs the interpreter-mode kernel so the tool works
+end-to-end off-TPU (stage attribution is then about interpreter op
+counts, not VPU time — use it to validate the tool and the stub paths,
+not to quote performance).
 """
 
 from __future__ import annotations
@@ -35,7 +47,7 @@ from gossipfs_tpu.core.state import FAILED, MEMBER, UNKNOWN
 LANE = merge_pallas.LANE
 
 
-def build_inputs(n, c_blk, fanout, key):
+def build_inputs(n, c_blk, fanout, key, arc_align=1):
     nc, cs = n // c_blk, c_blk // LANE
     ks = jax.random.split(key, 4)
     hb = jax.random.randint(ks[0], (nc, n, cs, LANE), -128, 127, jnp.int8)
@@ -47,21 +59,29 @@ def build_inputs(n, c_blk, fanout, key):
     sa = jnp.zeros((nc, cs, LANE), jnp.int32)
     sb = jnp.zeros((nc, cs, LANE), jnp.int32)
     g = jnp.full((nc, cs, LANE), -120, jnp.int32)
-    bases = jax.random.randint(ks[3], (n, 1), 0, n, jnp.int32)
+    if arc_align > 1:
+        # aligned-arc bases are multiples of arc_align (core/topology.py
+        # random_arc_bases_aligned) — unaligned bases would read gather
+        # windows the aligned group-max never produced (ADVICE r5 #1)
+        bases = jax.random.randint(
+            ks[3], (n, 1), 0, n // arc_align, jnp.int32) * arc_align
+    else:
+        bases = jax.random.randint(ks[3], (n, 1), 0, n, jnp.int32)
     return hb, asl, flags, sa, sb, g, bases
 
 
 def time_stub(n, c_blk, block_r, fanout, stub, rounds, reps,
-              arc_align=1):
+              arc_align=1, elementwise="lanes", interpret=False):
     hb, asl, flags, sa, sb, g, bases = build_inputs(
-        n, c_blk, fanout, jax.random.PRNGKey(0))
+        n, c_blk, fanout, jax.random.PRNGKey(0), arc_align=arc_align)
 
     kern = functools.partial(
         merge_pallas.resident_round_blocked,
         fanout=fanout, member=int(MEMBER), unknown=int(UNKNOWN),
         failed=int(FAILED), age_clamp=AGE_CLAMP, window=126,
         t_fail=5, t_cooldown=12, block_r=block_r, resident=True,
-        arc_align=arc_align, _stub=stub,
+        arc_align=arc_align, elementwise=elementwise, interpret=interpret,
+        _stub=stub,
     )
 
     @jax.jit
@@ -93,19 +113,34 @@ def main():
     p.add_argument("--rounds", type=int, default=100)
     p.add_argument("--reps", type=int, default=3)
     p.add_argument("--arc-align", type=int, default=1)
+    p.add_argument("--elementwise", choices=("lanes", "swar"),
+                   default="lanes")
+    p.add_argument("--interpret", action="store_true",
+                   help="interpreter-mode kernel (off-TPU tool validation)")
     p.add_argument("--stubs", nargs="*", default=[
         "", "rcnt", "gather", "wmax,gather", "epi", "epi,rcnt",
         "vtick", "vtick,wmax,gather,epi,rcnt",
     ])
     args = p.parse_args()
     fanout = max(1, args.n.bit_length() - 1)
+    if args.arc_align > 1:
+        # round fanout UP to an arc_align multiple, as the production
+        # entry points do (bench/curves.py, bench/frontier.py) — the raw
+        # log2-ish fanout (14 at the default N) is not a multiple of 8
+        # and resident_round_blocked rejects it (ADVICE r5 #1)
+        fanout = -(-fanout // args.arc_align) * args.arc_align
     for stub in args.stubs:
         el = time_stub(args.n, args.block_c, args.block_r, fanout,
                        stub, args.rounds, args.reps,
-                       arc_align=args.arc_align)
+                       arc_align=args.arc_align,
+                       elementwise=args.elementwise,
+                       interpret=args.interpret)
         print(json.dumps({
             "stub": stub or "(full)",
             "ms_per_round": round(el / args.rounds * 1e3, 3),
+            "elementwise": args.elementwise,
+            "backend": ("interpret/" if args.interpret else "")
+            + jax.default_backend(),
         }), flush=True)
 
 
