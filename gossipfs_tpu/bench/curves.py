@@ -136,6 +136,107 @@ def sweep(ns=DEFAULT_NS, rounds=ROUNDS, crash_rate=0.01, seed=0,
     }
 
 
+def partition_sweep(ns=(1024,), seed=0, split_at=5) -> dict:
+    """Scenario-engine partition rows — the committed netsplit artifact.
+
+    Per N: split the cohort into halves for ``t_fail + t_cooldown +
+    diameter + slack`` rounds, crash one tracked node inside EACH side
+    mid-split, heal, and reduce the per-round device stats
+    (metrics.detection.partition_round_stats) plus the detection events
+    into a PartitionReport.  The claims the rows pin:
+
+      * ``cross_hb_advances == 0`` — zero cross-partition heartbeat
+        propagation while the split holds (the edge filter is airtight);
+      * ``split_brain_rounds`` ~ t_fail + t_cooldown + diameter — how
+        long the two sides' views diverge before both accept the split;
+      * partition-local detection keeps working: the same-side tracked
+        crash is detected in ~t_fail rounds (``local_ttd``);
+      * ``reconverge_rounds <= reconverge_bound`` (t_fail + gossip
+        diameter) — after heal the views knit back purely by gossip.
+
+    CPU-feasible at N=1024-4096; tools/verify_claims.py re-runs the
+    N=1024 row as the ``partition_reconv`` claim.
+    """
+    import math
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gossipfs_tpu.detector.sim import SimDetector
+    from gossipfs_tpu.metrics.detection import (
+        partition_round_stats,
+        summarize_partition,
+    )
+    from gossipfs_tpu.scenarios import split_halves
+
+    rows = []
+    for n in ns:
+        fanout = SimConfig.log_fanout(n)
+        cfg = SimConfig(
+            n=n,
+            topology="random",
+            fanout=fanout,
+            remove_broadcast=False,   # scenario runs are gossip-only
+            fresh_cooldown=True,      # (scenarios/tensor.py gating)
+            t_cooldown=6,
+            merge_kernel="xla",       # the filterable merge path
+        )
+        diameter = math.ceil(math.log(n) / math.log(fanout + 1))
+        split_len = cfg.t_fail + cfg.t_cooldown + diameter + 8
+        heal_at = split_at + split_len
+        bound = cfg.t_fail + diameter
+        horizon = heal_at + bound + 8
+
+        det = SimDetector(cfg, seed=seed)
+        sc = split_halves(n, start=split_at, end=heal_at)
+        det.load_scenario(sc)
+        pid = sc.partitions[0].pid(n)
+        pid_dev = jnp.asarray(pid)
+        stats = jax.jit(partition_round_stats)
+
+        # one tracked crash per side, two rounds into the split: the
+        # partition-local TTD/FPR evidence
+        crash_a = n // 4
+        crash_b = n // 2 + n // 4
+        crash_rounds = {crash_a: split_at + 2, crash_b: split_at + 2}
+        series = []
+        for _ in range(horizon):
+            if int(det.state.round) == split_at + 2:
+                det.crash(crash_a)
+                det.crash(crash_b)
+            det.advance(1)
+            row = np.asarray(stats(det.state, pid_dev))
+            series.append({
+                "round": int(det.state.round),
+                "cross_members": int(row[0]),
+                "cross_hb_max": int(row[1]),
+                "cross_complete": bool(row[2]),
+                "complete": bool(row[3]),
+                "n_alive": int(row[4]),
+            })
+        report = summarize_partition(
+            series, det.drain_events(), pid, split_at, heal_at,
+            crash_rounds=crash_rounds,
+        )
+        rows.append({
+            "n": n,
+            "fanout": fanout,
+            "split_at": split_at,
+            "heal_at": heal_at,
+            "split_rounds": split_len,
+            "reconverge_bound": bound,
+            **report.as_dict(),
+        })
+    return {
+        "metric": "netsplit behavior vs N (scenario engine; rounds, "
+                  "1 round == 1 s reference time)",
+        "protocol": "random fanout=log2(N), gossip-only dissemination, "
+                    "t_fail=5, t_cooldown=6; half/half partition with "
+                    "heal, one tracked crash per side",
+        "rows": rows,
+    }
+
+
 def sweep_t_fail(n=4096, t_fails=(3, 5, 8, 12), rounds=ROUNDS, seed=0) -> dict:
     """The deployment knob: detection latency vs false-positive tradeoff.
 
@@ -196,9 +297,15 @@ def main(argv=None) -> None:
                    help="override fanout (default log2(N))")
     p.add_argument("--t-fail-sweep", action="store_true",
                    help="sweep t_fail at fixed N instead of N")
+    p.add_argument("--partition", action="store_true",
+                   help="scenario-engine netsplit rows (split-brain "
+                        "duration, view divergence, reconvergence) "
+                        "instead of the TTD/FPR sweep")
     p.add_argument("--out", type=str, default=None)
     args = p.parse_args(argv)
-    if args.t_fail_sweep:
+    if args.partition:
+        doc = json.dumps(partition_sweep(ns=tuple(args.ns)))
+    elif args.t_fail_sweep:
         doc = json.dumps(sweep_t_fail(rounds=args.rounds))
     else:
         doc = json.dumps(sweep(ns=tuple(args.ns), rounds=args.rounds,

@@ -26,16 +26,39 @@ import sys
 import time
 
 
+def pad_quantum(block_c: int, topology: str) -> int:
+    """Admissible-N quantum of the rr kernel: N must be a multiple of the
+    stripe width (and, for arcs, of ARC_CHUNK)."""
+    import math
+
+    from gossipfs_tpu.ops import merge_pallas
+
+    q = block_c
+    if topology == "random_arc":
+        q = math.lcm(q, merge_pallas.ARC_CHUNK)
+    return q
+
+
 def run(n: int, rounds: int, block_c: int, crash_at: int, track: int,
         crash_rate: float, seed: int, topology: str, block_r: int,
         arc_align: int = 1, fanout: int | None = None,
         elementwise: str = "lanes") -> dict:
     import jax
+    import numpy as np
 
     from gossipfs_tpu.bench.run import tracked_crash_events
     from gossipfs_tpu.config import SimConfig
     from gossipfs_tpu.core import rounds as R
     from gossipfs_tpu.metrics.detection import summarize
+
+    # Literal-N support (e.g. the BASELINE-named 100,000): pad up to the
+    # next admissible aligned size with permanently-dead pad nodes — never
+    # members anywhere, excluded from tracked crashes, churn and metrics
+    # (rr_packed_init's member_mask; zero kernel changes).  100,000 at
+    # block_c=1024 runs as n_padded=100,352 with 352 pads.
+    quantum = pad_quantum(block_c, topology)
+    n_pad = -(-n // quantum) * quantum
+    padded = n_pad != n
 
     over = dict(topology=topology, merge_block_r=block_r,
                 arc_align=arc_align, elementwise=elementwise)
@@ -43,16 +66,20 @@ def run(n: int, rounds: int, block_c: int, crash_at: int, track: int,
         over["fanout"] = fanout
     elif arc_align > 1:
         # aligned arcs need fanout % align == 0: round log2(N) up
-        lf = SimConfig.log_fanout(n)
+        lf = SimConfig.log_fanout(n_pad)
         over["fanout"] = -(-lf // arc_align) * arc_align
-    cfg = SimConfig.packed_rr(n, block_c, **over)
+    # else: packed_rr's own default, log_fanout of the (padded) n it gets
+    cfg = SimConfig.packed_rr(n_pad, block_c, **over)
     events, crash_rounds, churn_ok = tracked_crash_events(
-        cfg, rounds, track, crash_at
+        cfg, rounds, track, crash_at, n_live=n if padded else None
     )
+    member_mask = np.arange(n_pad) < n if padded else None
 
     @jax.jit
     def go(key, events, churn_ok):
-        hb4, as4, alive, hb_base, rnd, counts = R.rr_packed_init(cfg)
+        hb4, as4, alive, hb_base, rnd, counts = R.rr_packed_init(
+            cfg, member_mask=member_mask
+        )
         out = R._scan_rounds_rr_packed(
             hb4, as4, alive, hb_base, rnd, cfg, key, events,
             crash_rate, churn_ok, counts0=counts,
@@ -68,7 +95,8 @@ def run(n: int, rounds: int, block_c: int, crash_at: int, track: int,
     jax.block_until_ready(mcarry)
     elapsed = time.perf_counter() - t0
 
-    report = summarize(mcarry, per_round, crash_rounds)
+    report = summarize(mcarry, per_round, crash_rounds,
+                       n_effective=n if padded else None)
     ttd_f = [v for v in report.ttd_first.values() if v >= 0]
     ttd_c = [v for v in report.ttd_converged.values() if v >= 0]
     import statistics
@@ -76,7 +104,9 @@ def run(n: int, rounds: int, block_c: int, crash_at: int, track: int,
         "metric": "single-chip capacity frontier (resident-round kernel, "
                   "packed 2 B/entry wire)",
         "n": n,
-        "entries": n * n,
+        "n_padded": n_pad,
+        "pad_nodes": n_pad - n,
+        "entries": n_pad * n_pad,
         "merge_block_c": block_c,
         "fanout": cfg.fanout,
         "arc_align": arc_align,

@@ -44,7 +44,7 @@ from gossipfs_tpu.sdfs.types import RECOVERY_DELAY
 
 
 def tracked_crash_events(
-    cfg: SimConfig, rounds: int, track: int, at: int
+    cfg: SimConfig, rounds: int, track: int, at: int, n_live: int | None = None
 ) -> tuple[RoundEvents, dict[int, int], jnp.ndarray]:
     """Schedule ``track`` deterministic crashes at round ``at``.
 
@@ -54,11 +54,17 @@ def tracked_crash_events(
     detection-latency report, and a ``churn_ok`` mask excluding the tracked
     nodes from random churn — a random rejoin would reset their
     detection/convergence carry mid-measurement (core/rounds._update_carry).
+
+    ``n_live``: effective cohort for PADDED configs (the literal-N support,
+    bench/frontier.py): tracked crashes spread over [0, n_live) only and
+    the churn mask additionally excludes the permanently-dead pad ids past
+    it — a random rejoin would otherwise resurrect a pad into the cohort.
     """
     n = cfg.n
-    track = min(track, n - 1)
-    stride = max(n // (track + 1), 1)
-    nodes = [(cfg.introducer + (k + 1) * stride) % n for k in range(track)]
+    live = n if n_live is None else n_live
+    track = min(track, live - 1)
+    stride = max(live // (track + 1), 1)
+    nodes = [(cfg.introducer + (k + 1) * stride) % live for k in range(track)]
     nodes = sorted({x for x in nodes if x != cfg.introducer})
     crash = np.zeros((rounds, n), dtype=bool)
     at = min(at, rounds - 1)
@@ -72,6 +78,8 @@ def tracked_crash_events(
     # collapses the population to ~zero and trivializes the scenario —
     # model the reference's "introducer VM stays up" deployment instead
     churn_ok[cfg.introducer] = False
+    if n_live is not None:
+        churn_ok[n_live:] = False
     return events, {node: at for node in nodes}, jnp.asarray(churn_ok)
 
 

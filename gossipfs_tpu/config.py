@@ -109,7 +109,18 @@ class SimConfig:
                                      # merge_pallas.stripe_supported);
                                      # "*_interpret" variants run the same
                                      # kernels in interpreter mode (CPU
-                                     # tests only — slow)
+                                     # tests only — slow).
+                                     # Scenario engine (scenarios/): runs
+                                     # with active link faults fall back
+                                     # to "xla" (run_rounds substitutes it
+                                     # via scenarios.tensor.
+                                     # xla_fallback_config) — the pallas/rr
+                                     # kernels fuse gather+epilogue over
+                                     # unfiltered edge semantics; the XLA
+                                     # path consumes per-edge-filtered
+                                     # edges natively.  Same protocol
+                                     # arithmetic, fault-free transport
+                                     # stays on the fast kernels
     view_dtype: str = "int16"        # gossip-view storage: "int16" | "int8".
                                      # int8 halves the merge's HBM traffic but
                                      # its 126-round rebase window only covers
@@ -227,14 +238,29 @@ class SimConfig:
             if self.merge_kernel.startswith("pallas_rr"):
                 # the rr kernel accepts narrower resident stripes — the
                 # capacity lever: N * merge_block_c bytes must fit VMEM,
-                # so N=65,536 runs at merge_block_c=1024
+                # so N=65,536 runs at merge_block_c=1024.
+                #
+                # Deep-stripe gate, GLOBAL count by design: what actually
+                # selects the lane-compacted accumulator is the PER-SHARD
+                # stripe count (nloc/merge_block_c — ops/merge_pallas.py
+                # keys on n_cols), so under run_rounds_sharded a config
+                # this check rejects could be legal on every shard.  The
+                # config cannot know the mesh size (it is a frozen,
+                # mesh-free protocol object shared by single-chip and
+                # sharded callers), so it enforces the worst case — the
+                # single-chip run — and stays intentionally conservative
+                # for sharded ones.  The cost is nil in practice: sharded
+                # capacity configs already run merge_block_r in {128, 256,
+                # 512} (ANCHORS_r05.json), all multiples of 128.
                 if (self.n // self.merge_block_c > RR_ACC_STRIPES
                         and self.merge_block_r % 128):
                     raise ValueError(
                         "deep-stripe rr shapes (n/merge_block_c > "
                         f"{RR_ACC_STRIPES}) use the lane-compacted count "
                         "accumulator, which needs merge_block_r % 128 == 0 "
-                        f"(got {self.merge_block_r})"
+                        f"(got {self.merge_block_r}).  The stripe count is "
+                        "checked GLOBALLY (conservative for sharded runs — "
+                        "see the comment above this check)"
                     )
                 if not rr_supported(
                     self.n, self.fanout, self.merge_block_c,

@@ -1007,9 +1007,16 @@ def _round_core(
     config: SimConfig,
     ctx: ShardCtx = LOCAL_CTX,
     matrix_events: bool = True,
+    edge_filter=None,
 ) -> tuple[SimState, RoundMetrics, jax.Array, jax.Array, jax.Array, jax.Array | None]:
     """One round, layout- and shard-generic (state may be 2-D or blocked,
     square or a subject-axis shard).
+
+    ``edge_filter``: optional scenario-engine edge rewrite (a dropped
+    message's edge becomes the receiver itself — a no-op merge; see
+    scenarios/tensor.py).  Only passed on paths whose edges are the
+    explicit [N, F] form and were not already filtered by the caller
+    (the ring mode, whose edges derive from the post-tick tables here).
 
     Returns (state, metrics, fail, any_fail [nloc], first_obs [nloc],
     member_col [nloc] | None — see :func:`_merge`)."""
@@ -1020,6 +1027,8 @@ def _round_core(
     if config.topology == "ring":
         edges = topology.ring_edges_from_status(state.status.reshape(n, n))
     assert edges is not None
+    if edge_filter is not None:
+        edges = edge_filter(edges)
     # crash-only + fresh-cooldown + no-remove-broadcast: this round's
     # detector firings are readable off the post-tick lanes the merge
     # kernel loads anyway (status == FAILED and age == 0), so the kernels
@@ -1199,6 +1208,36 @@ gossip_round_donate = partial(
 )(_gossip_round_impl)
 
 
+def _gossip_round_scenario_impl(
+    state: SimState,
+    events: RoundEvents,
+    edges: jax.Array | None,
+    config: SimConfig,
+    tsc,
+    key: jax.Array,
+) -> tuple[SimState, RoundMetrics, jax.Array, jax.Array]:
+    """One interactive round under an armed fault scenario.
+
+    Same contract as :func:`_gossip_round_impl`, plus ``tsc`` (a
+    scenarios.tensor.TensorScenario) and a per-round ``key`` for the
+    Bernoulli loss draws.  Scenario configs are XLA-merge
+    (scenarios.tensor.xla_fallback_config — callers pass that config
+    here), so the state stays 2-D and no blocked relayout happens.
+    """
+    from gossipfs_tpu.scenarios.tensor import filter_edges
+
+    ef = lambda e: filter_edges(tsc, e, state.round, key)  # noqa: E731
+    state, metrics, _fail, any_fail, first_obs, _ = _round_core(
+        state, events, edges, config, edge_filter=ef
+    )
+    return state, metrics, any_fail, first_obs
+
+
+gossip_round_scenario = partial(jax.jit, static_argnames=("config",))(
+    _gossip_round_scenario_impl
+)
+
+
 def _update_carry(
     carry: MetricsCarry,
     state: SimState,
@@ -1353,7 +1392,7 @@ def _scan_rounds_rr(
     return state, mcarry, per_round
 
 
-def rr_packed_init(config: SimConfig) -> tuple:
+def rr_packed_init(config: SimConfig, member_mask=None) -> tuple:
     """Fully-joined packed stripe-major initial state for the rr core.
 
     Device arrays built directly in the scan's own layout — the frontier
@@ -1361,6 +1400,14 @@ def rr_packed_init(config: SimConfig) -> tuple:
     this instead of init_state because three [N, N] SimState lanes plus
     blocked copies exceed HBM at N=65,536 before the scan starts.
     Returns (hb4, as4, alive, hb_base, round, counts).
+
+    ``member_mask`` bool [N]: nodes outside it start permanently dead
+    and UNKNOWN everywhere — the literal-N padding support
+    (bench/frontier.py pads e.g. 100,000 up to the next stripe-aligned
+    size with dead pad nodes; zero kernel changes).  Pads never bump
+    (dead), are never MEMBER in any row (so they are invisible to
+    detection, convergence and SDFS placement), and stay dead as long
+    as the caller excludes them from churn/joins (churn_ok).
     """
     from gossipfs_tpu.ops import merge_pallas
 
@@ -1368,9 +1415,11 @@ def rr_packed_init(config: SimConfig) -> tuple:
     lane = merge_pallas.LANE
     nc = n // config.merge_block_c
     cs = config.merge_block_c // lane
-    # pack_age_status(age=0, MEMBER) as a Python constant — computing it
-    # through jnp breaks callers that jit around this initializer
+    # pack_age_status(age=0, MEMBER) / (age=0, UNKNOWN) as Python
+    # constants — computing them through jnp breaks callers that jit
+    # around this initializer
     joined = int(MEMBER) - 128
+    unknown = int(UNKNOWN) - 128
 
     @jax.jit
     def init():
@@ -1383,7 +1432,29 @@ def rr_packed_init(config: SimConfig) -> tuple:
             jnp.full((n,), n, jnp.int32),
         )
 
-    return init()
+    if member_mask is None:
+        return init()
+
+    @jax.jit
+    def init_masked(mask):
+        mask = mask.astype(bool)
+        # stripe-major subject axes: subject j sits at
+        # [j // c_blk, :, (j % c_blk) // lane, j % lane]
+        colm = mask.reshape(nc, 1, cs, lane)
+        rowm = mask.reshape(1, n, 1, 1)
+        as4 = jnp.where(rowm & colm, jnp.int8(joined), jnp.int8(unknown))
+        n_live = jnp.sum(mask, dtype=jnp.int32)
+        counts = jnp.where(mask, n_live, 0)
+        return (
+            jnp.zeros((nc, n, cs, lane), jnp.int8),
+            as4,
+            mask,
+            jnp.zeros((n,), jnp.int32),
+            jnp.int32(0),
+            counts,
+        )
+
+    return init_masked(jnp.asarray(member_mask))
 
 
 def _scan_rounds_rr_packed(
@@ -1552,6 +1623,7 @@ def _scan_rounds(
     ctx: ShardCtx,
     mcarry0: MetricsCarry | None = None,
     matrix_events: bool = True,
+    scenario=None,
 ) -> tuple[SimState, MetricsCarry, RoundMetrics]:
     """The shared scan over rounds (state in its final layout already).
 
@@ -1565,7 +1637,17 @@ def _scan_rounds(
     scans (e.g. the detector's chunked bulk advancement, which reads a
     small membership view between chunks) accumulates first-detection /
     convergence rounds exactly as one long scan would.
+
+    ``scenario``: optional compiled fault-injection rule table
+    (scenarios.tensor.TensorScenario) — per-round edge filters drop
+    cross-partition / lossy / lagging messages.  Scenario scans run the
+    XLA merge path (enforced upstream), so the rr dispatch below never
+    fires for them.
     """
+    if scenario is not None:
+        from gossipfs_tpu.scenarios.tensor import (
+            filter_edges as scn_filter_edges,
+        )
     if _rr_scan_eligible(config, state.n, _nsubj(state.hb.shape),
                          matrix_events, ctx):
         # whole round in one kernel; rejoin_rate is 0 here (a nonzero rate
@@ -1594,21 +1676,31 @@ def _scan_rounds(
             else:
                 ev = RoundEvents(crash=ev.crash | crash, leave=ev.leave,
                                  join=ev.join)
+        ef = None
+        if scenario is not None:
+            k_scn = jax.random.fold_in(k, 0x5CE)
+            ef = lambda e: scn_filter_edges(scenario, e, st.round, k_scn)  # noqa: E731
         if config.topology == "ring":
             edges = None  # derived per-round from the membership tables
+            ring_filter = ef  # applied inside _round_core, post-derivation
         else:
             edges = topology.in_edges(config, k_edge, None)
+            if ef is not None:
+                edges = ef(edges)
+            ring_filter = None
         round_idx = st.round
         alive_before = st.alive
         if fused:
             # matrix_events is False here, so scheduled leaves (if any) can
             # only mean silent death — same liveness effect as a crash
+            # (non-ring only, so any scenario filter already ran above)
             st, metrics, member_col, any_fail, first_obs = _round_core_fused(
                 st, ev.crash | ev.leave, edges, config, ctx
             )
         else:
             st, metrics, _fail, any_fail, first_obs, member_col = _round_core(
-                st, ev, edges, config, ctx, matrix_events=matrix_events
+                st, ev, edges, config, ctx, matrix_events=matrix_events,
+                edge_filter=ring_filter,
             )
         # joins lost to a dead introducer don't reset metrics (slave.go:22 SPOF)
         if matrix_events:
@@ -1636,6 +1728,7 @@ def _run_rounds_impl(
     churn_ok: jax.Array | None = None,
     mcarry0: MetricsCarry | None = None,
     crash_only_events: bool = False,
+    scenario=None,
 ) -> tuple[SimState, MetricsCarry, RoundMetrics]:
     """Scan ``num_rounds`` gossip rounds.
 
@@ -1659,6 +1752,15 @@ def _run_rounds_impl(
     around it; the XLA merge path partitions cleanly either way.
     """
     n = config.n
+    if scenario is not None and config.merge_kernel != "xla":
+        # scenario runs arrive through the run_rounds wrappers, which
+        # substitute the XLA-merge fallback config (scenarios/tensor.py
+        # xla_fallback_config) — the rr scan below samples its own edges
+        # in-kernel and would silently ignore the filter
+        raise ValueError(
+            "scenario runs require merge_kernel='xla' (use "
+            "run_rounds(..., scenario=...), which substitutes it)"
+        )
     # static: no scheduled events + no random rejoins => the leave/join
     # matrix rewrites drop out of the compiled round entirely.
     # ``crash_only_events`` is the caller's static promise that scheduled
@@ -1686,7 +1788,7 @@ def _run_rounds_impl(
         state = _to_blocked(state, config)
     state, mcarry, per_round = _scan_rounds(
         state, config, key, events, crash_rate, rejoin_rate, churn_ok, LOCAL_CTX,
-        mcarry0=mcarry0, matrix_events=matrix_events,
+        mcarry0=mcarry0, matrix_events=matrix_events, scenario=scenario,
     )
     if blocked:
         state = _from_blocked(state)
@@ -1738,12 +1840,22 @@ def run_rounds(
     churn_ok: jax.Array | None = None,
     mcarry0: MetricsCarry | None = None,
     crash_only_events: bool = False,
+    scenario=None,
 ) -> tuple[SimState, MetricsCarry, RoundMetrics]:
-    """Jitted entry for :func:`_run_rounds_impl` (same signature/docs)."""
+    """Jitted entry for :func:`_run_rounds_impl` (same signature/docs).
+
+    ``scenario``: a compiled scenarios.tensor.TensorScenario (or None).
+    Scenario runs execute the XLA-merge fallback config — same protocol
+    arithmetic, per-edge filterable transport (scenarios/tensor.py).
+    """
     check_crash_only_promise(events, crash_only_events)
+    if scenario is not None:
+        from gossipfs_tpu.scenarios.tensor import xla_fallback_config
+
+        config = xla_fallback_config(config)
     return _run_rounds_jit(
         state, config, num_rounds, key, events, crash_rate, rejoin_rate,
-        churn_ok, mcarry0, crash_only_events,
+        churn_ok, mcarry0, crash_only_events, scenario,
     )
 
 
@@ -1758,15 +1870,20 @@ def run_rounds_donate(
     churn_ok: jax.Array | None = None,
     mcarry0: MetricsCarry | None = None,
     crash_only_events: bool = False,
+    scenario=None,
 ) -> tuple[SimState, MetricsCarry, RoundMetrics]:
     """In-place variant: XLA reuses the input state's HBM for the output
     (the caller's ``state`` is consumed).  At N=32k the scan needs ~13 GiB
     without aliasing — past a v5e chip's headroom — and ~9 GiB with it.
     """
     check_crash_only_promise(events, crash_only_events)
+    if scenario is not None:
+        from gossipfs_tpu.scenarios.tensor import xla_fallback_config
+
+        config = xla_fallback_config(config)
     return _run_rounds_donate_jit(
         state, config, num_rounds, key, events, crash_rate, rejoin_rate,
-        churn_ok, mcarry0, crash_only_events,
+        churn_ok, mcarry0, crash_only_events, scenario,
     )
 
 

@@ -73,6 +73,59 @@ class CoSim:
         self.log = log or EventLog()
         self._recover_at: list[int] = []  # rounds at which to run fail_recover
         self.events: list[DetectionEvent] = []
+        # armed fault scenario (scenarios/): the detector gets the gossip
+        # transport rules; the control plane additionally confines
+        # RPC/scp-level reachability to the master's side of any active
+        # partition (see _reachable)
+        self.scenario = None
+        self._scn_round0 = 0
+
+    def load_scenario(self, scenario) -> None:
+        """Arm a scenarios.FaultScenario on BOTH planes: gossip transport
+        (detector.load_scenario — every engine behind the FailureDetector
+        seam supports it) and the SDFS control plane's reachability.
+        Rule windows count from the current round."""
+        det = self.detector
+        if not hasattr(det, "load_scenario"):
+            raise NotImplementedError(
+                f"{type(det).__name__} has no scenario support"
+            )
+        det.load_scenario(scenario)
+        self.scenario = scenario
+        self._scn_round0 = self.round
+
+    def clear_scenario(self) -> None:
+        det = self.detector
+        if hasattr(det, "clear_scenario"):
+            det.clear_scenario()
+        self.scenario = None
+
+    def scenario_status(self) -> dict | None:
+        if self.scenario is None:
+            return None
+        # one status-document producer: the detector's (load_scenario
+        # guarantees it exists — a second hand-built copy here would
+        # drift from the engine surfaces)
+        return self.detector.scenario_status()
+
+    def _reachable(self) -> set[int]:
+        """Transport-level reachability from the control plane's seat.
+
+        The metadata authority lives with the master, so under an active
+        partition only the master's side answers its RPC/scp — replica
+        pushes to the far side fail, which is exactly what starves a
+        minority-side write of its quorum (reference: an scp to an
+        unreachable VM fails immediately).  Without a scenario this is
+        ground-truth liveness, as before.
+        """
+        alive = set(self.detector.alive_nodes())
+        if self.scenario is None:
+            return alive
+        pid = self.scenario.pid_at(self.round - self._scn_round0)
+        if pid is None:
+            return alive
+        side = pid[self.cluster.master_node]
+        return {x for x in alive if pid[x] == side}
 
     @property
     def round(self) -> int:
@@ -83,8 +136,10 @@ class CoSim:
         """See ``select_observer`` — the *view itself* stays pure gossip data:
         dead-but-undetected members remain in it, so placement/election react
         at detection time, not at crash time."""
-        alive = set(self.detector.alive_nodes())  # == "answers RPC"
-        return select_observer(self.cluster.live, alive, self.cluster.master_node)
+        # "answers RPC" — partition-confined under an armed scenario
+        return select_observer(
+            self.cluster.live, self._reachable(), self.cluster.master_node
+        )
 
     def tick(self, rounds: int = 1) -> None:
         """Advance the detector and let the control plane react per round."""
@@ -110,7 +165,7 @@ class CoSim:
                 old_master = self.cluster.master_node
                 self.cluster.update_membership(
                     self.detector.membership(observer),
-                    reachable=self.detector.alive_nodes(),
+                    reachable=sorted(self._reachable()),
                     now=now,
                     elect=self.election == "local",
                 )

@@ -68,9 +68,15 @@ class Cluster:
     """n node processes + per-node ShimClients."""
 
     def __init__(self, n: int, period: float = 0.1, root: str | None = None,
-                 rpc_timeout: float = 5.0):
+                 rpc_timeout: float = 5.0, t_fail: int = 5):
         self.n = n
         self.period = period
+        self.t_fail = t_fail  # detection timeout in rounds (slave.go:24);
+                              # partition scenarios on small rings raise it
+                              # — mid-split freshness paths stretch across
+                              # the dropped boundary, and the default 5 sits
+                              # at the cascade threshold (BASELINE's ring-
+                              # fragility finding, now reproducible on demand)
         self.root = root or tempfile.mkdtemp(prefix="gossipfs_deploy_")
         # multi-MB puts fan out 4 replica pushes through the writer's RPC:
         # on a loaded 1-core host the reference-size workload (5-10 MB,
@@ -101,37 +107,114 @@ class Cluster:
              "--idx", str(idx), "--n", str(self.n),
              "--udp-base", str(self.udp_base),
              "--rpc-base", str(self.rpc_base),
-             "--dir", self.root, "--period", str(self.period)],
+             "--dir", self.root, "--period", str(self.period),
+             "--t-fail", str(self.t_fail)],
             env=env,
         )
+
+    def _probe_lsm(self, idx: int) -> list[int] | None:
+        """One liveness probe on a FRESH throwaway channel.
+
+        Boot-time probing must NOT reuse the cached ``client(idx)``
+        channel: a channel whose first connect hit the not-yet-bound
+        port enters grpc's transient-failure backoff, and rapid retries
+        on it never reconnect — observed on this host as a LIVE server
+        staying "unavailable" for 40+ s (the whole deploy lane failed to
+        boot).  A fresh channel connects the moment the server is up;
+        the cached clients are only created after start() returns, when
+        every server answers.
+        """
+        c = ShimClient(f"127.0.0.1:{self.rpc_base + idx}", timeout=2.0)
+        try:
+            return c.lsm(idx)
+        except Exception:
+            return None
+        finally:
+            c.close()
+
+    def _probe_ready(self, idx: int) -> bool:
+        """Full view AND every heartbeat counter past the detection grace.
+
+        View convergence alone is NOT "the cluster is up": members whose
+        counters still sit at hb <= 1 are inside the reference's
+        detection grace (slave.go:468-469) — kill one then and NO
+        survivor can ever declare it failed (its entry is frozen at
+        hb=1, permanently grace-protected).  Scenarios that start with a
+        kill therefore need counters > 1 everywhere, which also proves
+        gossip (not just the introducer's JOIN push) actually flows.
+        """
+        c = ShimClient(f"127.0.0.1:{self.rpc_base + idx}", timeout=2.0)
+        try:
+            line = c.call("ScenarioStatus")["lines"][0]
+            hb = line.get("hb") or {}
+            if len(hb) != self.n:
+                return False
+            # below min_group (NodeDaemon's default 4) nodes stay in the
+            # refresh-only branch and never bump — counters sit at 0
+            # forever, AND detection is disabled anyway, so the grace
+            # concern the hb check exists for cannot arise
+            return self.n < 4 or min(hb.values()) > 1
+        except Exception:
+            return False
+        finally:
+            c.close()
 
     def start(self, timeout: float = 30.0) -> None:
         self.spawn(0)  # introducer first (reference SPOF, slave.go:22)
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            try:
-                self.client(0).lsm(0)
+            if self._probe_lsm(0) is not None:
                 break
-            except Exception:
-                time.sleep(0.1)
+            time.sleep(0.25)
         else:
             raise RuntimeError("introducer did not come up")
         for i in range(1, self.n):
             self.spawn(i)
-        # wait until every node's own view holds the full cohort
+        # wait until every node's own view holds the full cohort with
+        # every counter past the hb-grace (see _probe_ready)
         while time.monotonic() < deadline:
-            try:
-                views = [set(self.client(i).lsm(i)) for i in range(self.n)]
-                if all(v == set(range(self.n)) for v in views):
-                    return
-            except Exception:
-                pass
-            time.sleep(0.1)
+            if all(self._probe_ready(i) for i in range(self.n)):
+                return
+            time.sleep(0.25)
         raise RuntimeError("cluster did not converge")
 
     def kill9(self, idx: int) -> None:
         self.procs[idx].send_signal(signal.SIGKILL)
         self.procs[idx].wait()
+
+    def load_scenario(self, scenario) -> list[int]:
+        """Push one scenarios.FaultScenario rule table to every live node
+        (the deploy backend of the scenario engine).  Each node anchors
+        the rule windows at its own receipt; the fan-out completes in
+        milliseconds against multi-period windows.  Returns the node ids
+        that acked."""
+        payload = base64.b64encode(scenario.to_json().encode()).decode()
+        acked = []
+        for idx, proc in self.procs.items():
+            if proc.poll() is not None:
+                continue
+            try:
+                ok = self.client(idx).call(
+                    "ScenarioLoad", file=scenario.name, data_b64=payload
+                ).get("ok")
+            except Exception:
+                ok = False
+            if ok:
+                acked.append(idx)
+        return acked
+
+    def scenario_status(self) -> list[dict]:
+        """Collect every node's ScenarioStatus line (skipping dead nodes)."""
+        lines: list[dict] = []
+        for idx, proc in self.procs.items():
+            if proc.poll() is not None:
+                continue
+            try:
+                lines += self.client(idx).call("ScenarioStatus").get(
+                    "lines") or []
+            except Exception:
+                pass
+        return lines
 
     def wait_detected(self, victim: int, observer: int,
                       timeout: float = 30.0) -> float:

@@ -77,6 +77,11 @@ class _Env:
     def record_detection(self, observer: int, subject_addr: str) -> None:
         self._daemon.on_detection(subject_addr)
 
+    def message_allowed(self, src: int, peer_addr: str) -> bool:
+        """UdpNode._send scenario hook: the daemon evaluates the rule
+        table pushed over the control plane (ScenarioLoad)."""
+        return not self._daemon.scenario_drops(src, peer_addr)
+
 
 class NodeDaemon:
     """One cluster member: gossip + store + RPC server, all in-process."""
@@ -119,11 +124,45 @@ class NodeDaemon:
         self._clients: dict[int, ShimClient] = {}
         self._server: grpc.Server | None = None
         self._stop = threading.Event()
+        # scenario engine: rule table pushed via ScenarioLoad.  Each node
+        # anchors round 0 at its own PROTOCOL-round counter at receipt
+        # (UdpNode.rounds — the same clock the logs stamp): under host
+        # load the node's ticks stall and the fault windows stall with
+        # them, exactly like the sim and in-process UDP engines.  A
+        # wall-clock anchor would instead let a partition "heal" while
+        # the starved node executed almost no protocol rounds.  Receipt
+        # skew across the fan-out is ~one tick against multi-round rule
+        # windows.
+        self._scn_runtime = None
+        self._scn_round0 = 0
+
+    # -- scenario engine ---------------------------------------------------
+
+    def _scn_round(self) -> int:
+        return self.udp.rounds - self._scn_round0
+
+    def scenario_drops(self, src: int, peer_addr: str) -> bool:
+        """Whether the armed scenario drops this outgoing gossip datagram."""
+        rt = self._scn_runtime
+        if rt is None:
+            return False
+        try:
+            dst = int(peer_addr.rsplit(":", 1)[1]) - self.udp_base
+        except ValueError:
+            return False
+        if not 0 <= dst < self.n:
+            return False
+        return rt.drops(src, dst, self._scn_round())
 
     # -- plumbing ----------------------------------------------------------
 
     def log(self, kind: str, message: str, **fields) -> None:
+        # ``round`` is the node's OWN protocol-round clock (heartbeat
+        # ticks, detector/udp.py UdpNode.rounds): latency read off the
+        # log is then in protocol rounds — it stalls with the process
+        # under host load instead of widening like wall-clock windows
         entry = {"ts": round(time.time(), 3), "node": self.idx,
+                 "round": self.udp.rounds,
                  "kind": kind, "message": message, **fields}
         with open(self.log_path, "a") as f:
             f.write(json.dumps(entry) + "\n")
@@ -531,6 +570,50 @@ class NodeDaemon:
     def AskForConfirmation(self, req, ctx):
         return {"confirm": self.auto_confirm}
 
+    def ScenarioLoad(self, req, ctx):
+        """Arm a fault scenario on THIS node (scenarios/schedule.py JSON in
+        ``data_b64``).  The launcher fans the same table out to every
+        node — the deploy backend of the scenario engine; windows count
+        from each node's receipt.  An empty payload disarms."""
+        from gossipfs_tpu.scenarios.runtime import ScenarioRuntime
+        from gossipfs_tpu.scenarios.schedule import FaultScenario
+
+        payload = base64.b64decode(req.get("data_b64", "") or "")
+        if not payload:
+            self._scn_runtime = None
+            self.log("scenario", "scenario cleared")
+            return {"ok": True}
+        try:
+            sc = FaultScenario.from_json(payload.decode())
+        except (ValueError, KeyError) as e:
+            self.log("scenario_error", repr(e))
+            return {"ok": False}
+        if sc.n != self.n:
+            self.log("scenario_error",
+                     f"scenario n={sc.n} != cluster n={self.n}")
+            return {"ok": False}
+        self._scn_round0 = self.udp.rounds
+        self._scn_runtime = ScenarioRuntime(sc)
+        self.log("scenario", f"armed scenario {sc.name}",
+                 scenario=sc.name, horizon=sc.horizon)
+        return {"ok": True}
+
+    def ScenarioStatus(self, req, ctx):
+        """This node's view of the armed scenario (GrepReply lines).
+
+        Also carries the node's protocol-round tick counter and its
+        members' heartbeat counters — the per-node vitals an operator
+        (or a test) wants next to the fault state."""
+        rt = self._scn_runtime
+        doc = {"node": self.idx, "armed": rt is not None,
+               "rounds": self.udp.rounds,
+               "tick_error": repr(self.udp.last_tick_error)
+               if self.udp.last_tick_error else "",
+               "hb": {a: m.hb for a, m in self.udp.members.items()}}
+        if rt is not None:
+            doc.update(rt.status(self._scn_round()))
+        return {"lines": [doc]}
+
     def UpdateFileVersion(self, req, ctx):
         """The writer's commit: the pushes landed, publish the placement."""
         file, version = req["file"], int(req["version"])
@@ -581,6 +664,7 @@ class NodeDaemon:
         "Get", "GetDeleteInfo", "DeleteFileData", "Delete", "Ls", "Store",
         "RemoteReput", "Vote", "AssignNewMaster", "AskForConfirmation",
         "UpdateFileVersion", "Lsm", "AliveNodes", "Grep", "ShowMetadata",
+        "ScenarioLoad", "ScenarioStatus",
     )
 
     # -- lifecycle ---------------------------------------------------------

@@ -20,6 +20,7 @@ from gossipfs_tpu.config import SimConfig
 from gossipfs_tpu.core.rounds import (
     gossip_round,
     gossip_round_donate,
+    gossip_round_scenario,
     run_rounds,
 )
 from gossipfs_tpu.core.state import MEMBER, RoundEvents, SimState, init_state
@@ -63,6 +64,43 @@ class SimDetector:
         # importantly, readers hold a reference to THE buffer, not to one
         # call's buffer
         self._snap_buffer: SnapshotBuffer | None = None
+        # armed fault scenario (scenarios/): declarative schedule, its
+        # compiled tensor rule table, and the XLA-fallback config the
+        # scenario rounds execute (scenarios.tensor module docstring)
+        self._scenario = None
+        self._scn_tensor = None
+        self._scn_config: SimConfig | None = None
+
+    # -- scenario engine ---------------------------------------------------
+    def load_scenario(self, scenario) -> None:
+        """Arm a scenarios.FaultScenario: rule windows count from the
+        CURRENT round.  Scenario rounds run the XLA-merge fallback config
+        (same protocol arithmetic — scenarios/tensor.py documents the
+        rr/pallas gating); loading replaces any previous scenario."""
+        from gossipfs_tpu.scenarios import tensor as scn_tensor
+
+        if scenario.n != self.config.n:
+            raise ValueError(
+                f"scenario is for n={scenario.n}, detector has "
+                f"n={self.config.n}"
+            )
+        self._join_bulk()
+        self._scn_config = scn_tensor.xla_fallback_config(self.config)
+        self._scn_tensor = scn_tensor.compile_tensor(
+            scenario, round0=int(self.state.round)
+        )
+        self._scenario = scenario
+
+    def clear_scenario(self) -> None:
+        self._scenario = self._scn_tensor = self._scn_config = None
+
+    def scenario_status(self) -> dict | None:
+        """Status document for the armed scenario (None when unarmed)."""
+        if self._scenario is None:
+            return None
+        return self._scenario.status(
+            int(self.state.round) - int(self._scn_tensor.round0)
+        )
 
     # -- event verbs -------------------------------------------------------
     def _check(self, node: int) -> int:
@@ -101,6 +139,20 @@ class SimDetector:
         self._resolve_pending_bulk()
         n = self.config.n
         for _ in range(rounds):
+            round_idx = int(self.state.round)
+            scn_on = self._scenario is not None
+            if scn_on and self._pending_join and self._scenario.active_at(
+                round_idx - int(self._scn_tensor.round0)
+            ):
+                # the join path is an instantaneous introducer row/column
+                # rewrite, not transport messages — it cannot be filtered
+                # by the active fault rules, so it would teleport across a
+                # partition.  Refuse rather than simulate wrong dynamics.
+                raise NotImplementedError(
+                    "join during an active scenario window is not "
+                    "transport-filtered; advance past the fault windows "
+                    "(or clear_scenario) before joining"
+                )
             ev = RoundEvents(
                 crash=self._mask(self._pending_crash),
                 leave=self._mask(self._pending_leave),
@@ -109,18 +161,28 @@ class SimDetector:
             self._pending_crash.clear()
             self._pending_leave.clear()
             self._pending_join.clear()
-            k = jax.random.fold_in(self._key, int(self.state.round))
-            if self.config.topology == "ring":
+            k = jax.random.fold_in(self._key, round_idx)
+            cfg = self._scn_config if scn_on else self.config
+            if cfg.topology == "ring":
                 edges = None  # derived in-round from the membership tables
             else:
                 from gossipfs_tpu.core import topology
 
-                edges = topology.in_edges(self.config, k, None)
-            round_idx = int(self.state.round)
-            step = gossip_round_donate if self.donate else gossip_round
-            self.state, _, any_fail, first_obs = step(
-                self.state, ev, edges, self.config
-            )
+                edges = topology.in_edges(cfg, k, None)
+            if scn_on:
+                # scenario rounds: the XLA-fallback config + per-edge drop
+                # filter (scenarios/tensor.py).  No donate variant — the
+                # scenario path is the interactive/parity lane, not the
+                # capacity frontier
+                self.state, _, any_fail, first_obs = gossip_round_scenario(
+                    self.state, ev, edges, cfg, self._scn_tensor,
+                    jax.random.fold_in(k, 0x5CE),
+                )
+            else:
+                step = gossip_round_donate if self.donate else gossip_round
+                self.state, _, any_fail, first_obs = step(
+                    self.state, ev, edges, cfg
+                )
             if not bool(jnp.any(any_fail)):
                 # quiet round: one scalar transfer
                 continue
@@ -183,11 +245,24 @@ class SimDetector:
         """
         self._join_bulk()
         start_round = int(self.state.round)
+        if (
+            self._scenario is not None
+            and self._pending_join
+            and self._scenario.active_at(
+                start_round - int(self._scn_tensor.round0)
+            )
+        ):
+            # same teleport refusal as the interactive path (see advance)
+            raise NotImplementedError(
+                "join during an active scenario window is not "
+                "transport-filtered"
+            )
         events = self._first_round_events(rounds)
 
         if snapshot_every is None:
             self.state, mcarry, _ = run_rounds(
-                self.state, self.config, rounds, self._key, events=events
+                self.state, self.config, rounds, self._key, events=events,
+                scenario=self._scn_tensor,
             )
             self._pending_bulk.append((start_round, rounds, mcarry, self.state))
             return None
@@ -217,7 +292,8 @@ class SimDetector:
                         join=events.join[off:off + ln],
                     )
                     st, mcarry, _ = run_rounds(
-                        st, self.config, ln, self._key, events=ev, mcarry0=mcarry
+                        st, self.config, ln, self._key, events=ev,
+                        mcarry0=mcarry, scenario=self._scn_tensor,
                     )
                     if prev is not None:
                         # blocks until the previous chunk lands — the current

@@ -54,6 +54,12 @@ class UdpNode:
         self.fail_list: dict[str, float] = {}  # addr -> entry's last ts
         self.transport: asyncio.DatagramTransport | None = None
         self._hb_task: asyncio.Task | None = None
+        # protocol rounds THIS node has ticked — the node's own logical
+        # clock.  Deploy logs stamp it so latency assertions count
+        # protocol rounds instead of widenable wall-clock windows, and it
+        # stalls exactly when the process is starved (unlike wall time).
+        self.rounds = 0
+        self.last_tick_error: Exception | None = None
 
     # -- lifecycle ---------------------------------------------------------
     async def start(self) -> None:
@@ -84,6 +90,14 @@ class UdpNode:
 
     def _send(self, peer_addr: str, msg: str) -> None:
         if self.transport is None:
+            return
+        # scenario engine send hook (scenarios/): the cluster (or the
+        # deploy daemon's _Env) decides per datagram whether an armed
+        # fault rule — partition, Bernoulli link loss, slow sender —
+        # drops it.  Dropping HERE models the network, so heartbeats,
+        # JOIN/LEAVE/REMOVE verbs and list pushes are all affected alike.
+        allowed = getattr(self.cluster, "message_allowed", None)
+        if allowed is not None and not allowed(self.idx, peer_addr):
             return
         host, port = peer_addr.rsplit(":", 1)
         self.transport.sendto(msg.encode(), (host, int(port)))
@@ -159,13 +173,22 @@ class UdpNode:
         period = self.cluster.period
         while self.alive:
             await asyncio.sleep(period)
-            self.tick()
+            try:
+                self.tick()
+            except Exception as e:  # noqa: BLE001
+                # a tick that throws must not silently kill the heartbeat
+                # task: the node would freeze mid-protocol (peers see its
+                # counter stop at the last pushed value — and if that is
+                # still within the hb<=1 grace, slave.go:468, it becomes
+                # PERMANENTLY undetectable).  Record and keep ticking.
+                self.last_tick_error = e
 
     def tick(self) -> None:
         c = self.cluster
         now = self._now()
         if not self.alive:
             return
+        self.rounds += 1
         if len(self.members) < c.min_group:
             for m in self.members.values():
                 m.ts = now  # refresh-only (slave.go:504-509)
@@ -217,6 +240,7 @@ class UdpCluster:
         t_cooldown: int = 5,
         min_group: int = 4,
         fresh_cooldown: bool = False,
+        scenario=None,
     ):
         self.n = n
         self.period = period
@@ -229,6 +253,44 @@ class UdpCluster:
         self._events: list[DetectionEvent] = []
         self._round = 0
         self.introducer = 0
+        # scenario engine (scenarios/): armed rule table + the cluster
+        # round it was armed at (rule windows are arming-relative)
+        self._scn_runtime = None
+        self._scn_round0 = 0
+        if scenario is not None:
+            self.load_scenario(scenario)
+
+    # -- scenario engine ----------------------------------------------------
+    def load_scenario(self, scenario) -> None:
+        """Arm a scenarios.FaultScenario; windows count from NOW (the
+        current cluster round).  Same rule table and semantics as the
+        tensor sim's edge filter and the deploy daemons' pushed table."""
+        from gossipfs_tpu.scenarios.runtime import ScenarioRuntime
+
+        if scenario.n != self.n:
+            raise ValueError(
+                f"scenario is for n={scenario.n}, cluster has n={self.n}"
+            )
+        self._scn_runtime = ScenarioRuntime(scenario)
+        self._scn_round0 = self._round
+
+    def clear_scenario(self) -> None:
+        self._scn_runtime = None
+
+    def scenario_status(self) -> dict | None:
+        if self._scn_runtime is None:
+            return None
+        return self._scn_runtime.status(self._round - self._scn_round0)
+
+    def message_allowed(self, src: int, peer_addr: str) -> bool:
+        """The UdpNode._send hook: False = the armed scenario drops it."""
+        rt = self._scn_runtime
+        if rt is None:
+            return True
+        dst = self._addr_to_idx.get(peer_addr)
+        if dst is None:
+            return True
+        return not rt.drops(src, dst, self._round - self._scn_round0)
 
     def record_detection(self, observer: int, subject_addr: str) -> None:
         subject = self._addr_to_idx[subject_addr]
@@ -343,6 +405,16 @@ class UdpDetector:
 
     def drain_events(self):
         return self._sync(self.cluster.drain_events)
+
+    # -- scenario engine (executed on the cluster's own loop thread) --------
+    def load_scenario(self, scenario) -> None:
+        self._sync(self.cluster.load_scenario, scenario)
+
+    def clear_scenario(self) -> None:
+        self._sync(self.cluster.clear_scenario)
+
+    def scenario_status(self):
+        return self._sync(self.cluster.scenario_status)
 
     def close(self) -> None:
         self._sync(self.cluster.stop_all)

@@ -1,0 +1,52 @@
+"""Scenario engine: declarative network-partition & link-fault injection.
+
+One :class:`~gossipfs_tpu.scenarios.schedule.FaultScenario` file drives
+all three transport engines — the tensor sim (edge filters on the
+sampled topology), the asyncio UDP engine (send-hook drop rule), and
+the per-process deployment (the rule table pushed over the control
+plane).  See ``scenarios/schedule.py`` for the schema and semantics.
+
+The tensor backend's exports resolve LAZILY (module ``__getattr__``):
+``schedule``/``runtime`` are pure-Python, and the deploy daemons — a
+documented jax-free path that must start in milliseconds — import them
+via this package from their ``ScenarioLoad`` RPC.  An eager
+``tensor`` import here would pull jax into every daemon the moment a
+scenario arms.
+"""
+
+from gossipfs_tpu.scenarios.runtime import ScenarioRuntime
+from gossipfs_tpu.scenarios.schedule import (
+    FaultScenario,
+    LinkFault,
+    Partition,
+    SlowNode,
+    expand_selector,
+    split_halves,
+)
+
+_TENSOR_EXPORTS = (
+    "TensorScenario",
+    "compile_tensor",
+    "filter_edges",
+    "require_scenario_config",
+    "xla_fallback_config",
+)
+
+__all__ = [
+    "FaultScenario",
+    "LinkFault",
+    "Partition",
+    "ScenarioRuntime",
+    "SlowNode",
+    "expand_selector",
+    "split_halves",
+    *_TENSOR_EXPORTS,
+]
+
+
+def __getattr__(name: str):
+    if name in _TENSOR_EXPORTS:
+        from gossipfs_tpu.scenarios import tensor
+
+        return getattr(tensor, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,196 @@
+"""Tensor-sim backend of the scenario engine: edge filters on the
+sampled topology.
+
+The round kernel is receiver-centric — ``edges[i, f]`` is the f-th
+sender whose row receiver *i* max-merges this round (core/topology.py).
+A dropped message is therefore an EDGE REWRITE: the filtered edge points
+at the receiver itself, and a self-edge merge is a provable no-op (the
+gossip view is built from the same ticked state the receiver holds, so
+the strict ``advance`` compare rejects every value — the argument
+aligned arcs already rely on, core/topology.random_arc_bases_aligned).
+Nothing else about the round changes: nodes keep ticking, bumping and
+detecting; only which rows reach which receivers does.
+
+Engine coverage / gating (see also config.py's merge_kernel notes):
+
+  * the XLA merge paths (2-D state) take filtered edges natively —
+    scenario runs therefore FORCE ``merge_kernel="xla"`` via
+    :func:`xla_fallback_config` (the rr/pallas fast paths run the round
+    in-kernel over unfiltered gathers and stay reserved for
+    fault-free transport);
+  * ``remove_broadcast`` must be off: the broadcast is modeled as an
+    instantaneous tensor column-OR, not as transport messages, so a
+    partition could not filter it — gossip-only dissemination is the
+    transport-faithful mode (it also needs ``fresh_cooldown``, as ever);
+  * ``random_arc`` has no per-edge form (arc bases gather through a
+    windowed row-max) — use ``random``, whose detection behavior the
+    arc mode matches by construction (bench/curves.py parity rows).
+
+Scenario round numbers are relative to ARMING: :class:`TensorScenario`
+carries ``round0`` (the absolute sim round at arming) and the filter
+subtracts it, so a scenario loaded mid-run keeps its schedule.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gossipfs_tpu.config import SimConfig
+from gossipfs_tpu.scenarios.schedule import FaultScenario
+
+
+class TensorScenario(NamedTuple):
+    """The compiled (device-array) form ``filter_edges`` consumes.
+
+    Rule counts are static (array shapes); empty rule kinds compile to
+    zero-length leading axes and vanish from the traced program.  All
+    leaves are loop-invariant over a scan.
+    """
+
+    round0: jax.Array      # int32 scalar — absolute round the scenario armed
+    part_start: jax.Array  # int32 [P]
+    part_end: jax.Array    # int32 [P]
+    part_pid: jax.Array    # int32 [P, N]
+    loss_start: jax.Array  # int32 [L]
+    loss_end: jax.Array    # int32 [L]
+    loss_rate: jax.Array   # float32 [L]
+    loss_src: jax.Array    # bool [L, N]
+    loss_dst: jax.Array    # bool [L, N]
+    slow_start: jax.Array  # int32 [S]
+    slow_end: jax.Array    # int32 [S]
+    slow_stride: jax.Array # int32 [S]
+    slow_nodes: jax.Array  # bool [S, N]
+
+
+def compile_tensor(scenario: FaultScenario, round0: int = 0) -> TensorScenario:
+    """Compile a declarative scenario to the device-array rule table."""
+    n = scenario.n
+
+    def mask(nodes) -> np.ndarray:
+        m = np.zeros((n,), dtype=bool)
+        m[list(nodes)] = True
+        return m
+
+    parts = scenario.partitions
+    losses = scenario.link_faults
+    slows = scenario.slow_nodes
+    return TensorScenario(
+        round0=jnp.int32(round0),
+        part_start=jnp.asarray([p.start for p in parts], jnp.int32),
+        part_end=jnp.asarray([p.end for p in parts], jnp.int32),
+        part_pid=jnp.asarray(
+            np.stack([p.pid(n) for p in parts], axis=0)
+            if parts else np.zeros((0, n), np.int32)
+        ),
+        loss_start=jnp.asarray([f.start for f in losses], jnp.int32),
+        loss_end=jnp.asarray([f.end for f in losses], jnp.int32),
+        loss_rate=jnp.asarray([f.rate for f in losses], jnp.float32),
+        loss_src=jnp.asarray(
+            np.stack([mask(f.src) for f in losses], axis=0)
+            if losses else np.zeros((0, n), bool)
+        ),
+        loss_dst=jnp.asarray(
+            np.stack([mask(f.dst) for f in losses], axis=0)
+            if losses else np.zeros((0, n), bool)
+        ),
+        slow_start=jnp.asarray([s.start for s in slows], jnp.int32),
+        slow_end=jnp.asarray([s.end for s in slows], jnp.int32),
+        slow_stride=jnp.asarray([max(s.stride, 1) for s in slows], jnp.int32),
+        slow_nodes=jnp.asarray(
+            np.stack([mask(s.nodes) for s in slows], axis=0)
+            if slows else np.zeros((0, n), bool)
+        ),
+    )
+
+
+def filter_edges(
+    tsc: TensorScenario, edges: jax.Array, rnd: jax.Array, key: jax.Array
+) -> jax.Array:
+    """Apply the rule table to one round's explicit in-edges.
+
+    ``edges`` int32 [N, F] (sender ids per receiver; ring mode's [N, 3]
+    included), ``rnd`` the absolute round scalar, ``key`` a per-round
+    PRNG key (the loss draws fold the rule index in, so multiple loss
+    rules draw independently).  Returns edges with every dropped
+    message's edge rewritten to the receiver (a no-op merge).
+    """
+    n, _f = edges.shape
+    rel = rnd - tsc.round0
+    recv = jnp.arange(n, dtype=jnp.int32)[:, None]
+    drop = jnp.zeros(edges.shape, dtype=bool)
+    p_count = tsc.part_start.shape[0]
+    for p in range(p_count):
+        active = (rel >= tsc.part_start[p]) & (rel < tsc.part_end[p])
+        pid = tsc.part_pid[p]
+        drop |= active & (pid[edges] != pid[recv])
+    for s in range(tsc.slow_start.shape[0]):
+        active = (
+            (rel >= tsc.slow_start[s]) & (rel < tsc.slow_end[s])
+            & (rel % tsc.slow_stride[s] != 0)
+        )
+        drop |= active & tsc.slow_nodes[s][edges]
+    for l in range(tsc.loss_start.shape[0]):  # noqa: E741
+        active = (rel >= tsc.loss_start[l]) & (rel < tsc.loss_end[l])
+        u = jax.random.uniform(jax.random.fold_in(key, l), edges.shape)
+        drop |= (
+            active
+            & tsc.loss_src[l][edges]
+            & tsc.loss_dst[l][recv]
+            & (u < tsc.loss_rate[l])
+        )
+    return jnp.where(drop, recv, edges)
+
+
+def require_scenario_config(config: SimConfig) -> None:
+    """Reject protocol modes the transport-level fault model cannot honor.
+
+    * ``remove_broadcast`` is an instantaneous column-OR over the whole
+      matrix, not a set of messages — a partition could not filter it
+      (the UDP/deploy engines DO filter their real REMOVE datagrams);
+      gossip-only dissemination is the transport-faithful mode.
+    * ``random_arc`` gathers through a windowed row-max over arc bases
+      and has no per-edge rewrite; use ``random``.
+    """
+    if config.remove_broadcast:
+        raise ValueError(
+            "scenario runs require remove_broadcast=False: the sim's REMOVE "
+            "broadcast is an instantaneous tensor reduction, not transport "
+            "messages, so partitions/link faults cannot filter it "
+            "(use gossip-only dissemination + fresh_cooldown)"
+        )
+    if not config.fresh_cooldown:
+        raise ValueError(
+            "scenario runs require fresh_cooldown=True: in gossip-only "
+            "dissemination the faithful stale-timestamp fail list gives "
+            "removed entries a ~zero cooldown and zombie re-add cycles "
+            "(config.py fresh_cooldown notes) — a partitioned run would "
+            "then never reconverge after heal, misattributing the "
+            "protocol pathology to the injected fault"
+        )
+    if config.topology == "random_arc":
+        raise ValueError(
+            "scenario runs support topology 'ring' or 'random': random_arc "
+            "merges through a windowed row-max over arc bases, which has no "
+            "per-edge drop form"
+        )
+
+
+def xla_fallback_config(config: SimConfig) -> SimConfig:
+    """The config a scenario run actually executes: same protocol, XLA merge.
+
+    The pallas/rr kernels fuse the gather, epilogue and per-round
+    reductions in-kernel over unfiltered edge semantics; under active
+    link faults the run falls back to the XLA merge path (documented in
+    config.py's ``merge_kernel`` notes), which consumes the filtered
+    edges natively.  Everything protocol-level (dtypes, thresholds,
+    dissemination mode, elementwise formulation) is preserved.
+    """
+    require_scenario_config(config)
+    if config.merge_kernel == "xla":
+        return config
+    return dataclasses.replace(config, merge_kernel="xla")

@@ -11,6 +11,12 @@ Command surface matches README.md:8-29 plus fault/time controls the sim adds:
   show_metadata | check              master's file->replica map
   advance <r>                        advance simulated time by r rounds
   events                             detection events so far
+  scenario load <file.json>          arm a declarative fault scenario
+                                     (gossipfs_tpu/scenarios/ schema:
+                                     partitions, link loss, slow nodes;
+                                     needs --gossip-only — the broadcast
+                                     modes aren't transport-filterable)
+  scenario status | clear            armed-scenario state / disarm
   grep [--node <k>] <regex>          search the event log (MP1 legacy verb);
                                      --node scopes to one machine's log view
 
@@ -76,6 +82,13 @@ def make_parser() -> argparse.ArgumentParser:
         "--confirm-timeout", type=float, default=float(CONFIRM_TIMEOUT),
         help="seconds to wait for the write-conflict yes/no before "
              "rejecting (reference: server.go:172)",
+    )
+    p.add_argument(
+        "--gossip-only", action="store_true",
+        help="gossip-only dissemination (remove_broadcast off, fresh "
+             "cooldown) — the north-star mode, and required before "
+             "'scenario load' (the instantaneous REMOVE broadcast cannot "
+             "be partition-filtered; scenarios/tensor.py)",
     )
     p.add_argument(
         "--arc-align", type=int, default=1,
@@ -156,6 +169,29 @@ def dispatch(
         elif cmd == "events":
             for ev in sim.events:
                 print(ev, file=out)
+        elif cmd == "scenario":
+            sub = args[0] if args else "status"
+            if sub == "load":
+                from gossipfs_tpu.scenarios import FaultScenario
+
+                sim.load_scenario(FaultScenario.from_file(args[1]))
+                st = sim.scenario_status()
+                print(f"armed '{st['name']}' (horizon {st['horizon']} "
+                      "rounds from now)", file=out)
+            elif sub == "status":
+                st = sim.scenario_status()
+                if st is None:
+                    print("no scenario armed", file=out)
+                else:
+                    print(f"{st['name']}: round {st['round']}, "
+                          f"{'ACTIVE' if st['active'] else 'inactive'}; "
+                          f"rules: {st['rules'] or 'none'}", file=out)
+            elif sub == "clear":
+                sim.clear_scenario()
+                print("scenario cleared", file=out)
+            else:
+                print(f"unknown scenario verb: {sub} "
+                      "(load <file.json> | status | clear)", file=out)
         elif cmd == "grep":
             # ``grep [--node <k>] [--] <pattern>``: the explicit flag
             # scopes the search to node k's own log view (distributed-grep
@@ -200,8 +236,11 @@ def main(argv=None) -> None:
             else:
                 cfg = SimConfig.packed_rr(args.n)
         else:
+            extra = {}
+            if args.gossip_only:
+                extra = dict(remove_broadcast=False, fresh_cooldown=True)
             cfg = SimConfig(n=args.n, topology=args.topology,
-                            fanout=args.fanout)
+                            fanout=args.fanout, **extra)
     except ValueError as e:
         parser.error(str(e))
     detector = None

@@ -57,6 +57,16 @@ METHOD_TYPES: dict[str, tuple] = {
     "Ls": (pb.FileRequest, pb.LsReply),
     "Store": (pb.NodeRequest, pb.StoreReply),
     "ShowMetadata": (pb.Empty, pb.MetadataReply),
+    # scenario engine (deploy backend): extension verbs documented (not
+    # declared) in gossipfs.proto — the rule table travels as
+    # scenarios/schedule.py JSON in PutRequest.data_b64 (file = scenario
+    # name; empty payload disarms); status rides GrepReply's Struct
+    # lines.  Registered here only: gRPC dispatches by path string, so
+    # reusing existing message shapes keeps the checked-in pb2 the
+    # proto's exact codegen (no protoc needed; see the proto's
+    # extension-verbs comment for the promotion path).
+    "ScenarioLoad": (pb.PutRequest, pb.OkReply),
+    "ScenarioStatus": (pb.Empty, pb.GrepReply),
 }
 
 

@@ -50,3 +50,11 @@ def pytest_configure(config):
         "slow'` (< 5 min, every component covered at least once); run the "
         "full suite before shipping protocol-arithmetic changes.",
     )
+    config.addinivalue_line(
+        "markers",
+        "scenario: scenario-engine coverage (gossipfs_tpu/scenarios/ — "
+        "partitions, link faults, slow nodes across the three transport "
+        "engines).  Fast-lane cases ride tier-1; the deploy variant is "
+        "additionally marked slow.  `pytest -m scenario` runs just this "
+        "subsystem.",
+    )

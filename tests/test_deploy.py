@@ -68,11 +68,11 @@ def test_master_kill9_election_and_writes_resume(cluster):
     assert cluster.client(2).put("meta.txt", data)
 
     cluster.kill9(0)  # the master AND the introducer
-    # idle-box elections complete in single-digit seconds; the window is
-    # wide because real-process gossip periods get starved when the full
-    # suite saturates this 1-core host (observed > 60 s once under load)
-    election_s = cluster.wait_new_master(2, 0, timeout=120.0)
-    assert election_s < 100.0
+    # wait_new_master is SYNCHRONIZATION only (its generous timeout
+    # absorbs 1-core CI starvation); the latency ASSERTION below is in
+    # protocol rounds read off the winner's own event log instead of a
+    # widenable wall-clock window
+    cluster.wait_new_master(2, 0, timeout=120.0)
 
     # the new master rebuilt metadata from per-node store listings:
     # the pre-election file is still readable through it
@@ -85,6 +85,41 @@ def test_master_kill9_election_and_writes_resume(cluster):
             "Grep", pattern="became master"
         ).get("lines") or []
     assert len({w["node"] for w in winners}) == 1
+
+    # election latency in PROTOCOL ROUNDS: every deploy log entry carries
+    # the node's own heartbeat-tick counter (deploy/node.py log()), which
+    # stalls with the process under host load instead of widening like
+    # wall time.  From the round the winner's own detector dropped the
+    # dead master to the round it logged the win: its view must go
+    # masterless (~immediately after its own detection), then one control
+    # tick campaigns and the Vote fan-out completes — a handful of rounds
+    # of protocol work, NOT a function of absolute host speed.
+    # the winner itself may have dropped the master via a peer's REMOVE
+    # broadcast (no local detect entry), so take the earliest detect of
+    # node 0 across survivors — their tick counters align to within a
+    # couple of rounds (all booted inside the same convergence window)
+    winner = int(next(iter(winners))["node"])
+    win_lines = cluster.client(winner).call(
+        "Grep", pattern="became master"
+    ).get("lines") or []
+    detect_lines = []
+    for i in range(1, N):
+        detect_lines += [
+            ln for ln in (cluster.client(i).call(
+                "Grep", pattern="detected failure of node 0"
+            ).get("lines") or [])
+            if ln.get("subject") == 0
+        ]
+    assert win_lines and detect_lines
+    elected_round = min(ln["round"] for ln in win_lines)
+    detect_round = min(ln["round"] for ln in detect_lines)
+    latency_rounds = elected_round - detect_round
+    # lower bound -3, not 0: elected/detect rounds may come from two
+    # different nodes' tick counters (boot skew of a couple of ticks)
+    assert -3 <= latency_rounds <= 30, (
+        f"election took {latency_rounds} protocol rounds after first "
+        f"detection (elected@{elected_round}, detected@{detect_round})"
+    )
 
 
 def test_write_conflict_confirmation_crosses_processes(cluster):

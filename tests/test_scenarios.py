@@ -1,0 +1,621 @@
+"""Scenario engine: declarative partitions, link faults and slow nodes
+driven through the three transport engines from one schedule
+(gossipfs_tpu/scenarios/ — see ISSUE/BASELINE "scenario engine").
+
+Fast lane: schema + runtime semantics, the tensor engine's edge filter
+(zero cross-partition propagation, heal/reconvergence, loss and slow
+rules), sim-vs-UDP parity on the same scenario file, the CoSim quorum
+story under a minority-side partition, literal-N padding exclusion, and
+the CLI verbs.  Slow lane: the per-process deployment variant.
+"""
+
+import asyncio
+import io
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gossipfs_tpu.config import SimConfig
+from gossipfs_tpu.core.state import MEMBER, SimState, init_state
+from gossipfs_tpu.scenarios import (
+    FaultScenario,
+    LinkFault,
+    Partition,
+    ScenarioRuntime,
+    SlowNode,
+    compile_tensor,
+    require_scenario_config,
+    split_halves,
+    xla_fallback_config,
+)
+
+pytestmark = pytest.mark.scenario
+
+
+def gossip_only_cfg(n: int, **over) -> SimConfig:
+    kw = dict(
+        n=n, topology="random", fanout=SimConfig.log_fanout(n),
+        remove_broadcast=False, fresh_cooldown=True, t_cooldown=6,
+    )
+    kw.update(over)
+    return SimConfig(**kw)
+
+
+# ---------------------------------------------------------------------------
+# schema + runtime semantics
+# ---------------------------------------------------------------------------
+
+
+class TestSchema:
+    def test_json_roundtrip_all_rule_kinds(self):
+        sc = FaultScenario(
+            name="kitchen-sink", n=64, seed=3,
+            partitions=(Partition(start=2, end=9,
+                                  groups=(tuple(range(16)),
+                                          tuple(range(16, 32)))),),
+            link_faults=(LinkFault(start=0, end=5, rate=0.25,
+                                   src=tuple(range(64)), dst=(7, 9)),),
+            slow_nodes=(SlowNode(start=1, end=20, stride=4,
+                                 nodes=tuple(range(8, 16))),),
+        )
+        rt = FaultScenario.from_json(sc.to_json())
+        assert rt == sc
+        assert rt.horizon == 20
+        assert rt.active_at(4) and not rt.active_at(25)
+        assert len(rt.active_rules(2)) == 3
+
+    def test_selectors(self):
+        doc = """{"name": "s", "n": 8, "partitions": [
+            {"start": 0, "end": 4,
+             "groups": [{"range": [0, 3]}, [5, 6]]}],
+            "link_faults": [
+            {"start": 0, "end": 2, "rate": 1.0, "src": "all", "dst": [0]}]}"""
+        sc = FaultScenario.from_json(doc)
+        assert sc.partitions[0].groups == ((0, 1, 2), (5, 6))
+        assert sc.link_faults[0].src == tuple(range(8))
+        # pid: groups -> 1, 2; the rest (3, 4, 7) -> implicit 0
+        assert sc.pid_at(1).tolist() == [1, 1, 1, 0, 0, 2, 2, 0]
+        assert sc.pid_at(4) is None
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="overlap"):
+            FaultScenario(name="x", n=8, partitions=(
+                Partition(start=0, end=2, groups=((0, 1), (1, 2))),))
+        with pytest.raises(ValueError, match="out of range"):
+            FaultScenario(name="x", n=8, partitions=(
+                Partition(start=0, end=2, groups=((9,),)),))
+        with pytest.raises(ValueError, match="rate"):
+            FaultScenario(name="x", n=8, link_faults=(
+                LinkFault(start=0, end=2, rate=1.5, src=(0,), dst=(1,)),))
+        with pytest.raises(ValueError, match="stride"):
+            FaultScenario(name="x", n=8, slow_nodes=(
+                SlowNode(start=0, end=2, stride=1, nodes=(0,)),))
+        with pytest.raises(ValueError, match="start < end"):
+            FaultScenario(name="x", n=8, partitions=(
+                Partition(start=5, end=5, groups=((0,),)),))
+
+    def test_runtime_drop_semantics(self):
+        sc = FaultScenario(
+            name="rt", n=6,
+            partitions=(Partition(start=2, end=5, groups=((0, 1, 2),)),),
+            link_faults=(LinkFault(start=0, end=10, rate=1.0,
+                                   src=(4,), dst=(5,)),),
+            slow_nodes=(SlowNode(start=0, end=10, stride=3, nodes=(3,)),),
+        )
+        rt = ScenarioRuntime(sc)
+        # partition only inside its window
+        assert rt.drops(0, 4, 3) and rt.drops(4, 0, 3)
+        assert not rt.drops(0, 4, 1) and not rt.drops(0, 4, 5)
+        # total directional loss = asymmetric link: 4->5 dead, 5->4 alive
+        assert rt.drops(4, 5, 0) and not rt.drops(5, 4, 0)
+        # slow node: messages only get out on stride multiples
+        assert not rt.drops(3, 0, 0) and not rt.drops(3, 0, 6)
+        assert rt.drops(3, 0, 1) and rt.drops(3, 0, 7)
+
+    def test_gating(self):
+        broadcast = SimConfig(n=16)  # reference mode: remove_broadcast on
+        with pytest.raises(ValueError, match="remove_broadcast"):
+            require_scenario_config(broadcast)
+        arc = SimConfig(n=1024, topology="random_arc", fanout=10,
+                        remove_broadcast=False, fresh_cooldown=True)
+        with pytest.raises(ValueError, match="random_arc"):
+            require_scenario_config(arc)
+        # the fallback keeps the protocol, swaps only the merge kernel
+        fast = gossip_only_cfg(2048, merge_kernel="pallas",
+                               view_dtype="int8", hb_dtype="int16",
+                               merge_block_c=1024)
+        fell = xla_fallback_config(fast)
+        assert fell.merge_kernel == "xla"
+        assert (fell.t_fail, fell.hb_dtype, fell.view_dtype) == (
+            fast.t_fail, fast.hb_dtype, fast.view_dtype)
+
+
+# ---------------------------------------------------------------------------
+# tensor engine (the fast-lane tier-1 smoke)
+# ---------------------------------------------------------------------------
+
+
+class TestTensorEngine:
+    def test_partition_blocks_cross_gossip_and_heals(self):
+        from gossipfs_tpu.core.rounds import run_rounds
+
+        n = 128
+        cfg = gossip_only_cfg(n)
+        sc = split_halves(n, start=3, end=40)
+        tsc = compile_tensor(sc)
+        pid = sc.partitions[0].pid(n)
+        cross = pid[:, None] != pid[None, :]
+
+        final, mcarry, _ = run_rounds(
+            init_state(cfg), cfg, 30, jax.random.PRNGKey(0), scenario=tsc
+        )
+        status = np.asarray(final.status)
+        hb = np.asarray(final.hb)
+        # split accepted: no live observer still lists a cross member
+        assert ((status == 1) & cross).sum() == 0
+        # ZERO cross-partition heartbeat propagation: no cross copy ever
+        # exceeds what had crossed by the split round (diag bumped to 3)
+        assert hb[cross].max() <= 3
+        assert hb[~cross].max() == 30  # same-side gossip kept flowing
+        # every node was "detected" by the far side within ~t_fail of the
+        # split — both sides keep detecting, partition-locally
+        fd = np.asarray(mcarry.first_detect)
+        assert (fd >= 3).all() and (fd <= 3 + cfg.t_fail + 4).all()
+
+        # same scenario, horizon past heal: views fully reconverge by
+        # gossip alone (t_fail + diameter + slack after heal at 40)
+        final2, _, _ = run_rounds(
+            init_state(cfg), cfg, 55, jax.random.PRNGKey(0), scenario=tsc
+        )
+        assert (np.asarray(final2.status) == 1).all()
+
+    def test_scenario_forces_xla_fallback(self):
+        from gossipfs_tpu.core.rounds import _run_rounds_impl, run_rounds
+
+        cfg = SimConfig.packed_rr(2048, 1024, interpret=True)
+        sc = split_halves(2048, start=1, end=6)
+        tsc = compile_tensor(sc)
+        # the wrapper substitutes the XLA fallback config and runs
+        final, _, _ = run_rounds(
+            init_state(cfg), cfg, 3, jax.random.PRNGKey(0), scenario=tsc
+        )
+        assert int(final.round) == 3
+        # the impl refuses a pallas config + scenario outright (the rr
+        # scan samples its own edges and would ignore the filter)
+        with pytest.raises(ValueError, match="merge_kernel='xla'"):
+            _run_rounds_impl(
+                init_state(cfg), cfg, 3, jax.random.PRNGKey(0),
+                scenario=tsc,
+            )
+
+    def test_lossy_links_slow_detection_but_not_correctness(self):
+        from gossipfs_tpu.bench.run import tracked_crash_events
+        from gossipfs_tpu.core.rounds import run_rounds
+
+        n = 64
+        cfg = gossip_only_cfg(n)
+        sc = FaultScenario(
+            name="lossy", n=n,
+            link_faults=(LinkFault(start=0, end=40, rate=0.4,
+                                   src=tuple(range(n)),
+                                   dst=tuple(range(n))),),
+        )
+        events, crash_rounds, churn_ok = tracked_crash_events(cfg, 25, 3, 4)
+        final, mcarry, per = run_rounds(
+            init_state(cfg), cfg, 25, jax.random.PRNGKey(1),
+            events=events, scenario=compile_tensor(sc),
+        )
+        fd = np.asarray(mcarry.first_detect)
+        for node, r0 in crash_rounds.items():
+            # detection still lands, within t_fail plus loss-induced lag
+            assert r0 + cfg.t_fail <= fd[node] <= r0 + cfg.t_fail + 8
+
+    def test_slow_node_rule(self):
+        from gossipfs_tpu.core.rounds import run_rounds
+
+        n = 64
+
+        def run_with(stride, t_fail):
+            cfg = gossip_only_cfg(n, t_fail=t_fail,
+                                  t_cooldown=max(6, t_fail + 1))
+            sc = FaultScenario(
+                name="slow", n=n,
+                slow_nodes=(SlowNode(start=0, end=30, stride=stride,
+                                     nodes=(1,)),),
+            )
+            _, mcarry, per = run_rounds(
+                init_state(cfg), cfg, 25, jax.random.PRNGKey(2),
+                scenario=compile_tensor(sc),
+            )
+            fp = int(np.asarray(per.false_positives).sum())
+            return int(np.asarray(mcarry.first_detect)[1]), fp
+
+        # lag well below the timeout: never detected.  (Margin matters:
+        # a handicapped sender's entry ages have heavy tails under random
+        # gossip — at stride 2 vs t_fail 5 the occasional age-6 streak
+        # already fires, which is itself a finding only this fault class
+        # surfaces.  At t_fail=10 an 11-round no-advance streak is
+        # vanishingly rare.)
+        fd_mild, _ = run_with(stride=2, t_fail=10)
+        assert fd_mild == -1
+        # lag beyond the timeout: the lagging node IS declared failed
+        # while alive — a partial-failure FALSE POSITIVE, the scenario
+        # class the crash-stop model could never produce
+        fd_slow, fps = run_with(stride=12, t_fail=5)
+        assert fd_slow >= 0 and fps > 0
+
+
+# ---------------------------------------------------------------------------
+# three-engine parity: one scenario file, same detection events
+# ---------------------------------------------------------------------------
+
+
+class TestEngineParity:
+    def test_partition_parity_sim_vs_udp(self):
+        """The same small-N partition scenario file drives the tensor sim
+        and the asyncio UDP engine; both must produce the same detection
+        events: each side detects exactly the other side, no same-side
+        detections, and both end fully split (the satellite acceptance).
+        """
+        from gossipfs_tpu.detector.sim import SimDetector
+        from gossipfs_tpu.detector.udp import UdpCluster
+
+        n = 10
+        side_a, side_b = set(range(5)), set(range(5, 10))
+        sc = split_halves(n, start=5, end=1000)
+
+        # -- tensor sim (ring parity mode, gossip-only dissemination)
+        cfg = SimConfig(n=n, remove_broadcast=False, fresh_cooldown=True,
+                        t_cooldown=6)
+        det = SimDetector(cfg, seed=0)
+        det.load_scenario(sc)
+        det.advance(30)
+        sim_events = det.drain_events()
+        sim_views = {i: set(det.membership(i)) for i in range(n)}
+
+        # -- asyncio UDP engine, same scenario object
+        async def udp_run():
+            c = UdpCluster(n=n, base_port=23400, period=0.05,
+                           fresh_cooldown=True, scenario=sc)
+            try:
+                await c.start_all()
+                await c.run(30)
+                return (c.drain_events(),
+                        {i: set(c.membership(i)) for i in c.alive_nodes()})
+            finally:
+                c.stop_all()
+
+        udp_events, udp_views = asyncio.run(udp_run())
+
+        for name, events, views in (("sim", sim_events, sim_views),
+                                    ("udp", udp_events, udp_views)):
+            det_by_a = {e.subject for e in events if e.observer in side_a}
+            det_by_b = {e.subject for e in events if e.observer in side_b}
+            assert det_by_a == side_b, (name, det_by_a)
+            assert det_by_b == side_a, (name, det_by_b)
+            for i, view in views.items():
+                assert view == (side_a if i in side_a else side_b), (
+                    name, i, view)
+
+    def test_udp_scenario_status_and_clear(self):
+        from gossipfs_tpu.detector.udp import UdpCluster
+
+        async def run():
+            c = UdpCluster(n=4, base_port=23600, period=0.05)
+            try:
+                await c.start_all()
+                assert c.scenario_status() is None
+                c.load_scenario(split_halves(4, 0, 10))
+                st = c.scenario_status()
+                assert st["active"] and st["name"] == "halves"
+                c.clear_scenario()
+                assert c.scenario_status() is None
+            finally:
+                c.stop_all()
+
+        asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# CoSim under partition: SDFS quorum behavior (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+
+class TestCoSimPartition:
+    def test_minority_puts_fail_quorum_then_heal_restores(self):
+        from gossipfs_tpu.cosim import CoSim
+        from gossipfs_tpu.sdfs.quorum import quorum
+
+        n = 16
+        cfg = gossip_only_cfg(n)
+        sim = CoSim(cfg, seed=0)
+        assert sim.put("a.txt", b"v1")
+        holders = list(sim.cluster.master.files["a.txt"].node_list)
+        assert len(holders) == 4 and quorum(4) == 2
+
+        # minority side: the master (node 0) plus two NON-holders — at
+        # most one replica of a.txt is reachable from inside, below the
+        # 2-ack quorum.  3 < min_group, so the minority also never
+        # detects the far side (small groups refresh only): its view
+        # stays full while its transport is cut — the harshest variant.
+        others = [x for x in range(1, n) if x not in holders][:2]
+        minority = tuple(sorted([0, *others]))
+        sc = FaultScenario(
+            name="minority", n=n,
+            partitions=(Partition(start=1, end=30, groups=(minority,)),),
+        )
+        sim.load_scenario(sc)
+        sim.tick(3)  # split active; control plane reachability confined
+        assert sim.cluster.reachable == set(minority)
+
+        # minority-side write: plan reuses the 4 holders, but <= 1 of
+        # them answers from this side — the put must fail its quorum
+        assert not sim.put("a.txt", b"v2-split", confirm=lambda: True)
+        # reads fail their version-report quorum the same way
+        assert sim.get("a.txt") is None
+
+        # heal, let reachability recover, and write again: durability is
+        # restored (all holders ack; the read returns the fresh bytes)
+        sim.tick(30)
+        assert sim.cluster.reachable == set(range(n))
+        assert sim.put("a.txt", b"v3-healed", confirm=lambda: True)
+        assert sim.get("a.txt") == b"v3-healed"
+
+
+# ---------------------------------------------------------------------------
+# literal-N padding (VERDICT missing #1 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestPadding:
+    def test_padded_cohort_excludes_pads_end_to_end(self):
+        """XLA-path integration at small N: pads start dead, survive
+        churn AND rejoin rounds without ever entering the cohort, stay
+        out of every view, and the metrics count the effective N."""
+        from gossipfs_tpu.bench.run import tracked_crash_events
+        from gossipfs_tpu.core.rounds import run_rounds
+        from gossipfs_tpu.metrics.detection import summarize
+
+        n_pad, n_live = 256, 250
+        cfg = gossip_only_cfg(n_pad)
+        events, crash_rounds, churn_ok = tracked_crash_events(
+            cfg, 20, 4, 3, n_live=n_live
+        )
+        assert all(node < n_live for node in crash_rounds)
+        assert not np.asarray(churn_ok)[n_live:].any()
+        mask = jnp.arange(n_pad) < n_live
+        final, mcarry, per_round = run_rounds(
+            init_state(cfg, mask), cfg, 20, jax.random.PRNGKey(0),
+            events=events, crash_rate=0.02, rejoin_rate=0.2,
+            churn_ok=churn_ok,
+        )
+        alive = np.asarray(final.alive)
+        status = np.asarray(final.status)
+        assert not alive[n_live:].any()          # pads never resurrect
+        assert (status[:, n_live:] != 1).all()   # ...or enter any view
+        fd = np.asarray(mcarry.first_detect)
+        assert (fd[n_live:] == -1).all()         # ...or get detected
+        report = summarize(mcarry, per_round, crash_rounds,
+                           n_effective=n_live)
+        assert report.n == n_live
+        detected = [v for v in report.ttd_first.values() if v >= 0]
+        assert len(detected) == len(crash_rounds)
+
+    def test_rr_packed_init_member_mask(self):
+        """The frontier path's padded initializer: pad rows/columns start
+        UNKNOWN and dead, counts reflect the live cohort only."""
+        from gossipfs_tpu.core.rounds import rr_packed_init
+        from gossipfs_tpu.ops import merge_pallas
+
+        n_pad, n_live = 2048, 2000
+        cfg = SimConfig.packed_rr(n_pad, 1024, interpret=True)
+        mask = np.arange(n_pad) < n_live
+        hb4, as4, alive, hb_base, rnd, counts = rr_packed_init(
+            cfg, member_mask=mask
+        )
+        assert np.array_equal(np.asarray(alive), mask)
+        st = np.asarray(merge_pallas.unpack_age_status(as4)[1])
+        # stripe-major [nc, N, cs, LANE] -> [receiver, subject]
+        st2 = st.transpose(1, 0, 2, 3).reshape(n_pad, n_pad)
+        want = np.where(mask[:, None] & mask[None, :], 1, 0)
+        assert np.array_equal(st2, want)
+        assert np.array_equal(
+            np.asarray(counts), np.where(mask, n_live, 0)
+        )
+        assert int(np.asarray(hb4).max()) == 0
+
+    def test_frontier_pad_math_hits_literal_100k(self):
+        from gossipfs_tpu.bench.frontier import pad_quantum
+
+        q = pad_quantum(1024, "random_arc")
+        assert q == 1024
+        n_pad = -(-100_000 // q) * q
+        assert n_pad == 100_352 and n_pad - 100_000 == 352
+        # the padded size is an admissible rr shape at the frontier width
+        from gossipfs_tpu.ops import merge_pallas
+
+        assert merge_pallas.rr_supported(n_pad, 24, 1024, arc_align=8)
+
+
+# ---------------------------------------------------------------------------
+# partition metrics (metrics/detection.py)
+# ---------------------------------------------------------------------------
+
+
+class TestPartitionMetrics:
+    def test_partition_round_stats_counts(self):
+        from gossipfs_tpu.metrics.detection import partition_round_stats
+
+        n = 4
+        pid = jnp.asarray([0, 0, 1, 1], jnp.int32)
+        status = jnp.asarray(
+            [[1, 1, 1, 0],   # row 0 still holds cross member 2
+             [1, 1, 0, 0],
+             [1, 0, 1, 1],   # row 2 still holds cross member 0
+             [1, 1, 1, 1]],  # row 3 is dead: ignored
+            jnp.int8,
+        )
+        hb = jnp.zeros((n, n), jnp.int32).at[0, 2].set(7).at[3, 0].set(99)
+        state = SimState(
+            hb=hb, age=jnp.zeros((n, n), jnp.int8), status=status,
+            alive=jnp.asarray([True, True, True, False]),
+            round=jnp.int32(0), hb_base=jnp.zeros((n,), jnp.int32),
+        )
+        out = np.asarray(partition_round_stats(state, pid))
+        cross_members, cross_hb_max, cross_complete, complete, n_alive = out
+        assert cross_members == 2     # (0,2) and (2,0); dead row 3 ignored
+        assert cross_hb_max == 7      # row 3's 99 is a dead observer's
+        assert cross_complete == 0    # (1,2) and (2,1) missing
+        assert complete == 0
+        assert n_alive == 3
+
+    def test_summarize_partition_series(self):
+        from gossipfs_tpu.detector.api import DetectionEvent
+        from gossipfs_tpu.metrics.detection import summarize_partition
+
+        pid = np.asarray([0, 0, 1, 1])
+        series = []
+        for r in range(1, 13):
+            series.append({
+                "round": r,
+                "cross_members": 4 if r <= 6 else 0,
+                # the max is 3 at the split-boundary state (r=2) and
+                # jumps INSIDE the split — exactly one counted advance
+                # (a jump at r=2 itself would be pre-split gossip)
+                "cross_hb_max": 5 if r >= 4 else 3,
+                "cross_complete": r >= 11,
+                "complete": r >= 12,
+                "n_alive": 4,
+            })
+        events = [
+            DetectionEvent(round=5, observer=0, subject=2,
+                           false_positive=True),   # cross: expected
+            DetectionEvent(round=6, observer=0, subject=1,
+                           false_positive=True),   # same side, alive: FP
+            DetectionEvent(round=7, observer=2, subject=3,
+                           false_positive=False),  # tracked crash, local
+        ]
+        rep = summarize_partition(
+            series, events, pid, split_at=2, heal_at=8,
+            crash_rounds={3: 4},
+        )
+        assert rep.split_brain_rounds == 5      # cross_members 0 at r=7
+        assert rep.view_divergence_max == 4
+        assert rep.cross_hb_advances == 1       # 3 -> 5 within the split
+        assert rep.reconverge_rounds == 3       # cross complete at r=11
+        assert rep.full_view_rounds == 4
+        assert rep.local_ttd == {3: 3}
+        assert rep.cross_detections == 1
+        assert rep.local_false_positives == 1
+        assert rep.local_fp_rate > 0
+
+
+# ---------------------------------------------------------------------------
+# CLI verbs
+# ---------------------------------------------------------------------------
+
+
+class TestCliVerbs:
+    def test_scenario_load_status_clear(self, tmp_path):
+        from gossipfs_tpu.cosim import CoSim
+        from gossipfs_tpu.shim import cli
+
+        path = tmp_path / "split.json"
+        path.write_text(split_halves(10, 2, 20).to_json())
+        cfg = SimConfig(n=10, remove_broadcast=False, fresh_cooldown=True)
+        sim = CoSim(cfg, seed=0)
+        out = io.StringIO()
+        assert cli.dispatch(sim, f"scenario load {path}", out=out)
+        assert "armed 'halves'" in out.getvalue()
+        cli.dispatch(sim, "advance 3", out=out)
+        cli.dispatch(sim, "scenario status", out=out)
+        assert "ACTIVE" in out.getvalue()
+        cli.dispatch(sim, "scenario clear", out=out)
+        out2 = io.StringIO()
+        cli.dispatch(sim, "scenario status", out=out2)
+        assert "no scenario armed" in out2.getvalue()
+
+    def test_load_on_broadcast_config_reports_error(self, tmp_path):
+        from gossipfs_tpu.cosim import CoSim
+        from gossipfs_tpu.shim import cli
+
+        path = tmp_path / "split.json"
+        path.write_text(split_halves(10, 2, 20).to_json())
+        sim = CoSim(SimConfig(n=10), seed=0)  # reference broadcast mode
+        out = io.StringIO()
+        assert cli.dispatch(sim, f"scenario load {path}", out=out)
+        assert "error:" in out.getvalue()
+        assert "remove_broadcast" in out.getvalue()
+
+    def test_gossip_only_flag(self):
+        from gossipfs_tpu.shim import cli
+
+        args = cli.make_parser().parse_args(["--n", "8", "--gossip-only"])
+        assert args.gossip_only
+
+
+# ---------------------------------------------------------------------------
+# deploy variant (slow lane): the same rule table over OS processes
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_deploy_partition_split_brain(tmp_path):
+    """The per-process deployment under the same declarative partition:
+    the launcher pushes one rule table over the control plane, each
+    daemon's send hook drops cross-side datagrams, and the two sides
+    converge to independent views — detection/REMOVE all crossing real
+    process boundaries."""
+    from gossipfs_tpu.deploy.launcher import Cluster
+
+    n = 8
+    side_a = tuple(range(4))
+    # t_fail=15, not the default 5: while the split settles, each side's
+    # freshness paths route past dropped cross edges — at t_fail=5 a 4/4
+    # ring split sits exactly on the false-positive cascade threshold
+    # (the BASELINE ring-fragility finding) and a side can collapse on a
+    # loaded host.  The margin makes the test pin the PARTITION behavior,
+    # not the ring's marginality.
+    cluster = Cluster(n, period=0.1, root=str(tmp_path), t_fail=15)
+    try:
+        cluster.start(timeout=90.0)
+        sc = FaultScenario(
+            name="deploy-split", n=n,
+            partitions=(Partition(start=0, end=100_000, groups=(side_a,)),),
+        )
+        acked = cluster.load_scenario(sc)
+        assert set(acked) == set(range(n))
+        status = cluster.scenario_status()
+        assert len(status) == n and all(ln["armed"] for ln in status)
+
+        want = {
+            i: (set(side_a) if i in side_a else set(range(4, n)))
+            for i in range(n)
+        }
+        deadline = time.monotonic() + 60.0
+        views = {}
+        while time.monotonic() < deadline:
+            views = {i: set(cluster.client(i).lsm(i)) for i in range(n)}
+            if views == want:
+                break
+            time.sleep(0.2)
+        assert views == want, f"views never fully split: {views}"
+
+        # each side collectively logged detections of the OTHER side only
+        # (per-node sets can be empty: a node that learned of a far-side
+        # member via a peer's REMOVE broadcast never fires its own
+        # detector — reference dissemination semantics)
+        for side in (set(side_a), set(range(4, n))):
+            subjects: set[int] = set()
+            for i in side:
+                lines = cluster.client(i).call(
+                    "Grep", pattern="detected failure"
+                ).get("lines") or []
+                subjects |= {int(ln["subject"]) for ln in lines}
+            assert subjects and subjects <= (set(range(n)) - side), (
+                side, subjects)
+    finally:
+        cluster.stop()

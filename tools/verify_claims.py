@@ -67,6 +67,21 @@ CLAIMS = {
          "--shards", "8", "--block-c", "2048", "--fanout", "24",
          "--rounds", "40", "--reps", "3"],
         lambda d: d["implied_rounds_per_sec_v5e8"], 23.5, 0.3),
+    # scenario engine (PARTITION_r07.json is the committed artifact of
+    # the same command): during a netsplit ZERO cross-partition heartbeat
+    # propagation (cross_hb_advances == 0) and, after heal, cross views
+    # reconverge within t_fail + gossip diameter rounds
+    # (reconverge_rounds <= reconverge_bound).  CPU-feasible — pinned to
+    # the cpu backend so a contended axon window can't skew it.
+    "partition_reconv": (
+        ["env", "JAX_PLATFORMS=cpu", sys.executable, "-m",
+         "gossipfs_tpu.bench.curves", "--partition", "--ns", "1024"],
+        lambda d: 1.0 if all(
+            r["cross_hb_advances"] == 0
+            and 0 <= r["reconverge_rounds"] <= r["reconverge_bound"]
+            for r in d["rows"]
+        ) else 0.0,
+        1.0, 0.0),
 }
 
 
