@@ -237,46 +237,165 @@ def partition_sweep(ns=(1024,), seed=0, split_at=5) -> dict:
     }
 
 
-def sweep_t_fail(n=4096, t_fails=(3, 5, 8, 12), rounds=ROUNDS, seed=0) -> dict:
-    """The deployment knob: detection latency vs false-positive tradeoff.
+def sweep_t_fail(n=4096, t_fails=(3, 5, 8, 12), t_suspects=(0, 2),
+                 rounds=ROUNDS, seed=0) -> dict:
+    """The deployment knobs: detection latency vs false-positive tradeoff.
 
     The reference hardcodes t_fail = 5 s (slave.go:24); this sweep shows
-    what that choice buys — each row is (t_fail, TTD, FPR) at fixed N under
-    1% crash churn, the curve an operator would tune against.
+    what that choice buys — and, since the suspicion subsystem
+    (suspicion/), what the SECOND knob buys: each row is (t_fail,
+    t_suspect, TTD, FPR) at fixed N under 1% crash churn, the two-knob
+    surface an operator would tune against.  ``t_suspect=0`` rows are the
+    legacy single-knob curve (suspicion off); suspicion rows run the XLA
+    fallback path (suspicion.with_suspicion) with refutation counts
+    attached, so the knee analysis covers where SUSPECT+refute moves it.
     """
+    from gossipfs_tpu.suspicion import SuspicionParams, with_suspicion
+
     rows = []
     for t_fail in t_fails:
-        cfg = SimConfig(
-            n=n,
-            topology="random",
-            fanout=SimConfig.log_fanout(n),
-            remove_broadcast=False,
-            fresh_cooldown=True,
-            t_fail=t_fail,
-            t_cooldown=max(12, t_fail + 4),
-            merge_kernel="pallas",
-            view_dtype="int8",
-            hb_dtype="int16",
-            merge_block_c=16_384,
-        )
-        events, crash_rounds, churn_ok = tracked_crash_events(
-            cfg, rounds, TRACK, CRASH_AT
-        )
-        final, carry, per_round = run_rounds(
-            init_state(cfg), cfg, rounds, jax.random.PRNGKey(seed),
-            events=events, crash_rate=0.01, churn_ok=churn_ok,
-        )
-        report = summarize(carry, per_round, crash_rounds)
-        ttd_f = [v for v in report.ttd_first.values() if v >= 0]
-        rows.append(
-            {
-                "t_fail": t_fail,
-                "ttd_first_median": statistics.median(ttd_f) if ttd_f else None,
-                "false_positive_rate": report.false_positive_rate,
-            }
-        )
-    return {"metric": "TTD vs FPR over t_fail (the reference's 5 s knob)",
+        for t_sus in t_suspects:
+            cfg = SimConfig(
+                n=n,
+                topology="random",
+                fanout=SimConfig.log_fanout(n),
+                remove_broadcast=False,
+                fresh_cooldown=True,
+                t_fail=t_fail,
+                t_cooldown=max(12, t_fail + 4),
+                merge_kernel="pallas",
+                view_dtype="int8",
+                hb_dtype="int16",
+                merge_block_c=16_384,
+            )
+            if t_sus:
+                cfg = with_suspicion(cfg, SuspicionParams(t_suspect=t_sus))
+            events, crash_rounds, churn_ok = tracked_crash_events(
+                cfg, rounds, TRACK, CRASH_AT
+            )
+            final, carry, per_round = run_rounds(
+                init_state(cfg), cfg, rounds, jax.random.PRNGKey(seed),
+                events=events, crash_rate=0.01, churn_ok=churn_ok,
+            )
+            report = summarize(carry, per_round, crash_rounds)
+            ttd_f = [v for v in report.ttd_first.values() if v >= 0]
+            rows.append(
+                {
+                    "t_fail": t_fail,
+                    "t_suspect": t_sus,
+                    "ttd_first_median": statistics.median(ttd_f) if ttd_f else None,
+                    "false_positive_rate": report.false_positive_rate,
+                    "suspects_entered": report.suspects_entered,
+                    "refutations": report.refutations,
+                    "fp_suppressed": report.fp_suppressed,
+                }
+            )
+    return {"metric": "TTD vs FPR over (t_fail, t_suspect) — the "
+                      "reference's 5 s knob plus the SWIM suspicion knob",
             "n": n, "rows": rows}
+
+
+def suspicion_sweep(ns=(1024,), rounds=ROUNDS, seed=0, t_fail_fast=3,
+                    t_suspect=2, t_fail_base=5, loss_rate=0.9,
+                    loss_frac=16) -> dict:
+    """Suspicion A/B — the committed SUSPECT artifact (suspicion/).
+
+    Per N, two fault regimes x three detector modes:
+
+      * regimes: (a) the standard 1% random crash churn; (b) a PR-2
+        Bernoulli-loss scenario — 1/``loss_frac`` of the cohort loses
+        ``loss_rate`` of its OUTGOING datagrams for the whole horizon
+        (scenarios/: the partial-failure class that manufactures exactly
+        the transient staleness suspicion exists to absorb);
+      * modes: ``t_fail=5`` baseline (the reference knee), ``t_fail=3``
+        raw (the FP storm the --t-fail-sweep documents), and
+        ``t_fail=3 + t_suspect=2`` — SWIM suspicion at the fast knob.
+
+    The claims the rows pin (tools/verify_claims.py ``suspicion_fpr``
+    re-runs this command): with suspicion at t_fail=3, median TTD-first
+    stays <= t_fail + t_suspect (the t_fail=5-class latency) while FPR
+    stays within 10x of the t_fail=5 baseline instead of the raw-t3
+    storm; and under the loss scenario suspicion-on FPR is strictly
+    below suspicion-off at the same t_fail.  CPU-feasible at N=1024.
+    """
+    from gossipfs_tpu.scenarios import FaultScenario, LinkFault
+    from gossipfs_tpu.scenarios.tensor import compile_tensor
+    from gossipfs_tpu.suspicion import SuspicionParams, with_suspicion
+
+    rows = []
+    for n in ns:
+        base_kw = dict(
+            n=n, topology="random", fanout=SimConfig.log_fanout(n),
+            remove_broadcast=False, fresh_cooldown=True, t_cooldown=12,
+            merge_kernel="xla",
+        )
+        # lossy senders: the first n/loss_frac nodes drop loss_rate of
+        # their outgoing gossip (asymmetric: their inbound is fine) —
+        # their entries at everyone else go stale in bursts
+        lossy = tuple(range(max(n // loss_frac, 1)))
+        loss_sc = FaultScenario(
+            name="lossy-senders", n=n,
+            link_faults=(LinkFault(start=0, end=rounds, rate=loss_rate,
+                                   src=lossy, dst=tuple(range(n))),),
+        )
+        for fault in ("churn", "loss"):
+            for mode, t_fail, sus in (
+                ("baseline-t5", t_fail_base, None),
+                ("raw-t3", t_fail_fast, None),
+                ("suspect-t3", t_fail_fast,
+                 SuspicionParams(t_suspect=t_suspect)),
+            ):
+                cfg = SimConfig(
+                    **base_kw, t_fail=t_fail,
+                )
+                if sus is not None:
+                    cfg = with_suspicion(cfg, sus)
+                events, crash_rounds, churn_ok = tracked_crash_events(
+                    cfg, rounds, TRACK, CRASH_AT
+                )
+                kw: dict = dict(events=events, churn_ok=churn_ok,
+                                crash_only_events=True)
+                if fault == "churn":
+                    kw["crash_rate"] = 0.01
+                else:
+                    kw["scenario"] = compile_tensor(loss_sc)
+                final, carry, per_round = run_rounds(
+                    init_state(cfg), cfg, rounds, jax.random.PRNGKey(seed),
+                    **kw,
+                )
+                report = summarize(carry, per_round, crash_rounds)
+                ttd_f = [v for v in report.ttd_first.values() if v >= 0]
+                ttd_s = [v for v in report.ttd_suspect.values() if v >= 0]
+                s2c = [v for v in report.suspect_to_confirm.values()
+                       if v >= 0]
+                rows.append({
+                    "n": n,
+                    "fault": fault,
+                    "mode": mode,
+                    "t_fail": t_fail,
+                    "t_suspect": sus.t_suspect if sus else 0,
+                    "tracked_crashes": len(crash_rounds),
+                    "detected": len(ttd_f),
+                    "ttd_first_median": statistics.median(ttd_f) if ttd_f else None,
+                    "ttd_first_max": max(ttd_f) if ttd_f else None,
+                    "ttd_suspect_median": statistics.median(ttd_s) if ttd_s else None,
+                    "suspect_to_confirm_median": statistics.median(s2c) if s2c else None,
+                    "false_positive_rate": report.false_positive_rate,
+                    "false_positives": report.false_positives,
+                    "suspects_entered": report.suspects_entered,
+                    "refutations": report.refutations,
+                    "fp_suppressed": report.fp_suppressed,
+                })
+    return {
+        "metric": "suspicion A/B: TTD & FPR, suspicion-on vs -off "
+                  "(rounds; 1 round == 1 s reference time)",
+        "protocol": f"random fanout=log2(N), gossip-only dissemination; "
+                    f"modes t_fail={t_fail_base} | t_fail={t_fail_fast} raw"
+                    f" | t_fail={t_fail_fast}+t_suspect={t_suspect}; "
+                    f"faults: 1% crash churn | Bernoulli loss rate="
+                    f"{loss_rate} on 1/{loss_frac} of senders",
+        "rows": rows,
+    }
 
 
 def main(argv=None) -> None:
@@ -296,7 +415,12 @@ def main(argv=None) -> None:
     p.add_argument("--fanout", type=int, default=None,
                    help="override fanout (default log2(N))")
     p.add_argument("--t-fail-sweep", action="store_true",
-                   help="sweep t_fail at fixed N instead of N")
+                   help="sweep the (t_fail, t_suspect) knob surface at "
+                        "fixed N instead of N")
+    p.add_argument("--suspicion", action="store_true",
+                   help="suspicion A/B rows (suspicion-on vs -off under "
+                        "crash churn and a Bernoulli-loss scenario) — "
+                        "the SUSPECT artifact")
     p.add_argument("--partition", action="store_true",
                    help="scenario-engine netsplit rows (split-brain "
                         "duration, view divergence, reconvergence) "
@@ -305,6 +429,9 @@ def main(argv=None) -> None:
     args = p.parse_args(argv)
     if args.partition:
         doc = json.dumps(partition_sweep(ns=tuple(args.ns)))
+    elif args.suspicion:
+        doc = json.dumps(suspicion_sweep(ns=tuple(args.ns),
+                                         rounds=args.rounds))
     elif args.t_fail_sweep:
         doc = json.dumps(sweep_t_fail(rounds=args.rounds))
     else:

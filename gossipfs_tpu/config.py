@@ -17,7 +17,11 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Literal
+from typing import TYPE_CHECKING, Literal
+
+if TYPE_CHECKING:  # runtime import is lazy (see __post_init__) — the
+    # suspicion package must stay importable from jax-free daemons
+    from gossipfs_tpu.suspicion.params import SuspicionParams
 
 Topology = Literal["ring", "random", "random_arc"]
 
@@ -120,7 +124,18 @@ class SimConfig:
                                      # path consumes per-edge-filtered
                                      # edges natively.  Same protocol
                                      # arithmetic, fault-free transport
-                                     # stays on the fast kernels
+                                     # stays on the fast kernels.
+                                     # Suspicion subsystem (suspicion/):
+                                     # same gating rule — a config with
+                                     # ``suspicion`` set requires
+                                     # merge_kernel="xla" (the pallas/rr
+                                     # kernels fuse the MEMBER-only
+                                     # tick/epilogue in-kernel and know
+                                     # nothing of the SUSPECT lifecycle);
+                                     # suspicion.with_suspicion(cfg, p)
+                                     # substitutes it like
+                                     # xla_fallback_config does for
+                                     # scenario runs
     view_dtype: str = "int16"        # gossip-view storage: "int16" | "int8".
                                      # int8 halves the merge's HBM traffic but
                                      # its 126-round rebase window only covers
@@ -165,6 +180,22 @@ class SimConfig:
                                      # rr_resident_supported); "on": require
                                      # it (error if it cannot fit); "off":
                                      # always stream receiver blocks
+    suspicion: "SuspicionParams | None" = None
+                                     # SWIM suspect/refute lifecycle
+                                     # (suspicion/params.py): silent
+                                     # members pass through SUSPECT for
+                                     # t_suspect rounds (refutable by any
+                                     # heartbeat advance) before FAILED.
+                                     # None = the reference's direct
+                                     # crash-on-timeout.  Requires the
+                                     # gossip-only protocol mode
+                                     # (remove_broadcast off + fresh
+                                     # cooldown), merge_kernel="xla" and
+                                     # elementwise="lanes" — see
+                                     # suspicion/tensor.py (the scenario
+                                     # engine's gating pattern); build
+                                     # configs via
+                                     # suspicion.with_suspicion(cfg, p)
     fused_tick: str = "auto"         # "auto": rounds with no join/leave events
                                      # and remove_broadcast off fuse the
                                      # heartbeat tick (bump/detect/cooldown)
@@ -316,6 +347,44 @@ class SimConfig:
             raise ValueError("elementwise='swar' requires hb_dtype='int8'")
         if self.fused_tick not in ("auto", "off"):
             raise ValueError(f"unknown fused_tick: {self.fused_tick!r}")
+        if self.suspicion is not None:
+            # SWIM suspect/refute lifecycle: enforce the engine gating at
+            # construction (suspicion/tensor.py documents the why) so a
+            # fast-kernel + suspicion config is unconstructible rather
+            # than silently running the suspicion-free kernels
+            from gossipfs_tpu.suspicion.params import SuspicionParams
+            from gossipfs_tpu.suspicion.tensor import (
+                require_suspicion_config,
+            )
+
+            if not isinstance(self.suspicion, SuspicionParams):
+                raise ValueError(
+                    "suspicion must be a suspicion.SuspicionParams, got "
+                    f"{type(self.suspicion).__name__}"
+                )
+            # the dissemination-mode requirements have ONE owner
+            # (suspicion/tensor.py documents the why); only the kernel
+            # gates below — the ones with_suspicion substitutes rather
+            # than requires — live here
+            require_suspicion_config(self)
+            if self.merge_kernel != "xla":
+                raise ValueError(
+                    "suspicion requires merge_kernel='xla' (the pallas/rr "
+                    "kernels fuse the MEMBER-only round in-kernel; use "
+                    "suspicion.with_suspicion, which substitutes it)"
+                )
+            if self.elementwise != "lanes":
+                raise ValueError(
+                    "suspicion requires elementwise='lanes' (the SWAR word "
+                    "constants encode the 3-state status machine)"
+                )
+            worst = self.suspicion.max_confirm_after(self.t_fail)
+            if worst >= AGE_CLAMP:
+                raise ValueError(
+                    f"t_fail + t_suspect * (1 + lh_multiplier) = {worst} "
+                    f"must be < AGE_CLAMP ({AGE_CLAMP}); the age lane — "
+                    "which carries the suspicion clock — saturates there"
+                )
         if self.view_dtype not in ("int16", "int8"):
             raise ValueError(f"unknown view_dtype: {self.view_dtype!r}")
         if self.hb_dtype not in ("int32", "int16", "int8"):

@@ -45,6 +45,7 @@ from gossipfs_tpu.core import topology
 from gossipfs_tpu.core.state import (
     FAILED,
     MEMBER,
+    SUSPECT,
     UNKNOWN,
     RoundEvents,
     SimState,
@@ -119,6 +120,21 @@ def _eye(n: int, shape: tuple[int, ...], ctx: ShardCtx = LOCAL_CTX) -> jax.Array
 
 def _subj_axes(a: jax.Array) -> tuple[int, ...]:
     return tuple(range(1, a.ndim))
+
+
+def _listed(status: jax.Array, config: SimConfig) -> jax.Array:
+    """bool mask of entries in the membership list.
+
+    Under the SWIM lifecycle (config.suspicion, suspicion/) a SUSPECT
+    entry is still a member — it gossips, counts toward min_group, and
+    is marked by LEAVE like any member — pending refutation or
+    confirmation; only the detector treats it specially.  In the
+    reference mode SUSPECT is unreachable, so the extra compare is
+    dropped at trace time.
+    """
+    if config.suspicion is None:
+        return status == MEMBER
+    return (status == MEMBER) | (status == SUSPECT)
 
 
 def _diag(arr: jax.Array, ctx: ShardCtx = LOCAL_CTX) -> jax.Array:
@@ -201,24 +217,43 @@ def _from_blocked(state: SimState) -> SimState:
 
 
 class RoundMetrics(NamedTuple):
-    """Per-round scalar observables (cheap enough to stack over any horizon)."""
+    """Per-round scalar observables (cheap enough to stack over any horizon).
+
+    Under suspicion (config.suspicion, suspicion/) ``true_detections`` /
+    ``false_positives`` count SUSPECT -> FAILED *confirmations* — the
+    lifecycle's actual failure declarations — and the three suspicion
+    counters are live; in the reference mode they are constant zeros
+    (folded away by XLA).
+    """
 
     true_detections: jax.Array   # detector fired on an actually-dead subject
     false_positives: jax.Array   # detector fired on a live subject
     n_alive: jax.Array
+    suspects_entered: jax.Array  # entries newly marked SUSPECT this round
+    refutations: jax.Array       # SUSPECT entries refuted (-> MEMBER)
+    fp_suppressed: jax.Array     # refutations of actually-ALIVE subjects —
+                                 # each one a false positive the plain
+                                 # crash-on-timeout detector would have fired
 
 
 def _round_stats(
-    n_det: jax.Array, state: SimState, ctx: ShardCtx
+    n_det: jax.Array, state: SimState, ctx: ShardCtx,
+    sus_stats: tuple[jax.Array, jax.Array, jax.Array] | None = None,
 ) -> tuple[RoundMetrics, jax.Array]:
     """Scalar RoundMetrics + any_fail from the per-subject detector counts."""
     nloc = n_det.shape[0]
     dead_l = ctx.slice_cols(~state.alive, nloc)
     alive_l = ctx.slice_cols(state.alive, nloc)
+    if sus_stats is None:
+        z = jnp.int32(0)
+        sus_stats = (z, z, z)
     metrics = RoundMetrics(
         true_detections=ctx.psum(jnp.sum(jnp.where(dead_l, n_det, 0))),
         false_positives=ctx.psum(jnp.sum(jnp.where(alive_l, n_det, 0))),
         n_alive=jnp.sum(state.alive, dtype=jnp.int32),
+        suspects_entered=sus_stats[0],
+        refutations=sus_stats[1],
+        fp_suppressed=sus_stats[2],
     )
     return metrics, n_det > 0
 
@@ -232,17 +267,23 @@ class MetricsCarry(NamedTuple):
     per-observer detection events instead of an aggregate placeholder.
     ``converged[j]``: first round every live observer had dropped j from its
     list (the cluster-wide detection-complete time the BASELINE curves want).
+    ``first_suspect[j]``: first round any observer held j SUSPECT
+    (suspicion runs only; stays -1 in the reference mode) — the
+    suspect-to-confirm latency the suspicion metrics report is
+    ``first_detect - first_suspect``.
     All are -1 until the event happens; reset to -1 when j rejoins.
     """
 
     first_detect: jax.Array    # int32 [N]
     first_observer: jax.Array  # int32 [N]
     converged: jax.Array       # int32 [N]
+    first_suspect: jax.Array   # int32 [N]
 
     @staticmethod
     def init(n: int) -> "MetricsCarry":
         neg = jnp.full((n,), -1, dtype=jnp.int32)
-        return MetricsCarry(first_detect=neg, first_observer=neg, converged=neg)
+        return MetricsCarry(first_detect=neg, first_observer=neg,
+                            converged=neg, first_suspect=neg)
 
 
 def _apply_events(
@@ -275,7 +316,7 @@ def _apply_events(
     # (removeMember appends the live Member struct, slave.go:276-286), so age
     # keeps running — cooldown is measured from the last gossip refresh.
     leave = events.leave & alive
-    mark = _rx(alive, nd) & (status == MEMBER) & _sj(leave, shp, ctx)
+    mark = _rx(alive, nd) & _listed(status, config) & _sj(leave, shp, ctx)
     status = jnp.where(mark, FAILED, status)
     if config.fresh_cooldown:
         age = jnp.where(mark, 0, age)
@@ -376,7 +417,8 @@ def _pre_tick(
     hb, status, alive = state.hb, state.status, state.alive
     nd, shp = hb.ndim, hb.shape
     counts = ctx.psum(
-        jnp.sum((status == MEMBER).astype(jnp.int32), axis=_subj_axes(status))
+        jnp.sum(_listed(status, config).astype(jnp.int32),
+                axis=_subj_axes(status))
     )
     small = counts < config.min_group
     active = alive & ~small
@@ -409,10 +451,19 @@ def _tick(
     hb, age, status, alive = state.hb, state.age, state.status, state.alive
     nd, shp = hb.ndim, hb.shape
     eye = _eye(n, shp, ctx)
+    sus = config.suspicion
+    # post-events status, before any tick write — the suspicion branch's
+    # local-health counts anchor here (refresher rewrites touch only
+    # inactive rows, so active rows' counts are unaffected either way)
+    status0 = status
 
-    # small groups only refresh timestamps (slave.go:504-509)
-    refresh_all = _rx(refresher, nd) & (status == MEMBER)
+    # small groups only refresh timestamps (slave.go:504-509).  Below
+    # min_group detection is disabled, so suspicion is moot there: any
+    # SUSPECT entry reverts to MEMBER with a fresh stamp
+    refresh_all = _rx(refresher, nd) & _listed(status, config)
     age = jnp.where(refresh_all, 0, age)
+    if sus is not None:
+        status = jnp.where(refresh_all & (status == SUSPECT), MEMBER, status)
 
     # bump own heartbeat + stamp — only while the self entry is still in the
     # list (updateMemberList matches by address, slave.go:443-448; a node that
@@ -447,14 +498,45 @@ def _tick(
         past_grace = (hb >= thr) & (hb != info.min)
     else:
         past_grace = hb > (config.hb_grace - basec)
-    fail = (
-        _rx(active, nd)
-        & (status == MEMBER)
-        & ~eye
-        & past_grace
-        & (age > config.t_fail)
-    )
-    status = jnp.where(fail, FAILED, status)
+    stale = _rx(active, nd) & ~eye & past_grace & (age > config.t_fail)
+    if sus is None:
+        fail = stale & (status == MEMBER)
+        status = jnp.where(fail, FAILED, status)
+    else:
+        # SWIM lifecycle (suspicion/params.py): a silent member is
+        # SUSPECTED first; confirmation to FAILED waits t_suspect more
+        # rounds of silence (the age lane IS the suspicion clock —
+        # age - t_fail = rounds in SUSPECT), refutable in the meantime
+        # by any heartbeat advance (the merge epilogue's SUSPECT ->
+        # MEMBER write).  Both masks derive from the pre-write status,
+        # so an entry always spends >= 1 round SUSPECT before it can
+        # confirm.  Lifeguard local health: while an anomalous fraction
+        # of a receiver's own list is simultaneously SUSPECT (evidence
+        # the receiver itself is degraded — a starved or cut-off node
+        # suspects everyone at once), its confirmation window stretches
+        # by lh_multiplier
+        suspect_new = stale & (status == MEMBER)
+        if sus.lh_multiplier > 0:
+            cnt_sus = ctx.psum(jnp.sum(
+                (status0 == SUSPECT).astype(jnp.int32),
+                axis=_subj_axes(status0)))
+            cnt_listed = ctx.psum(jnp.sum(
+                _listed(status0, config).astype(jnp.int32),
+                axis=_subj_axes(status0)))
+            degraded = (cnt_sus.astype(jnp.float32)
+                        > sus.lh_frac * cnt_listed.astype(jnp.float32))
+            confirm_age = (config.t_fail + sus.t_suspect
+                           * (1 + jnp.where(degraded, sus.lh_multiplier, 0)))
+            confirm_thr = _rx(confirm_age.astype(jnp.int32), nd)
+        else:
+            confirm_thr = jnp.int32(config.t_fail + sus.t_suspect)
+        confirm = (
+            _rx(active, nd) & ~eye & (status == SUSPECT)
+            & (age.astype(jnp.int32) > confirm_thr)
+        )
+        status = jnp.where(suspect_new, SUSPECT, status)
+        status = jnp.where(confirm, FAILED, status)
+        fail = confirm
     if config.fresh_cooldown:
         age = jnp.where(fail, 0, age)
 
@@ -626,7 +708,11 @@ def _gossip_view(
     """
     hb, status = state.hb, state.status
     nd = hb.ndim
-    elig = (status == MEMBER) & _rx(senders, nd)
+    # suspicion: SUSPECT entries keep gossiping (they are still list
+    # entries carrying the last-known counter; receivers' strict
+    # max-merge makes relaying a stale copy harmless) — _listed folds to
+    # the plain MEMBER compare when suspicion is off
+    elig = _listed(status, config) & _rx(senders, nd)
     vdtype = jnp.int8 if config.view_dtype == "int8" else jnp.int16
     if hb.dtype != jnp.int32:
         # Narrow (packed) arithmetic: int16/int8 ops run 2-4x denser than
@@ -691,6 +777,7 @@ def _membership_update(
     vdtype = jnp.int8 if config.view_dtype == "int8" else jnp.int16
     any_member = best_rel >= 0
     recv = _rx(alive, nd)
+    sus_on = config.suspicion is not None
     add = recv & (status == UNKNOWN) & any_member          # learn new member
     if narrow:
         # narrow-arithmetic epilogue, bit-identical to the int32+clip
@@ -713,7 +800,7 @@ def _membership_update(
         cmp_deep = jnp.clip(info.min - 1 - shift_a, -2, vmax).astype(vdtype)
         lhs = best_n + sa_n[None]
         advance = (
-            recv & (status == MEMBER) & any_member
+            recv & _listed(status, config) & any_member
             & (best_rel > cmp_deep[None])
             & (lhs > hb)
         )
@@ -753,7 +840,7 @@ def _membership_update(
         # max-merge + stamp: best_true > hb_true, both sides shifted
         # into the stored encoding (best32 + view_base > hb, as ever)
         advance = (
-            recv & (status == MEMBER) & any_member
+            recv & _listed(status, config) & any_member
             & (best32 > hb32 - shift_a[None])
         )
         upd = advance | add
@@ -763,7 +850,14 @@ def _membership_update(
         info = jnp.iinfo(hb.dtype)
         hb = jnp.clip(new32, info.min, info.max).astype(hb.dtype)
     age = jnp.where(upd, 0, age)
-    status = jnp.where(add, MEMBER, status)
+    if sus_on:
+        # REFUTATION: a fresher heartbeat observed while SUSPECT is
+        # SWIM's alive-message — the suspicion cancels and the entry
+        # rejoins the membership with a fresh stamp (the upd write above)
+        status = jnp.where(add | (advance & (status == SUSPECT)),
+                           MEMBER, status)
+    else:
+        status = jnp.where(add, MEMBER, status)
     age = jnp.minimum(age + 1, AGE_CLAMP).astype(jnp.int8)
     return hb, age, status
 
@@ -1008,7 +1102,8 @@ def _round_core(
     ctx: ShardCtx = LOCAL_CTX,
     matrix_events: bool = True,
     edge_filter=None,
-) -> tuple[SimState, RoundMetrics, jax.Array, jax.Array, jax.Array, jax.Array | None]:
+) -> tuple[SimState, RoundMetrics, jax.Array, jax.Array, jax.Array,
+           jax.Array | None, jax.Array | None]:
     """One round, layout- and shard-generic (state may be 2-D or blocked,
     square or a subject-axis shard).
 
@@ -1019,13 +1114,20 @@ def _round_core(
     (the ring mode, whose edges derive from the post-tick tables here).
 
     Returns (state, metrics, fail, any_fail [nloc], first_obs [nloc],
-    member_col [nloc] | None — see :func:`_merge`)."""
+    member_col [nloc] | None — see :func:`_merge`, any_suspect [nloc] |
+    None — suspicion runs only, feeds the ``first_suspect`` carry)."""
     n = state.n
+    sus_on = config.suspicion is not None
     state = _apply_events(state, events, config, ctx, matrix_events=matrix_events)
     active, refresher, colmax_est = _pre_tick(state, config, ctx)
+    pre_status = state.status if sus_on else None
     state, fail = _tick(state, config, ctx, active=active, refresher=refresher)
+    tick_status = state.status if sus_on else None
     if config.topology == "ring":
-        edges = topology.ring_edges_from_status(state.status.reshape(n, n))
+        edges = topology.ring_edges_from_status(
+            state.status.reshape(n, n),
+            include_suspects=config.suspicion is not None,
+        )
     assert edges is not None
     if edge_filter is not None:
         edges = edge_filter(edges)
@@ -1047,6 +1149,41 @@ def _round_core(
     )
     state = state._replace(round=state.round + 1)
 
+    sus_stats = None
+    any_sus = None
+    if sus_on:
+        # Suspicion observables, all off the three status snapshots the
+        # round already produced (pre-tick, post-tick, post-merge) — the
+        # suspicion lane runs XLA-only (suspicion/tensor.py gating), so
+        # these full-matrix reductions never touch the kernel fast path.
+        status_f, alive_f = state.status, state.alive
+        shp_f = status_f.shape
+        entered = (tick_status == SUSPECT) & (pre_status != SUSPECT)
+        # a refutation is evidence of life: a merge advance flipping a
+        # post-tick SUSPECT back to MEMBER.  Anchoring on tick_status
+        # (not pre_status) excludes the below-min_group refresher revert,
+        # which clears suspicion without any evidence — detection is
+        # disabled there in both modes, so nothing was "suppressed"
+        refuted = (tick_status == SUSPECT) & (status_f == MEMBER)
+        alive_col = _sj(alive_f, shp_f, ctx)
+        sus_stats = (
+            ctx.psum(jnp.sum(entered, dtype=jnp.int32)),
+            ctx.psum(jnp.sum(refuted, dtype=jnp.int32)),
+            ctx.psum(jnp.sum(refuted & alive_col, dtype=jnp.int32)),
+        )
+        any_sus = jnp.any(status_f == SUSPECT, axis=0).reshape(
+            _nsubj(shp_f))
+        if member_col is None:
+            # convergence must not count a SUSPECT holder as "dropped":
+            # the entry is still in the list pending refute/confirm
+            held = (
+                _listed(status_f, config)
+                & _rx(alive_f, status_f.ndim)
+                & ~_eye(n, shp_f, ctx)
+            )
+            member_col = jnp.sum(held.astype(jnp.int32), axis=0).reshape(
+                _nsubj(shp_f))
+
     # every fail-matrix statistic reduces over the SAME axis (receivers),
     # so XLA runs one column-reduce pass instead of several full-matrix
     # ones: per-subject detector counts + lowest firing observer, then
@@ -1061,8 +1198,8 @@ def _round_core(
     else:
         n_det = jnp.sum(fail, axis=0, dtype=jnp.int32).reshape(nloc)
         first_obs_now = jnp.argmax(fail, axis=0).astype(jnp.int32).reshape(nloc)
-    metrics, any_fail = _round_stats(n_det, state, ctx)
-    return state, metrics, fail, any_fail, first_obs_now, member_col
+    metrics, any_fail = _round_stats(n_det, state, ctx, sus_stats=sus_stats)
+    return state, metrics, fail, any_fail, first_obs_now, member_col, any_sus
 
 
 def _fused_ok(config: SimConfig, matrix_events: bool, n: int, nloc: int) -> bool:
@@ -1086,6 +1223,13 @@ def _fused_ok(config: SimConfig, matrix_events: bool, n: int, nloc: int) -> bool
         or matrix_events
         or config.remove_broadcast
         or config.topology == "ring"
+        # suspicion runs take the separate-pass round: the lifecycle's
+        # observables (suspects entered / refuted, the first-suspect
+        # carry) read the post-tick status snapshot the fused round
+        # exists to never materialize — one code path, pinned by the
+        # golden suspicion tests, beats a second fused variant on what
+        # is an XLA-only evaluation lane anyway
+        or config.suspicion is not None
     ):
         return False
     return not _use_pallas(config, config.fanout, n, nloc)
@@ -1188,7 +1332,7 @@ def _gossip_round_impl(
     blocked = _use_blocked(config, config.fanout, n)
     if blocked:
         state = _to_blocked(state, config)
-    state, metrics, _fail, any_fail, first_obs, _ = _round_core(
+    state, metrics, _fail, any_fail, first_obs, _, _ = _round_core(
         state, events, edges, config
     )
     if blocked:
@@ -1227,7 +1371,7 @@ def _gossip_round_scenario_impl(
     from gossipfs_tpu.scenarios.tensor import filter_edges
 
     ef = lambda e: filter_edges(tsc, e, state.round, key)  # noqa: E731
-    state, metrics, _fail, any_fail, first_obs, _ = _round_core(
+    state, metrics, _fail, any_fail, first_obs, _, _ = _round_core(
         state, events, edges, config, edge_filter=ef
     )
     return state, metrics, any_fail, first_obs
@@ -1247,22 +1391,40 @@ def _update_carry(
     round_idx: jax.Array,
     ctx: ShardCtx = LOCAL_CTX,
     member_col: jax.Array | None = None,
+    any_suspect: jax.Array | None = None,
 ) -> MetricsCarry:
     n = state.n
     # nloc from the per-subject vector, NOT the lane shape — the rr scan
     # carries its lanes in the stripe-major layout where shape[1:] is no
     # longer the subject count
     nloc = any_fail.shape[0]
-    first_detect, first_observer, converged = carry  # [nloc] — shard's slice
+    # [nloc] — shard's slice
+    first_detect, first_observer, converged, first_suspect = carry
     # rejoined = joins that actually took effect: new incarnation, new clock
     rejoined_l = ctx.slice_cols(rejoined, nloc)
     first_detect = jnp.where(rejoined_l, -1, first_detect)
     first_observer = jnp.where(rejoined_l, -1, first_observer)
     converged = jnp.where(rejoined_l, -1, converged)
+    first_suspect = jnp.where(rejoined_l, -1, first_suspect)
 
     fresh = (first_detect < 0) & any_fail
     first_observer = jnp.where(fresh, first_obs_now, first_observer)
     first_detect = jnp.where(fresh, round_idx, first_detect)
+    if any_suspect is not None:
+        # EPISODE semantics: once every observer's suspicion of j has
+        # cleared without a confirm (all refuted), the episode is over
+        # and the clock resets — otherwise a refuted pre-crash suspicion
+        # would make ttd_suspect negative and silently inflate the
+        # suspect-to-confirm latency with the healthy interval between
+        # episodes.  After a confirm (first_detect just set above, which
+        # is why this block runs after it) the stamp freezes: it names
+        # the episode that led to the detection.
+        first_suspect = jnp.where(
+            (first_detect < 0) & ~any_suspect, -1, first_suspect
+        )
+        first_suspect = jnp.where(
+            (first_suspect < 0) & any_suspect, round_idx, first_suspect
+        )
 
     alive_l = ctx.slice_cols(state.alive, nloc)
     if member_col is not None:
@@ -1279,7 +1441,7 @@ def _update_carry(
     converged = jnp.where((converged < 0) & all_dropped, round_idx, converged)
     return MetricsCarry(
         first_detect=first_detect, first_observer=first_observer,
-        converged=converged,
+        converged=converged, first_suspect=first_suspect,
     )
 
 
@@ -1697,8 +1859,10 @@ def _scan_rounds(
             st, metrics, member_col, any_fail, first_obs = _round_core_fused(
                 st, ev.crash | ev.leave, edges, config, ctx
             )
+            any_sus = None  # _fused_ok excludes suspicion runs
         else:
-            st, metrics, _fail, any_fail, first_obs, member_col = _round_core(
+            (st, metrics, _fail, any_fail, first_obs, member_col,
+             any_sus) = _round_core(
                 st, ev, edges, config, ctx, matrix_events=matrix_events,
                 edge_filter=ring_filter,
             )
@@ -1708,7 +1872,7 @@ def _scan_rounds(
         else:
             rejoined = jnp.zeros_like(st.alive)  # constant: resets fold away
         mc = _update_carry(mc, st, rejoined, any_fail, first_obs, round_idx, ctx,
-                           member_col=member_col)
+                           member_col=member_col, any_suspect=any_sus)
         return (st, mc), metrics
 
     if mcarry0 is None:

@@ -36,10 +36,20 @@ import jax.numpy as jnp
 
 from gossipfs_tpu.config import SimConfig
 
-# status lane values
+# status lane values (2 bits: the resident-round kernel packs status
+# beside age in one byte — ops/merge_pallas.pack_age_status)
 UNKNOWN = jnp.int8(0)   # j not in i's membership list
 MEMBER = jnp.int8(1)    # j in i's list (alive as far as i knows)
 FAILED = jnp.int8(2)    # j removed by i, still on the RecentFailList cooldown
+SUSPECT = jnp.int8(3)   # SWIM suspicion (config.suspicion, suspicion/):
+                        # j is in i's list but silent past t_fail — still a
+                        # member (gossiped, counted, placeable), pending
+                        # either refutation (a heartbeat advance -> MEMBER)
+                        # or confirmation (t_suspect more silent rounds ->
+                        # FAILED).  The suspect-start timestamp is carried
+                        # implicitly by the age lane (age - t_fail = rounds
+                        # in SUSPECT); only reachable when suspicion is
+                        # armed — the reference mode never writes it
 
 
 class SimState(NamedTuple):

@@ -25,7 +25,8 @@ import jax.numpy as jnp
 from gossipfs_tpu.config import SimConfig
 
 
-def ring_edges_from_status(status: jax.Array) -> jax.Array:
+def ring_edges_from_status(status: jax.Array,
+                           include_suspects: bool = False) -> jax.Array:
     """int32 [N, 3] — per-receiver ring in-edges over each node's *own* list.
 
     The reference recomputes its three push targets every heartbeat from its
@@ -37,15 +38,25 @@ def ring_edges_from_status(status: jax.Array) -> jax.Array:
     During transient list disagreement the inversion is approximate (a sender
     whose list differs from the receiver's may pick different targets).
 
+    ``include_suspects`` (suspicion runs, suspicion/): SUSPECT entries are
+    still list positions, so they stay ring push targets — the UDP engine
+    agrees by construction (its members dict holds suspects until the
+    confirm removes them).  Excluding them would make ring suspicion
+    self-reinforcing: a suspected neighbor would never be gossiped to
+    again, so no refutation could ever reach the suspecting side.
+
     Nodes with too few other members fall back to self-edges, which merge as
     no-ops (senders below min_group don't gossip anyway, slave.go:504-509).
     """
-    from gossipfs_tpu.core.state import MEMBER
+    from gossipfs_tpu.core.state import MEMBER, SUSPECT
 
     n = status.shape[0]
     i = jnp.arange(n, dtype=jnp.int32)[:, None]
     j = jnp.arange(n, dtype=jnp.int32)[None, :]
-    m = (status == MEMBER) & (j != i)
+    listed = status == MEMBER
+    if include_suspects:
+        listed = listed | (status == SUSPECT)
+    m = listed & (j != i)
     big = jnp.int32(n + 1)
     dn = jnp.where(m, (j - i) % n, big)
     next1 = jnp.argmin(dn, axis=1).astype(jnp.int32)
@@ -147,7 +158,9 @@ def in_edges(config: SimConfig, key: jax.Array, status: jax.Array) -> jax.Array:
     ``random`` yields explicit [N, F] edges.
     """
     if config.topology == "ring":
-        return ring_edges_from_status(status)
+        return ring_edges_from_status(
+            status, include_suspects=config.suspicion is not None
+        )
     if config.topology == "random_arc":
         if config.arc_align > 1:
             return random_arc_bases_aligned(

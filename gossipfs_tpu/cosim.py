@@ -108,6 +108,15 @@ class CoSim:
         # drift from the engine surfaces)
         return self.detector.scenario_status()
 
+    def suspicion_status(self) -> dict | None:
+        """Suspicion vitals (suspicion/) — the detector's document, same
+        one-producer rule as scenario_status; None when the detector has
+        no suspicion support or none is armed."""
+        det = self.detector
+        if hasattr(det, "suspicion_status"):
+            return det.suspicion_status()
+        return None
+
     def _reachable(self) -> set[int]:
         """Transport-level reachability from the control plane's seat.
 

@@ -203,6 +203,26 @@ class Cluster:
                 acked.append(idx)
         return acked
 
+    def load_suspicion(self, params) -> list[int]:
+        """Push one suspicion.SuspicionParams to every live node (the
+        deploy backend of the suspicion subsystem; None disarms).
+        Returns the node ids that acked."""
+        payload = ("" if params is None
+                   else base64.b64encode(params.to_json().encode()).decode())
+        acked = []
+        for idx, proc in self.procs.items():
+            if proc.poll() is not None:
+                continue
+            try:
+                ok = self.client(idx).call(
+                    "SuspicionLoad", file="suspicion", data_b64=payload
+                ).get("ok")
+            except Exception:
+                ok = False
+            if ok:
+                acked.append(idx)
+        return acked
+
     def scenario_status(self) -> list[dict]:
         """Collect every node's ScenarioStatus line (skipping dead nodes)."""
         lines: list[dict] = []

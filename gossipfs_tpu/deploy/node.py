@@ -72,6 +72,10 @@ class _Env:
         self.t_cooldown = t_cooldown
         self.min_group = min_group
         self.fresh_cooldown = True
+        # suspicion subsystem (suspicion/): SuspicionParams pushed over
+        # the control plane (SuspicionLoad RPC); the UdpNode reads this
+        # every tick, exactly like the in-process UdpCluster's attribute
+        self.suspicion = None
         self._daemon = daemon
 
     def record_detection(self, observer: int, subject_addr: str) -> None:
@@ -601,18 +605,54 @@ class NodeDaemon:
     def ScenarioStatus(self, req, ctx):
         """This node's view of the armed scenario (GrepReply lines).
 
-        Also carries the node's protocol-round tick counter and its
-        members' heartbeat counters — the per-node vitals an operator
-        (or a test) wants next to the fault state."""
+        Also carries the node's protocol-round tick counter, its members'
+        heartbeat counters, and — when suspicion is armed — the node's
+        suspicion vitals (live suspects, refutation/confirm totals): the
+        per-node state an operator (or a test) wants next to the fault
+        state, all riding the one status RPC."""
         rt = self._scn_runtime
         doc = {"node": self.idx, "armed": rt is not None,
                "rounds": self.udp.rounds,
                "tick_error": repr(self.udp.last_tick_error)
                if self.udp.last_tick_error else "",
                "hb": {a: m.hb for a, m in self.udp.members.items()}}
+        doc["suspicion_armed"] = self._env.suspicion is not None
+        if self.udp._sus is not None:
+            srt = self.udp._sus[1]
+            # the ONE vitals producer (SuspicionRuntime.status) so the
+            # fields cannot drift between engines; only `suspects` is
+            # remapped from addresses to node indices
+            sdoc = srt.status()
+            sdoc["suspects"] = sorted(
+                int(a.rsplit(":", 1)[1]) - self.udp_base
+                for a in srt.suspects
+            )
+            doc.update(sdoc)
         if rt is not None:
             doc.update(rt.status(self._scn_round()))
         return {"lines": [doc]}
+
+    def SuspicionLoad(self, req, ctx):
+        """Arm the suspicion lifecycle on THIS node (suspicion/params.py
+        JSON in ``data_b64``; empty payload disarms).  The launcher fans
+        the same params out to every node — the deploy backend of the
+        suspicion subsystem, riding the control plane like ScenarioLoad."""
+        from gossipfs_tpu.suspicion.params import SuspicionParams
+
+        payload = base64.b64decode(req.get("data_b64", "") or "")
+        if not payload:
+            self._env.suspicion = None
+            self.log("suspicion", "suspicion cleared")
+            return {"ok": True}
+        try:
+            params = SuspicionParams.from_json(payload.decode())
+        except (ValueError, KeyError) as e:
+            self.log("suspicion_error", repr(e))
+            return {"ok": False}
+        self._env.suspicion = params
+        self.log("suspicion", f"armed suspicion t_suspect={params.t_suspect}",
+                 t_suspect=params.t_suspect)
+        return {"ok": True}
 
     def UpdateFileVersion(self, req, ctx):
         """The writer's commit: the pushes landed, publish the placement."""
@@ -664,7 +704,7 @@ class NodeDaemon:
         "Get", "GetDeleteInfo", "DeleteFileData", "Delete", "Ls", "Store",
         "RemoteReput", "Vote", "AssignNewMaster", "AskForConfirmation",
         "UpdateFileVersion", "Lsm", "AliveNodes", "Grep", "ShowMetadata",
-        "ScenarioLoad", "ScenarioStatus",
+        "ScenarioLoad", "ScenarioStatus", "SuspicionLoad",
     )
 
     # -- lifecycle ---------------------------------------------------------

@@ -23,7 +23,13 @@ from gossipfs_tpu.core.rounds import (
     gossip_round_scenario,
     run_rounds,
 )
-from gossipfs_tpu.core.state import MEMBER, RoundEvents, SimState, init_state
+from gossipfs_tpu.core.state import (
+    MEMBER,
+    SUSPECT,
+    RoundEvents,
+    SimState,
+    init_state,
+)
 from gossipfs_tpu.detector.api import DetectionEvent
 from gossipfs_tpu.utils.snapshot import Snapshot, SnapshotBuffer
 
@@ -70,6 +76,11 @@ class SimDetector:
         self._scenario = None
         self._scn_tensor = None
         self._scn_config: SimConfig | None = None
+        # suspicion accounting (config.suspicion, suspicion/): cumulative
+        # lifecycle counters for the `suspicion status` surface — fed by
+        # the per-round RoundMetrics both advance paths already produce
+        self._sus_totals = {"suspects_entered": 0, "refutations": 0,
+                            "fp_suppressed": 0, "confirms": 0}
 
     # -- scenario engine ---------------------------------------------------
     def load_scenario(self, scenario) -> None:
@@ -174,14 +185,25 @@ class SimDetector:
                 # filter (scenarios/tensor.py).  No donate variant — the
                 # scenario path is the interactive/parity lane, not the
                 # capacity frontier
-                self.state, _, any_fail, first_obs = gossip_round_scenario(
-                    self.state, ev, edges, cfg, self._scn_tensor,
-                    jax.random.fold_in(k, 0x5CE),
+                self.state, metrics, any_fail, first_obs = (
+                    gossip_round_scenario(
+                        self.state, ev, edges, cfg, self._scn_tensor,
+                        jax.random.fold_in(k, 0x5CE),
+                    )
                 )
             else:
                 step = gossip_round_donate if self.donate else gossip_round
-                self.state, _, any_fail, first_obs = step(
+                self.state, metrics, any_fail, first_obs = step(
                     self.state, ev, edges, cfg
+                )
+            if self.config.suspicion is not None:
+                # suspicion is an interactive/evaluation lane (XLA-gated),
+                # so the extra scalar transfers per round are acceptable
+                self._accumulate_suspicion(
+                    int(metrics.suspects_entered), int(metrics.refutations),
+                    int(metrics.fp_suppressed),
+                    int(metrics.true_detections)
+                    + int(metrics.false_positives),
                 )
             if not bool(jnp.any(any_fail)):
                 # quiet round: one scalar transfer
@@ -205,6 +227,30 @@ class SimDetector:
                         false_positive=bool(alive[subj]),
                     )
                 )
+
+    def _accumulate_suspicion(self, entered: int, refuted: int,
+                              fp_sup: int, confirms: int) -> None:
+        t = self._sus_totals
+        t["suspects_entered"] += entered
+        t["refutations"] += refuted
+        t["fp_suppressed"] += fp_sup
+        t["confirms"] += confirms
+
+    def _accumulate_suspicion_bulk(self, per_round) -> None:
+        """Fold a scan's stacked RoundMetrics into the lifecycle totals.
+
+        Called from :meth:`_resolve_pending_bulk` — i.e. only once the
+        scan's results are being read anyway — so the blocking
+        np.asarray never serializes the bulk dispatch or the snapshot
+        pipeline's two-deep in-flight window.
+        """
+        self._accumulate_suspicion(
+            int(np.asarray(per_round.suspects_entered).sum()),
+            int(np.asarray(per_round.refutations).sum()),
+            int(np.asarray(per_round.fp_suppressed).sum()),
+            int(np.asarray(per_round.true_detections).sum())
+            + int(np.asarray(per_round.false_positives).sum()),
+        )
 
     def _mask(self, nodes: set[int]) -> jax.Array:
         m = np.zeros((self.config.n,), dtype=bool)
@@ -260,11 +306,13 @@ class SimDetector:
         events = self._first_round_events(rounds)
 
         if snapshot_every is None:
-            self.state, mcarry, _ = run_rounds(
+            self.state, mcarry, per_round = run_rounds(
                 self.state, self.config, rounds, self._key, events=events,
                 scenario=self._scn_tensor,
             )
-            self._pending_bulk.append((start_round, rounds, mcarry, self.state))
+            self._pending_bulk.append(
+                (start_round, rounds, mcarry, self.state, [per_round])
+            )
             return None
 
         if self._snap_buffer is None:
@@ -285,16 +333,18 @@ class SimDetector:
                 st = self.state
                 mcarry = None
                 prev: SimState | None = None
+                per_rounds = []  # folded lazily in _resolve_pending_bulk
                 for off, ln in chunks:
                     ev = RoundEvents(
                         crash=events.crash[off:off + ln],
                         leave=events.leave[off:off + ln],
                         join=events.join[off:off + ln],
                     )
-                    st, mcarry, _ = run_rounds(
+                    st, mcarry, per_round = run_rounds(
                         st, self.config, ln, self._key, events=ev,
                         mcarry0=mcarry, scenario=self._scn_tensor,
                     )
+                    per_rounds.append(per_round)
                     if prev is not None:
                         # blocks until the previous chunk lands — the current
                         # chunk is already queued behind it, so the device
@@ -303,7 +353,9 @@ class SimDetector:
                         self._publish(prev)
                     prev = st
                 self._publish(prev)
-                self._pending_bulk.append((start_round, rounds, mcarry, st))
+                self._pending_bulk.append(
+                    (start_round, rounds, mcarry, st, per_rounds)
+                )
             except BaseException as e:  # re-raised by the next _join_bulk
                 self._bulk_error = e
 
@@ -328,7 +380,10 @@ class SimDetector:
         reports the same first event per subject as the per-round path.
         """
         pending, self._pending_bulk = self._pending_bulk, []
-        for start, rounds, mcarry, state in pending:
+        for start, rounds, mcarry, state, per_rounds in pending:
+            if self.config.suspicion is not None:
+                for pr in per_rounds:
+                    self._accumulate_suspicion_bulk(pr)
             first = np.asarray(mcarry.first_detect)
             observer = np.asarray(mcarry.first_observer)
             alive = np.asarray(state.alive)
@@ -345,8 +400,43 @@ class SimDetector:
 
     # -- views -------------------------------------------------------------
     def membership(self, observer: int) -> list[int]:
+        # a SUSPECT entry is still in the list (pending refute/confirm)
+        # — the UDP engine's members dict naturally agrees, since its
+        # suspects are only removed at confirmation
         row = np.asarray(self.state.status[observer])
-        return [int(j) for j in np.nonzero(row == int(MEMBER))[0]]
+        return [
+            int(j)
+            for j in np.nonzero((row == int(MEMBER)) | (row == int(SUSPECT)))[0]
+        ]
+
+    def suspects(self, observer: int) -> list[int]:
+        """Entries the observer currently holds SUSPECT (suspicion runs;
+        empty in the reference mode — the lane value is unreachable)."""
+        row = np.asarray(self.state.status[observer])
+        return [int(j) for j in np.nonzero(row == int(SUSPECT))[0]]
+
+    def suspicion_status(self) -> dict | None:
+        """THE suspicion vitals document (CLI ``suspicion status``): per-
+        node live suspect counts plus the cumulative lifecycle totals.
+        None when suspicion is not armed."""
+        sus = self.config.suspicion
+        if sus is None:
+            return None
+        self._join_bulk()
+        self._resolve_pending_bulk()  # fold any finished scans' totals in
+        st = np.asarray(self.state.status)
+        alive = np.asarray(self.state.alive)
+        counts = ((st == int(SUSPECT)).sum(axis=1) * alive).astype(int)
+        return {
+            "enabled": True,
+            "t_suspect": sus.t_suspect,
+            "lh_multiplier": sus.lh_multiplier,
+            "suspect_counts": {
+                int(i): int(c) for i, c in enumerate(counts) if c
+            },
+            "suspects_now": int(counts.sum()),
+            **self._sus_totals,
+        }
 
     def alive_nodes(self) -> list[int]:
         return [int(j) for j in np.nonzero(np.asarray(self.state.alive))[0]]
@@ -480,6 +570,8 @@ class PackedDetector:
                     jnp.where(okc, -1, mc.first_observer[j])),
                 converged=mc.converged.at[j].set(
                     jnp.where(okc, -1, mc.converged[j])),
+                first_suspect=mc.first_suspect.at[j].set(
+                    jnp.where(okc, -1, mc.first_suspect[j])),
             )
             return hb4, as4, alive, hb_base, counts, mc, ok
 

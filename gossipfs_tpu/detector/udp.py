@@ -60,6 +60,23 @@ class UdpNode:
         # stalls exactly when the process is starved (unlike wall time).
         self.rounds = 0
         self.last_tick_error: Exception | None = None
+        # suspicion subsystem (suspicion/): per-node suspect table, armed
+        # when the cluster (or deploy _Env) carries SuspicionParams.
+        # (params, runtime) pair so a mid-run re-arm rebuilds the table
+        self._sus: tuple[object, object] | None = None
+        self._last_refute_t = float("-inf")  # rate-limits REFUTE broadcasts
+
+    def _suspicion(self):
+        """The armed SuspicionRuntime, tracking the host's params."""
+        params = getattr(self.cluster, "suspicion", None)
+        if params is None:
+            self._sus = None
+            return None
+        if self._sus is None or self._sus[0] is not params:
+            from gossipfs_tpu.suspicion.runtime import SuspicionRuntime
+
+            self._sus = (params, SuspicionRuntime(params))
+        return self._sus[1]
 
     # -- lifecycle ---------------------------------------------------------
     async def start(self) -> None:
@@ -127,8 +144,75 @@ class UdpNode:
                 self._add_member(arg)
             elif verb in ("LEAVE", "REMOVE"):
                 self._remove_member(arg)
+            elif verb == "SUSPECT":
+                self._on_suspect(arg)
+            elif verb == "REFUTE":
+                self._on_refute(arg)
         else:
             self._merge(self._decode(payload))
+
+    # -- suspicion wire verbs (SWIM suspect/refute, suspicion/) -------------
+    def _on_suspect(self, addr: str) -> None:
+        """A peer broadcast ``addr<CMD>SUSPECT``.
+
+        If the suspect is ME: refute by INCARNATION BUMP — advance my own
+        heartbeat counter past whatever the suspicion was based on and
+        broadcast a REFUTE carrying it (SWIM's alive message; the next
+        list pushes carry the bumped counter too).  Otherwise adopt the
+        suspicion: an observer whose OWN entry is already stale inherits
+        the earlier suspect-start and confirms sooner than its local
+        timer alone would.  An observer whose entry is still fresh
+        discards the adoption at its next tick — local freshness IS
+        refuting evidence (SWIM's alive-over-suspect rule), and honoring
+        a foreign timer across it would let a later staleness confirm
+        without serving any suspect window.
+        """
+        rt = self._suspicion()
+        if rt is None:
+            return
+        if addr == self.addr:
+            me = self.members.get(self.addr)
+            if me is None:
+                return
+            now = self._now()
+            if now - self._last_refute_t < self.cluster.period:
+                # k observers suspecting the same episode each broadcast
+                # SUSPECT to everyone, so k*(N-1) copies land here; one
+                # bump + one REFUTE broadcast per period answers the
+                # whole episode (SWIM refutes once per incarnation)
+                # instead of amplifying to O(k*N) datagrams
+                return
+            self._last_refute_t = now
+            me.hb += 1
+            me.ts = now
+            msg = f"{self.addr}{FIELD_SEP}{me.hb}{CMD_SEP}REFUTE"
+            for peer in list(self.members):
+                if peer != self.addr:
+                    self._send(peer, msg)
+        elif addr in self.members:
+            rt.adopt(addr, self._now())
+
+    def _on_refute(self, arg: str) -> None:
+        """``addr<#INFO#>hb<CMD>REFUTE``: the suspect's alive message.
+
+        Receiving it at all proves the sender was alive a datagram ago:
+        adopt the bumped incarnation, stamp fresh, and cancel any pending
+        suspicion.  A confirmed (fail-listed) entry is NOT resurrected —
+        the cooldown suppression wins, as it does for list gossip
+        (slave.go:430-439); the node rejoins through the introducer.
+        """
+        parts = arg.split(FIELD_SEP)
+        addr = parts[0]
+        hb = int(float(parts[1])) if len(parts) > 1 else 0
+        m = self.members.get(addr)
+        if m is None:
+            return
+        if hb > m.hb:
+            m.hb = hb
+        m.ts = self._now()
+        rt = self._suspicion()
+        if rt is not None:
+            rt.refute(addr)
 
     def _add_member(self, addr: str) -> None:
         """Introducer path: append + push full list to everyone
@@ -155,16 +239,25 @@ class UdpNode:
             self.fail_list[addr] = (
                 self._now() if self.cluster.fresh_cooldown else member.ts
             )
+        if self._sus is not None:
+            # removed for any reason (LEAVE, a peer's REMOVE): forget the
+            # pending suspicion (a confirm already popped it, uncounted)
+            self._sus[1].drop(addr)
 
     def _merge(self, remote: list[tuple[str, int]]) -> None:
         """Anti-entropy max-merge with local stamping (slave.go:414-440)."""
         now = self._now()
+        rt = self._sus[1] if self._sus is not None else None
         for addr, hb in remote:
             local = self.members.get(addr)
             if local is not None:
                 if hb > local.hb:
                     local.hb = hb
                     local.ts = now
+                    if rt is not None:
+                        # refute-by-advance: a fresher counter observed
+                        # while SUSPECT cancels the pending failure
+                        rt.refute(addr)
             elif addr not in self.fail_list:
                 self.members[addr] = _Member(hb, now)
 
@@ -197,19 +290,47 @@ class UdpNode:
         if me is not None:
             me.hb += 1
             me.ts = now
-        # detection (slave.go:460-482)
+        # detection (slave.go:460-482); with suspicion armed (suspicion/)
+        # a stale member passes through SUSPECT first: the first stale
+        # tick broadcasts SUSPECT (so the subject can actively refute by
+        # incarnation bump — see _on_suspect), and only t_suspect more
+        # periods of silence confirm the removal.  The confirm keeps the
+        # reference's REMOVE broadcast; a refresh before it (list gossip
+        # advance or a REFUTE) cancels the suspicion in _merge/_on_refute.
         t_fail = c.t_fail * c.period
+        rt = self._suspicion()
         for addr in list(self.members):
             if addr == self.addr:
                 continue
             m = self.members[addr]
-            if m.hb > 1 and m.ts < now - t_fail:
-                self._remove_member(addr)
-                c.record_detection(self.idx, addr)
-                msg = f"{addr}{CMD_SEP}REMOVE"
-                for peer in list(self.members):
-                    if peer != self.addr:
-                        self._send(peer, msg)
+            stale = m.hb > 1 and m.ts < now - t_fail
+            if not stale:
+                if rt is not None:
+                    # a genuinely-refuted suspicion was already popped
+                    # (and counted) by _merge/_on_refute when the fresh
+                    # evidence arrived; anything left here is a
+                    # peer-disseminated adoption for an entry that was
+                    # never stale locally — clear it WITHOUT counting a
+                    # refutation (no evidence-of-life event happened)
+                    rt.drop(addr)
+                continue
+            if rt is not None:
+                if rt.suspect(addr, now):
+                    msg = f"{addr}{CMD_SEP}SUSPECT"
+                    for peer in list(self.members):
+                        if peer != self.addr:
+                            self._send(peer, msg)
+                    continue
+                window = rt.t_suspect_window(c.period, len(self.members))
+                if not rt.expired(addr, now, window):
+                    continue
+                rt.confirm(addr)
+            self._remove_member(addr)
+            c.record_detection(self.idx, addr)
+            msg = f"{addr}{CMD_SEP}REMOVE"
+            for peer in list(self.members):
+                if peer != self.addr:
+                    self._send(peer, msg)
         # fail-list cooldown (slave.go:484-497)
         t_cool = c.t_cooldown * c.period
         for addr in list(self.fail_list):
@@ -241,6 +362,7 @@ class UdpCluster:
         min_group: int = 4,
         fresh_cooldown: bool = False,
         scenario=None,
+        suspicion=None,
     ):
         self.n = n
         self.period = period
@@ -248,6 +370,10 @@ class UdpCluster:
         self.t_cooldown = t_cooldown
         self.min_group = min_group
         self.fresh_cooldown = fresh_cooldown
+        # suspicion subsystem (suspicion/): SuspicionParams or None; the
+        # nodes read it every tick, so (dis)arming mid-run takes effect
+        # on their next heartbeat
+        self.suspicion = suspicion
         self.nodes = [UdpNode(self, i, base_port + i) for i in range(n)]
         self._addr_to_idx = {node.addr: i for i, node in enumerate(self.nodes)}
         self._events: list[DetectionEvent] = []
@@ -281,6 +407,56 @@ class UdpCluster:
         if self._scn_runtime is None:
             return None
         return self._scn_runtime.status(self._round - self._scn_round0)
+
+    # -- suspicion subsystem ------------------------------------------------
+    def load_suspicion(self, params) -> None:
+        """Arm a suspicion.SuspicionParams on every node (None disarms);
+        takes effect on each node's next heartbeat tick."""
+        self.suspicion = params
+
+    def clear_suspicion(self) -> None:
+        self.suspicion = None
+
+    def suspects(self, observer: int) -> list[int]:
+        """Node ids the observer currently holds SUSPECT."""
+        sus = self.nodes[observer]._sus
+        if sus is None:
+            return []
+        return sorted(
+            self._addr_to_idx[a] for a in sus[1].suspects
+            if a in self._addr_to_idx
+        )
+
+    def suspicion_status(self) -> dict | None:
+        """Cluster-wide suspicion vitals: per-node live suspect counts +
+        cumulative lifecycle totals — the tensor sim's document shape
+        (SimDetector.suspicion_status) minus ``fp_suppressed``, which
+        needs the ground-truth aliveness only the sim has per refute (a
+        consumer reading the real-socket engine must not mistake an
+        unknowable for a zero)."""
+        if self.suspicion is None:
+            return None
+        counts: dict[int, int] = {}
+        entered = refutations = confirms = 0
+        for i, node in enumerate(self.nodes):
+            if node._sus is None:
+                continue
+            rt = node._sus[1]
+            if node.alive and rt.suspects:
+                counts[i] = len(rt.suspects)
+            entered += rt.entered
+            refutations += rt.refutations
+            confirms += rt.confirms
+        return {
+            "enabled": True,
+            "t_suspect": self.suspicion.t_suspect,
+            "lh_multiplier": self.suspicion.lh_multiplier,
+            "suspect_counts": counts,
+            "suspects_now": sum(counts.values()),
+            "suspects_entered": entered,
+            "refutations": refutations,
+            "confirms": confirms,
+        }
 
     def message_allowed(self, src: int, peer_addr: str) -> bool:
         """The UdpNode._send hook: False = the armed scenario drops it."""
@@ -415,6 +591,19 @@ class UdpDetector:
 
     def scenario_status(self):
         return self._sync(self.cluster.scenario_status)
+
+    # -- suspicion subsystem (same thread discipline) -----------------------
+    def load_suspicion(self, params) -> None:
+        self._sync(self.cluster.load_suspicion, params)
+
+    def clear_suspicion(self) -> None:
+        self._sync(self.cluster.clear_suspicion)
+
+    def suspicion_status(self):
+        return self._sync(self.cluster.suspicion_status)
+
+    def suspects(self, observer: int) -> list[int]:
+        return self._sync(self.cluster.suspects, observer)
 
     def close(self) -> None:
         self._sync(self.cluster.stop_all)

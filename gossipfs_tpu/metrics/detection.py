@@ -27,7 +27,15 @@ from gossipfs_tpu.core.state import MEMBER, SimState
 
 @dataclasses.dataclass
 class DetectionReport:
-    """Summary of one simulation run's failure-detection behavior."""
+    """Summary of one simulation run's failure-detection behavior.
+
+    Suspicion-aware accounting (config.suspicion, suspicion/): under the
+    SWIM lifecycle ``true_detections``/``false_positives`` count SUSPECT
+    -> FAILED *confirmations*, and the suspicion fields below are live —
+    ``fp_suppressed`` is the headline: refutations of actually-alive
+    subjects, each one a false positive the plain crash-on-timeout
+    detector would have fired.  All zeros/empty in the reference mode.
+    """
 
     n: int
     rounds: int
@@ -38,6 +46,14 @@ class DetectionReport:
     false_positives: int
     false_positive_rate: float       # FP events / (alive-observer x subject x round)
     final_alive: int
+    suspects_entered: int = 0        # entries that entered SUSPECT
+    refutations: int = 0             # suspicions cancelled by a hb advance
+    fp_suppressed: int = 0           # refutations of actually-alive subjects
+    # per tracked crash: rounds from crash to first suspicion, and from
+    # first suspicion to the confirming detection (the suspect-to-confirm
+    # latency the lifecycle adds on top of t_fail)
+    ttd_suspect: dict[int, int] = dataclasses.field(default_factory=dict)
+    suspect_to_confirm: dict[int, int] = dataclasses.field(default_factory=dict)
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -61,6 +77,7 @@ def summarize(
     """
     first = np.asarray(carry.first_detect)
     conv = np.asarray(carry.converged)
+    first_sus = np.asarray(carry.first_suspect)
     tp = np.asarray(per_round.true_detections)
     fp = np.asarray(per_round.false_positives)
     n_alive = np.asarray(per_round.n_alive)
@@ -68,9 +85,14 @@ def summarize(
     n = first.shape[0] if n_effective is None else n_effective
 
     ttd_first, ttd_conv = {}, {}
+    ttd_sus, sus2conf = {}, {}
     for node, r0 in (crash_rounds or {}).items():
         ttd_first[node] = int(first[node] - r0) if first[node] >= 0 else -1
         ttd_conv[node] = int(conv[node] - r0) if conv[node] >= 0 else -1
+        if first_sus[node] >= 0:
+            ttd_sus[node] = int(first_sus[node] - r0)
+            if first[node] >= 0:
+                sus2conf[node] = int(first[node] - first_sus[node])
 
     # opportunities ~= sum over rounds of alive * (n - 1) observer-subject pairs
     opportunities = float(np.sum(n_alive.astype(np.int64)) * max(n - 1, 1))
@@ -83,6 +105,11 @@ def summarize(
         false_positives=int(fp.sum()),
         false_positive_rate=float(fp.sum()) / opportunities if opportunities else 0.0,
         final_alive=int(n_alive[-1]),
+        suspects_entered=int(np.asarray(per_round.suspects_entered).sum()),
+        refutations=int(np.asarray(per_round.refutations).sum()),
+        fp_suppressed=int(np.asarray(per_round.fp_suppressed).sum()),
+        ttd_suspect=ttd_sus,
+        suspect_to_confirm=sus2conf,
     )
 
 

@@ -116,8 +116,8 @@ def _sharded_runner(mesh, config, crash_rate, rejoin_rate, has_churn_ok,
         mesh=mesh,
         in_specs=(mat, mat, mat, rep, rep, P(AXIS), rep, rep, rep, rep, rep),
         out_specs=(mat, mat, mat, rep, rep, P(AXIS),
-                   rounds.MetricsCarry(P(AXIS), P(AXIS), P(AXIS)),
-                   rounds.RoundMetrics(rep, rep, rep)),
+                   rounds.MetricsCarry(P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+                   rounds.RoundMetrics(rep, rep, rep, rep, rep, rep)),
         **_SM_NOCHECK,
     )
     if donate:

@@ -47,7 +47,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
-from typing import Iterable, Sequence
+from typing import Sequence
 
 import numpy as np
 
@@ -70,12 +70,6 @@ def expand_selector(sel: Selector, n: int) -> tuple[int, ...]:
     if len(set(nodes)) != len(nodes):
         raise ValueError(f"duplicate node ids in selector: {sel}")
     return nodes
-
-
-def _mask(nodes: Iterable[int], n: int) -> np.ndarray:
-    m = np.zeros((n,), dtype=bool)
-    m[list(nodes)] = True
-    return m
 
 
 @dataclasses.dataclass(frozen=True)

@@ -17,6 +17,10 @@ Command surface matches README.md:8-29 plus fault/time controls the sim adds:
                                      needs --gossip-only — the broadcast
                                      modes aren't transport-filterable)
   scenario status | clear            armed-scenario state / disarm
+  suspicion status                   SWIM suspect/refute vitals (per-node
+                                     suspect counts, refutations, confirms
+                                     — needs --t-suspect); lsm marks a
+                                     SUSPECT entry with a trailing ?
   grep [--node <k>] <regex>          search the event log (MP1 legacy verb);
                                      --node scopes to one machine's log view
 
@@ -91,6 +95,13 @@ def make_parser() -> argparse.ArgumentParser:
              "be partition-filtered; scenarios/tensor.py)",
     )
     p.add_argument(
+        "--t-suspect", type=int, default=0,
+        help="arm the SWIM suspicion lifecycle (suspicion/): silent "
+             "members pass through a refutable SUSPECT state for this "
+             "many rounds before FAILED.  0 = off; needs --gossip-only "
+             "(the REMOVE broadcast would bypass the suspect window)",
+    )
+    p.add_argument(
         "--arc-align", type=int, default=1,
         help="with --packed: tile-aligned windowed-arc gossip (bases are "
              "multiples of this; fanout rounds up to a multiple) — the "
@@ -132,7 +143,20 @@ def dispatch(
         elif cmd == "crash":
             sim.detector.crash(int(args[0]))
         elif cmd == "lsm":
-            print(sim.detector.membership(int(args[0])), file=out)
+            obs = int(args[0])
+            members = sim.detector.membership(obs)
+            suspects: set[int] = set()
+            if getattr(sim.config, "suspicion", None) is not None and \
+                    hasattr(sim.detector, "suspects"):
+                suspects = set(sim.detector.suspects(obs))
+            if suspects:
+                # SUSPECT entries render distinctly: still members, but
+                # pending refute/confirm (suspicion/)
+                print("[" + ", ".join(
+                    f"{j}?" if j in suspects else str(j) for j in members
+                ) + "]", file=out)
+            else:
+                print(members, file=out)
         elif cmd == "IP":
             print(sim.detector.alive_nodes(), file=out)
         elif cmd == "advance":
@@ -192,6 +216,29 @@ def dispatch(
             else:
                 print(f"unknown scenario verb: {sub} "
                       "(load <file.json> | status | clear)", file=out)
+        elif cmd == "suspicion":
+            sub = args[0] if args else "status"
+            if sub == "status":
+                st = sim.suspicion_status()
+                if st is None:
+                    print("no suspicion armed (start with --t-suspect N)",
+                          file=out)
+                else:
+                    counts = st.get("suspect_counts") or {}
+                    per = ", ".join(f"{i}:{c}" for i, c in sorted(counts.items()))
+                    # fp_suppressed needs ground-truth aliveness: the
+                    # socket engines omit it — render the unknowable as
+                    # n/a, never as a measured zero
+                    fps = st.get("fp_suppressed")
+                    print(f"suspicion t_suspect={st['t_suspect']}: "
+                          f"{st.get('suspects_now', 0)} suspect entries now"
+                          f"{' (' + per + ')' if per else ''}; "
+                          f"refutations={st.get('refutations', 0)} "
+                          f"confirms={st.get('confirms', 0)} "
+                          f"fp_suppressed={'n/a' if fps is None else fps}",
+                          file=out)
+            else:
+                print(f"unknown suspicion verb: {sub} (status)", file=out)
         elif cmd == "grep":
             # ``grep [--node <k>] [--] <pattern>``: the explicit flag
             # scopes the search to node k's own log view (distributed-grep
@@ -241,6 +288,17 @@ def main(argv=None) -> None:
                 extra = dict(remove_broadcast=False, fresh_cooldown=True)
             cfg = SimConfig(n=args.n, topology=args.topology,
                             fanout=args.fanout, **extra)
+        if args.t_suspect > 0:
+            if args.packed:
+                parser.error("--t-suspect is unsupported in --packed mode "
+                             "(the rr kernel is the suspicion-free fast "
+                             "path; suspicion/tensor.py)")
+            from gossipfs_tpu.suspicion import (
+                SuspicionParams,
+                with_suspicion,
+            )
+
+            cfg = with_suspicion(cfg, SuspicionParams(t_suspect=args.t_suspect))
     except ValueError as e:
         parser.error(str(e))
     detector = None

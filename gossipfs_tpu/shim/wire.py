@@ -67,6 +67,11 @@ METHOD_TYPES: dict[str, tuple] = {
     # extension-verbs comment for the promotion path).
     "ScenarioLoad": (pb.PutRequest, pb.OkReply),
     "ScenarioStatus": (pb.Empty, pb.GrepReply),
+    # suspicion subsystem (deploy backend): SuspicionParams JSON rides
+    # PutRequest.data_b64 the same way a scenario rule table does (empty
+    # payload disarms); per-node suspicion vitals ride ScenarioStatus's
+    # Struct lines — no new reply shape needed
+    "SuspicionLoad": (pb.PutRequest, pb.OkReply),
 }
 
 

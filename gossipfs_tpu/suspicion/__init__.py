@@ -1,0 +1,43 @@
+"""Suspicion subsystem: SWIM-style suspect/refute lifecycle + Lifeguard
+adaptive timeouts.
+
+One :class:`~gossipfs_tpu.suspicion.params.SuspicionParams` policy
+drives all three transport engines — the tensor sim (the ALIVE ->
+SUSPECT -> FAILED transitions fused into the XLA round,
+``SimConfig.suspicion``), the asyncio UDP engine (real SUSPECT/REFUTE
+wire verbs with incarnation-bump refutation), and the per-process
+deployment (params pushed over the control plane via the
+``SuspicionLoad`` RPC).  See ``suspicion/params.py`` for the schema and
+timer semantics; ``suspicion/runtime.py`` is the per-node reference
+implementation the socket engines share.
+
+The tensor gating helpers resolve LAZILY (module ``__getattr__``), same
+pattern as ``scenarios/``: ``params``/``runtime`` are pure-Python and
+the deploy daemons — a documented jax-free path that must start in
+milliseconds — import them via this package from their ``SuspicionLoad``
+RPC.  An eager ``tensor`` import here would pull the config module (and
+with it the jax-adjacent stack) into every daemon the moment suspicion
+arms.
+"""
+
+from gossipfs_tpu.suspicion.params import SuspicionParams
+from gossipfs_tpu.suspicion.runtime import SuspicionRuntime
+
+_TENSOR_EXPORTS = (
+    "require_suspicion_config",
+    "with_suspicion",
+)
+
+__all__ = [
+    "SuspicionParams",
+    "SuspicionRuntime",
+    *_TENSOR_EXPORTS,
+]
+
+
+def __getattr__(name: str):
+    if name in _TENSOR_EXPORTS:
+        from gossipfs_tpu.suspicion import tensor
+
+        return getattr(tensor, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

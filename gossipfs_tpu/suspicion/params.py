@@ -1,0 +1,121 @@
+"""Suspicion subsystem schema: SWIM suspect/refute lifecycle parameters.
+
+The reference detector is pure crash-on-timeout (slave/slave.go:24,470):
+``t_fail`` rounds of silence and the entry is declared FAILED.  The
+BASELINE detection-quality curves show the limit of that single knob —
+FPR grows monotonically with N, and ``--t-fail-sweep`` shows t_fail=3
+collapsing into a false-positive storm, so faster detection is
+unreachable by turning it.  SWIM (Das et al., DSN 2002) interposes an
+intermediate SUSPECT state: a silent member is *suspected* first, and
+only confirmed FAILED after ``t_suspect`` further rounds of silence; any
+fresher heartbeat (an incarnation bump, in SWIM's terms) observed in the
+meantime *refutes* the suspicion and the entry rejoins the membership
+unharmed.  Lifeguard (Dadgar et al., 2018) adds local health awareness:
+a node that sees evidence it is itself degraded — here, an anomalous
+fraction of its entries simultaneously SUSPECT, the signal a starved or
+cut-off receiver produces — stretches its own confirmation timeout
+instead of storming.
+
+:class:`SuspicionParams` is the one typed schema all three transport
+engines consume (mirroring ``scenarios/schedule.py``):
+
+  * tensor sim — the suspect/confirm/refute transitions fused into the
+    XLA round (``core/rounds.py``; ``SimConfig.suspicion``, which gates
+    the run onto the XLA merge path exactly like scenario runs);
+  * asyncio UDP — real ``SUSPECT``/``REFUTE`` wire verbs with an
+    incarnation (heartbeat) bump (``detector/udp.py``);
+  * per-process deploy — the same params pushed over the control plane
+    (``SuspicionLoad`` RPC, like ``ScenarioLoad``).
+
+Timer semantics, identical everywhere (``suspicion/runtime.py`` is the
+per-message reference implementation): the suspicion clock runs on entry
+*staleness* — an entry is suspected once it has been silent more than
+``t_fail`` rounds, and confirmed once silent more than
+``t_fail + t_suspect * (1 + lh)`` rounds, where ``lh`` is the local
+health multiplier (0 unless the observer is degraded).  In the tensor
+engine the per-entry ``age`` lane carries the suspect-start timestamp
+implicitly (``age - t_fail`` = rounds in SUSPECT), so no new [N, N]
+lane is needed.  A refutation is any heartbeat advance observed while
+SUSPECT; the UDP engine additionally carries SWIM's *active* refutation
+— a suspected node that learns of its suspicion bumps its own counter
+and broadcasts a REFUTE.
+
+Jax-free on purpose: the deploy daemons (a documented jax-free path)
+load this module from their ``SuspicionLoad`` RPC.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+
+@dataclasses.dataclass(frozen=True)
+class SuspicionParams:
+    """One suspicion policy (frozen + hashable: it rides ``SimConfig``).
+
+    ``t_suspect``: rounds an entry stays SUSPECT before confirmation —
+    total silence before FAILED is ``t_fail + t_suspect`` (times the
+    local-health stretch, below).
+
+    ``lh_multiplier`` (optional Lifeguard local health, 0 = off): when a
+    node's *own* view holds more than ``lh_frac`` of its listed peers
+    simultaneously SUSPECT — evidence the node itself is degraded (a
+    healthy node never legitimately suspects a quarter of the cluster at
+    once) — its confirmation window stretches to
+    ``t_fail + t_suspect * (1 + lh_multiplier)``.  The signal is
+    memoryless (recomputed each round from the live suspect fraction),
+    which keeps it a cheap [N]-vector compare in the tensor engine.
+
+    ``lh_frac``: the degradation threshold as a fraction of the node's
+    listed (MEMBER + SUSPECT) peers.  Use exact binary fractions (0.25,
+    0.125) so the float compare agrees bit-for-bit between the tensor
+    engine (float32) and the per-node reference model (float64).
+    """
+
+    t_suspect: int = 2
+    lh_multiplier: int = 0
+    lh_frac: float = 0.25
+
+    def __post_init__(self) -> None:
+        if self.t_suspect < 1:
+            raise ValueError(f"t_suspect must be >= 1, got {self.t_suspect}")
+        if self.lh_multiplier < 0:
+            raise ValueError(
+                f"lh_multiplier must be >= 0, got {self.lh_multiplier}"
+            )
+        if not 0.0 < self.lh_frac < 1.0:
+            raise ValueError(f"lh_frac must be in (0, 1), got {self.lh_frac}")
+
+    # -- derived thresholds --------------------------------------------------
+    def confirm_after(self, t_fail: int, degraded: bool = False) -> int:
+        """Rounds of total silence before SUSPECT confirms to FAILED."""
+        mult = 1 + (self.lh_multiplier if degraded else 0)
+        return t_fail + self.t_suspect * mult
+
+    def max_confirm_after(self, t_fail: int) -> int:
+        """The worst-case confirmation age (full local-health stretch) —
+        what the age lane's saturation clamp must stay above."""
+        return self.confirm_after(t_fail, degraded=True)
+
+    # -- JSON codec (the control-plane wire form, like FaultScenario's) ------
+    def to_json(self) -> str:
+        return json.dumps({
+            "t_suspect": self.t_suspect,
+            "lh_multiplier": self.lh_multiplier,
+            "lh_frac": self.lh_frac,
+        }, indent=2)
+
+    @classmethod
+    def from_json(cls, text: str) -> "SuspicionParams":
+        doc = json.loads(text)
+        return cls(
+            t_suspect=int(doc["t_suspect"]),
+            lh_multiplier=int(doc.get("lh_multiplier", 0)),
+            lh_frac=float(doc.get("lh_frac", 0.25)),
+        )
+
+    @classmethod
+    def from_file(cls, path: str) -> "SuspicionParams":
+        with open(path) as f:
+            return cls.from_json(f.read())

@@ -1,0 +1,69 @@
+"""Tensor-sim gating for the suspicion subsystem.
+
+Suspicion rides the config, not a side table: ``SimConfig.suspicion``
+holds a :class:`~gossipfs_tpu.suspicion.params.SuspicionParams` and the
+round kernel (core/rounds.py) branches on it at trace time.  What this
+module owns is the ENGINE GATING — the same rules the scenario engine
+established (scenarios/tensor.py), because the fast kernels fuse the
+protocol over semantics suspicion changes:
+
+  * the rr/pallas merge kernels run the MEMBER-only tick/epilogue
+    in-kernel — they know nothing of the SUSPECT lane value, the
+    widened view eligibility, or the refute-on-advance status write.
+    Suspicion runs therefore execute the XLA merge path
+    (``merge_kernel="xla"``); rr/pallas stays the suspicion-free fast
+    path (documented in config.py's ``merge_kernel`` notes);
+  * the SWAR packed-word elementwise formulation (ops/swar.py) encodes
+    the 3-state status machine in its word constants — suspicion runs
+    use ``elementwise="lanes"``;
+  * ``remove_broadcast`` must be off: an instantaneous cluster-wide
+    REMOVE would bypass the per-observer SUSPECT window entirely
+    (gossip-only dissemination is the mode the lifecycle is defined
+    for, and it needs ``fresh_cooldown`` as ever).
+
+``SimConfig.__post_init__`` enforces all of this at construction, so a
+fast-kernel config with suspicion is unconstructible; :func:`with_suspicion`
+is the convenience that maps any gossip-only config onto its suspicion-run
+form — the ``xla_fallback_config`` analog for this subsystem.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from gossipfs_tpu.config import SimConfig
+from gossipfs_tpu.suspicion.params import SuspicionParams
+
+
+def require_suspicion_config(config: SimConfig) -> None:
+    """Reject protocol modes the SWIM lifecycle cannot compose with."""
+    if config.remove_broadcast:
+        raise ValueError(
+            "suspicion requires remove_broadcast=False: the sim's REMOVE "
+            "broadcast is an instantaneous tensor column-OR that would "
+            "confirm a failure cluster-wide before any observer's SUSPECT "
+            "window could refute it (use gossip-only dissemination + "
+            "fresh_cooldown, the north-star mode)"
+        )
+    if not config.fresh_cooldown:
+        raise ValueError(
+            "suspicion requires fresh_cooldown=True: gossip-only "
+            "dissemination with the faithful stale-timestamp fail list "
+            "gives confirmed removals a ~zero cooldown and zombie re-add "
+            "cycles (config.py fresh_cooldown notes), which would "
+            "re-suspect the same corpse forever"
+        )
+
+
+def with_suspicion(config: SimConfig, params: SuspicionParams) -> SimConfig:
+    """The config a suspicion run actually executes: same protocol
+    thresholds/dtypes/topology, suspicion armed, XLA merge + lanes
+    elementwise substituted (the scenario engine's fallback pattern —
+    fault-free transport stays on the fast kernels)."""
+    require_suspicion_config(config)
+    rep: dict = {"suspicion": params}
+    if config.merge_kernel != "xla":
+        rep["merge_kernel"] = "xla"
+    if config.elementwise != "lanes":
+        rep["elementwise"] = "lanes"
+    return dataclasses.replace(config, **rep)

@@ -58,3 +58,11 @@ def pytest_configure(config):
         "additionally marked slow.  `pytest -m scenario` runs just this "
         "subsystem.",
     )
+    config.addinivalue_line(
+        "markers",
+        "suspicion: suspicion-subsystem coverage (gossipfs_tpu/suspicion/ "
+        "— SWIM suspect/refute lifecycle + Lifeguard adaptive timeouts "
+        "across the three transport engines).  Fast-lane cases ride "
+        "tier-1; the deploy variant is additionally marked slow.  "
+        "`pytest -m suspicion` runs just this subsystem.",
+    )

@@ -26,7 +26,7 @@ import dataclasses
 
 from gossipfs_tpu.config import AGE_CLAMP
 
-UNKNOWN, MEMBER, FAILED = 0, 1, 2
+UNKNOWN, MEMBER, FAILED, SUSPECT = 0, 1, 2, 3
 
 
 @dataclasses.dataclass
@@ -55,14 +55,27 @@ class NaiveSim:
         self.fail_events = []  # list of (round, observer, subject)
 
     # -- helpers -----------------------------------------------------------
+    def _listed(self, e):
+        """In the membership list: MEMBER, or (suspicion armed) SUSPECT —
+        a suspect is still a member pending refute/confirm."""
+        if getattr(self.cfg, "suspicion", None) is None:
+            return e.status == MEMBER
+        return e.status in (MEMBER, SUSPECT)
+
     def _member_count(self, i):
-        return sum(1 for e in self.tables[i] if e.status == MEMBER)
+        return sum(1 for e in self.tables[i] if self._listed(e))
 
     def _ring_in_edges(self, i):
-        """Receiver-side ring inversion over i's own table, cyclic id order."""
+        """Receiver-side ring inversion over i's own table, cyclic id order.
+
+        Suspicion: SUSPECT entries stay ring push targets (still list
+        positions — core/topology.ring_edges_from_status agrees);
+        excluding them would make ring suspicion self-reinforcing.
+        """
         n = self.cfg.n
         members = [
-            j for j in range(n) if j != i and self.tables[i][j].status == MEMBER
+            j for j in range(n)
+            if j != i and self._listed(self.tables[i][j])
         ]
         if not members:
             return [i, i, i]
@@ -81,7 +94,7 @@ class NaiveSim:
             if not self.alive[j]:
                 continue
             for i in range(n):
-                if self.alive[i] and self.tables[i][j].status == MEMBER:
+                if self.alive[i] and self._listed(self.tables[i][j]):
                     # faithful mode: fail-list entry keeps its stale timestamp
                     self.tables[i][j].status = FAILED
                     if self.cfg.fresh_cooldown:
@@ -114,33 +127,68 @@ class NaiveSim:
         prediag = [self.tables[j][j].hb for j in range(n)]
 
         # tick
+        sus = getattr(cfg, "suspicion", None)
         active = [False] * n
         fails = []
         for i in range(n):
             if not self.alive[i]:
                 continue
             if self._member_count(i) < cfg.min_group:
+                # below min_group detection is disabled, so suspicion is
+                # moot: refresh the stamp and revert SUSPECT -> MEMBER
                 for e in self.tables[i]:
-                    if e.status == MEMBER:
+                    if self._listed(e):
                         e.age = 0
+                        if e.status == SUSPECT:
+                            e.status = MEMBER
                 continue
             active[i] = True
+            # Lifeguard local health (pre-tick counts, like the tensor's
+            # status0 anchor): an anomalous SUSPECT fraction in MY OWN
+            # view stretches MY confirmation window
+            confirm_thr = None
+            if sus is not None:
+                listed_cnt = self._member_count(i)
+                sus_cnt = sum(
+                    1 for e in self.tables[i] if e.status == SUSPECT
+                )
+                degraded = (sus.lh_multiplier > 0
+                            and sus_cnt > sus.lh_frac * listed_cnt)
+                confirm_thr = sus.confirm_after(cfg.t_fail, degraded)
             me = self.tables[i][i]
             if me.status == MEMBER:  # no self entry -> no bump (slave.go:443-448)
                 me.hb += 1
                 me.age = 0
             for j in range(n):
+                if j == i:
+                    continue
                 e = self.tables[i][j]
-                if (
-                    j != i
-                    and e.status == MEMBER
-                    and e.hb > cfg.hb_grace
-                    and e.age > cfg.t_fail
-                ):
-                    e.status = FAILED
-                    if cfg.fresh_cooldown:
-                        e.age = 0
-                    fails.append((i, j))
+                if sus is None:
+                    if (
+                        e.status == MEMBER
+                        and e.hb > cfg.hb_grace
+                        and e.age > cfg.t_fail
+                    ):
+                        e.status = FAILED
+                        if cfg.fresh_cooldown:
+                            e.age = 0
+                        fails.append((i, j))
+                else:
+                    # SWIM lifecycle: MEMBER -> SUSPECT at t_fail silent
+                    # rounds; SUSPECT -> FAILED (the detection event) at
+                    # confirm_thr; both judged on the entry's pre-write
+                    # status, so an entry spends >= 1 round SUSPECT
+                    if e.status == SUSPECT and e.age > confirm_thr:
+                        e.status = FAILED
+                        if cfg.fresh_cooldown:
+                            e.age = 0
+                        fails.append((i, j))
+                    elif (
+                        e.status == MEMBER
+                        and e.hb > cfg.hb_grace
+                        and e.age > cfg.t_fail
+                    ):
+                        e.status = SUSPECT
         self.fail_events.extend((self.round, i, j) for i, j in fails)
         if cfg.remove_broadcast:
             removed = {j for _, j in fails}
@@ -172,7 +220,10 @@ class NaiveSim:
                     continue
                 for j in range(n):
                     se = snapshot[k][j]
-                    if se.status != MEMBER:
+                    if not self._listed(se):
+                        # suspicion: a sender's SUSPECT entries keep
+                        # gossiping (still list entries; the receiver's
+                        # strict max-merge makes stale copies harmless)
                         continue
                     # window rule: gossip carries values in
                     # [view_base, view_base + window], the view's exact
@@ -182,9 +233,12 @@ class NaiveSim:
                     if se.hb < vb or se.hb > vb + cfg.rebase_window:
                         continue
                     e = self.tables[i][j]
-                    if e.status == MEMBER and se.hb > e.hb:
+                    if self._listed(e) and se.hb > e.hb:
+                        # REFUTATION: a fresher counter observed while
+                        # SUSPECT cancels the pending failure
                         e.hb = se.hb
                         e.age = 0
+                        e.status = MEMBER
                     elif e.status == UNKNOWN:
                         self.tables[i][j] = Entry(se.hb, 0, MEMBER)
 

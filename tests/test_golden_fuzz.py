@@ -21,6 +21,7 @@ from gossipfs_tpu.config import SimConfig
 from gossipfs_tpu.core.rounds import gossip_round
 from gossipfs_tpu.core.state import RoundEvents, init_state
 from gossipfs_tpu.core import topology
+from gossipfs_tpu.suspicion import SuspicionParams
 from reference_model import NaiveSim
 
 # randomized 24-config x 200-round sweep with O(N^2) Python comparisons (~16 min); test_golden_parity covers the same oracle deterministically in the fast lane
@@ -128,6 +129,21 @@ CONFIGS = [
                                       fresh_cooldown=True,
                                       hb_dtype="int8", view_dtype="int8",
                                       elementwise="swar"), True),
+    # the suspicion subsystem's XLA lifecycle (SimConfig.suspicion,
+    # suspicion/) against the same per-node oracle: crash/leave/join
+    # storms drive the SUSPECT/confirm/refute transitions — including
+    # rejoin-while-SUSPECT (the old incarnation's copy must confirm and
+    # cool down, never refute off the fresh incarnation's counter) and
+    # the Lifeguard local-health stretch under mass suspicion
+    ("sus-ring-i32", dict(n=24, remove_broadcast=False, fresh_cooldown=True,
+                          suspicion=SuspicionParams(t_suspect=2)), False),
+    ("sus-rand-i16-v8-introkill", dict(n=32, topology="random", fanout=5,
+                                       remove_broadcast=False,
+                                       fresh_cooldown=True,
+                                       hb_dtype="int16", view_dtype="int8",
+                                       suspicion=SuspicionParams(
+                                           t_suspect=3, lh_multiplier=2,
+                                           lh_frac=0.25)), True),
 ]
 
 

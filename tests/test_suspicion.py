@@ -1,0 +1,536 @@
+"""Suspicion subsystem: SWIM suspect/refute lifecycle + Lifeguard
+adaptive timeouts across the three transport engines
+(gossipfs_tpu/suspicion/ — see ISSUE/BASELINE "Suspicion").
+
+Fast lane: params schema + config gating, the tensor lifecycle
+(crash -> SUSPECT -> FAILED with the t_suspect window; blackout ->
+SUSPECT -> refuted with zero false positives), deterministic
+tensor-vs-oracle parity (including local health), sim-vs-UDP engine
+parity on the same scenario file (confirm and refute cases), the CLI
+verbs, and a tier-1 smoke.  Slow lane: the per-process deploy variant
+(params pushed over the control plane, vitals riding ScenarioStatus).
+"""
+
+import asyncio
+import io
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gossipfs_tpu.config import SimConfig
+from gossipfs_tpu.core.state import SUSPECT, RoundEvents, init_state
+from gossipfs_tpu.scenarios import FaultScenario, LinkFault, split_halves
+from gossipfs_tpu.suspicion import (
+    SuspicionParams,
+    SuspicionRuntime,
+    require_suspicion_config,
+    with_suspicion,
+)
+
+pytestmark = pytest.mark.suspicion
+
+
+def sus_cfg(n: int, t_fail: int = 3, t_suspect: int = 3, **over) -> SimConfig:
+    kw = dict(
+        n=n, topology="random", fanout=SimConfig.log_fanout(n),
+        remove_broadcast=False, fresh_cooldown=True, t_cooldown=6,
+        t_fail=t_fail,
+    )
+    kw.update(over)
+    return with_suspicion(SimConfig(**kw), SuspicionParams(t_suspect=t_suspect))
+
+
+def crash_events(n: int, rounds: int, node: int, at: int) -> RoundEvents:
+    crash = np.zeros((rounds, n), dtype=bool)
+    crash[at, node] = True
+    z = jnp.zeros((rounds, n), dtype=bool)
+    return RoundEvents(crash=jnp.asarray(crash), leave=z, join=z)
+
+
+# ---------------------------------------------------------------------------
+# schema + gating
+# ---------------------------------------------------------------------------
+
+
+class TestParams:
+    def test_json_roundtrip_and_validation(self):
+        p = SuspicionParams(t_suspect=4, lh_multiplier=2, lh_frac=0.125)
+        assert SuspicionParams.from_json(p.to_json()) == p
+        assert p.confirm_after(5) == 9
+        assert p.confirm_after(5, degraded=True) == 17
+        assert p.max_confirm_after(5) == 17
+        with pytest.raises(ValueError, match="t_suspect"):
+            SuspicionParams(t_suspect=0)
+        with pytest.raises(ValueError, match="lh_frac"):
+            SuspicionParams(lh_frac=1.5)
+
+    def test_config_gating(self):
+        # broadcast mode: the REMOVE column-OR would bypass the window
+        with pytest.raises(ValueError, match="remove_broadcast"):
+            require_suspicion_config(SimConfig(n=16))
+        with pytest.raises(ValueError, match="gossip-only"):
+            SimConfig(n=16, suspicion=SuspicionParams())
+        # fast kernels are the suspicion-free path: unconstructible
+        with pytest.raises(ValueError, match="merge_kernel"):
+            SimConfig(n=2048, topology="random", fanout=11,
+                      remove_broadcast=False, fresh_cooldown=True,
+                      merge_kernel="pallas", view_dtype="int8",
+                      hb_dtype="int16", suspicion=SuspicionParams())
+        with pytest.raises(ValueError, match="elementwise"):
+            SimConfig(n=1024, topology="random", fanout=10,
+                      remove_broadcast=False, fresh_cooldown=True,
+                      hb_dtype="int8", view_dtype="int8",
+                      elementwise="swar", suspicion=SuspicionParams())
+        # the age lane carries the suspicion clock: it must not saturate
+        with pytest.raises(ValueError, match="AGE_CLAMP"):
+            SimConfig(n=64, topology="random", fanout=6,
+                      remove_broadcast=False, fresh_cooldown=True,
+                      t_fail=30, t_cooldown=12,
+                      suspicion=SuspicionParams(t_suspect=40))
+
+    def test_with_suspicion_substitutes_fast_kernels(self):
+        fast = SimConfig(n=2048, topology="random", fanout=11,
+                         remove_broadcast=False, fresh_cooldown=True,
+                         merge_kernel="pallas", view_dtype="int8",
+                         hb_dtype="int16", merge_block_c=1024)
+        cfg = with_suspicion(fast, SuspicionParams(t_suspect=2))
+        assert cfg.merge_kernel == "xla"
+        assert cfg.suspicion == SuspicionParams(t_suspect=2)
+        assert (cfg.t_fail, cfg.hb_dtype, cfg.view_dtype) == (
+            fast.t_fail, fast.hb_dtype, fast.view_dtype)
+
+    def test_runtime_lifecycle(self):
+        rt = SuspicionRuntime(SuspicionParams(t_suspect=2, lh_multiplier=3,
+                                              lh_frac=0.25))
+        assert rt.suspect("a", 10.0) and not rt.suspect("a", 11.0)
+        assert not rt.expired("a", 11.9, 2.0)
+        assert rt.expired("a", 12.1, 2.0)
+        assert rt.refute("a") and not rt.refute("a")
+        rt.suspect("b", 0.0)
+        rt.confirm("b")
+        assert rt.refutations == 1 and rt.confirms == 1
+        # local health: 1 suspect of 2 listed > 0.25 -> window stretches
+        rt.suspect("c", 0.0)
+        assert rt.degraded(2) and rt.t_suspect_window(1.0, 2) == 8.0
+        assert not rt.degraded(8)
+        st = rt.status()
+        assert st["suspects"] == ["c"] and st["refutations"] == 1
+
+
+# ---------------------------------------------------------------------------
+# tensor engine lifecycle (the fast-lane tier-1 smoke lives here too)
+# ---------------------------------------------------------------------------
+
+
+class TestTensorLifecycle:
+    def test_crash_suspect_then_confirm(self):
+        """A real crash walks the whole lifecycle: SUSPECT at t_fail
+        silence, FAILED t_suspect rounds later, cluster-wide convergence
+        after — and the carries/metrics see each stage."""
+        from gossipfs_tpu.core.rounds import run_rounds
+        from gossipfs_tpu.metrics.detection import summarize
+
+        n, rounds, victim, at = 64, 30, 7, 5
+        cfg = sus_cfg(n, t_fail=3, t_suspect=3)
+        final, mc, per = run_rounds(
+            init_state(cfg), cfg, rounds, jax.random.PRNGKey(0),
+            events=crash_events(n, rounds, victim, at),
+        )
+        report = summarize(mc, per, {victim: at})
+        # suspected ~t_fail+1 rounds after the crash, confirmed exactly
+        # t_suspect later (the age lane is the clock, so the gap is tight)
+        assert 3 <= report.ttd_suspect[victim] <= 5
+        assert report.suspect_to_confirm[victim] == 3
+        assert report.ttd_first[victim] == report.ttd_suspect[victim] + 3
+        assert report.ttd_converged[victim] >= report.ttd_first[victim]
+        assert report.true_detections > 0
+        # the victim ends FAILED/UNKNOWN everywhere, never re-added
+        st = np.asarray(final.status)
+        alive = np.asarray(final.alive)
+        assert not alive[victim]
+        assert (st[alive][:, victim] != 1).all()
+        assert (st[alive][:, victim] != int(SUSPECT)).all()
+
+    def test_blackout_refutes_before_confirm(self):
+        """The acceptance refutation case: a LIVE node whose outgoing
+        gossip blacks out past t_fail is SUSPECTED everywhere; the
+        blackout heals inside the t_suspect window, the node's own
+        (kept-bumping) counter floods back, and every pending failure is
+        cancelled — zero false positives, zero confirmations."""
+        from gossipfs_tpu.core.rounds import run_rounds
+        from gossipfs_tpu.scenarios.tensor import compile_tensor
+
+        n, rounds, victim = 64, 25, 9
+        cfg = sus_cfg(n, t_fail=3, t_suspect=8)
+        # total outbound blackout over [2, 8): ages reach ~6 > t_fail
+        # but stay under the confirm threshold 11
+        sc = FaultScenario(
+            name="blackout", n=n,
+            link_faults=(LinkFault(start=2, end=8, rate=1.0,
+                                   src=(victim,), dst=tuple(range(n))),),
+        )
+        final, mc, per = run_rounds(
+            init_state(cfg), cfg, rounds, jax.random.PRNGKey(1),
+            scenario=compile_tensor(sc),
+        )
+        assert int(np.asarray(per.suspects_entered).sum()) > 0
+        assert int(np.asarray(per.refutations).sum()) > 0
+        assert int(np.asarray(per.fp_suppressed).sum()) > 0
+        # the pending failure was cancelled: never confirmed, no FPs;
+        # the fully-refuted episode also RESETS the suspect clock, so a
+        # later real crash would measure its own episode, not this one
+        assert int(mc.first_detect[victim]) == -1
+        assert int(mc.first_suspect[victim]) == -1
+        assert int(np.asarray(per.false_positives).sum()) == 0
+        assert int(np.asarray(per.true_detections).sum()) == 0
+        # fully healed membership
+        assert (np.asarray(final.status) == 1).all()
+
+    def test_suspect_counts_toward_membership(self):
+        """SUSPECT entries are still members: views, gossip eligibility
+        and convergence all treat them as listed (the detector seam's
+        membership() includes them)."""
+        from gossipfs_tpu.detector.sim import SimDetector
+        from gossipfs_tpu.scenarios.tensor import compile_tensor
+
+        n, victim = 32, 3
+        cfg = sus_cfg(n, t_fail=3, t_suspect=10)
+        det = SimDetector(cfg, seed=0)
+        # blackout starts at round 2, once counters cleared the hb<=1
+        # detection grace (slave.go:468) — a never-heard-from node is
+        # grace-protected and cannot be suspected at all
+        sc = FaultScenario(
+            name="blackout", n=n,
+            link_faults=(LinkFault(start=2, end=30, rate=1.0,
+                                   src=(victim,), dst=tuple(range(n))),),
+        )
+        det.load_scenario(sc)
+        det.advance(9)  # past t_fail silence: suspected, far from confirm
+        sus = det.suspects(0)
+        assert victim in sus
+        assert victim in det.membership(0)  # still a member
+        st = det.suspicion_status()
+        assert st["enabled"] and st["suspects_now"] > 0
+        assert st["suspect_counts"]  # per-node counts present
+
+    def test_oracle_parity_deterministic_with_local_health(self):
+        """Fast-lane golden parity: the XLA suspicion lifecycle (with the
+        Lifeguard stretch armed) against the per-node oracle, driven by a
+        deterministic crash/leave/join schedule through the zombie-rejoin
+        corner.  The randomized sweep lives in the slow-lane golden fuzz."""
+        import sys
+
+        sys.path.insert(0, "tests")
+        from reference_model import NaiveSim
+
+        from gossipfs_tpu.core import topology
+        from gossipfs_tpu.core.rounds import gossip_round
+
+        n = 32
+        base = SimConfig(n=n, topology="random", fanout=5,
+                         remove_broadcast=False, fresh_cooldown=True,
+                         t_fail=3, t_cooldown=5)
+        cfg = with_suspicion(base, SuspicionParams(
+            t_suspect=2, lh_multiplier=2, lh_frac=0.25))
+        schedule = {
+            4: dict(crash=[1, 2, 3, 4, 5, 6, 7, 8, 9]),  # mass death ->
+            # surviving views cross lh_frac: the stretch path runs
+            10: dict(leave=[10]),
+            12: dict(join=[3]),   # rejoin while others still suspect it
+            20: dict(crash=[11]),
+            26: dict(join=[11]),
+        }
+        state = init_state(cfg)
+        naive = NaiveSim(cfg)
+        key = jax.random.PRNGKey(7)
+        for r in range(40):
+            ev = schedule.get(r, {})
+            def m(idx):
+                a = np.zeros(n, dtype=bool)
+                if idx:
+                    a[list(idx)] = True
+                return jnp.asarray(a)
+            events = RoundEvents(crash=m(ev.get("crash")),
+                                 leave=m(ev.get("leave")),
+                                 join=m(ev.get("join")))
+            k = jax.random.fold_in(key, r)
+            edges = topology.in_edges(cfg, k, None)
+            state, _, _, _ = gossip_round(state, events, edges, cfg)
+            naive.step(np.array(edges), crash=ev.get("crash", []),
+                       leave=ev.get("leave", []), join=ev.get("join", []))
+            hb = np.array(state.hb_true())
+            age = np.array(state.age)
+            status = np.array(state.status)
+            assert np.array(state.alive).tolist() == naive.alive, f"r{r}"
+            for i in range(n):
+                if not naive.alive[i]:
+                    continue
+                for j in range(n):
+                    e = naive.tables[i][j]
+                    assert status[i][j] == e.status, f"status[{i},{j}] r{r}"
+                    if e.status != 0:
+                        zombie = e.hb > naive.tables[j][j].hb
+                        if not zombie:
+                            assert hb[i][j] == e.hb, f"hb[{i},{j}] r{r}"
+                        assert age[i][j] == e.age, f"age[{i},{j}] r{r}"
+
+    def test_reference_mode_unreachable(self):
+        """Without suspicion armed the SUSPECT lane value never appears
+        and the suspicion metrics stay zero — the reference mode is
+        bit-unchanged (the golden tests pin this too; here it's cheap)."""
+        from gossipfs_tpu.core.rounds import run_rounds
+
+        n, rounds = 32, 15
+        cfg = SimConfig(n=n, topology="random", fanout=5,
+                        remove_broadcast=False, fresh_cooldown=True)
+        final, mc, per = run_rounds(
+            init_state(cfg), cfg, rounds, jax.random.PRNGKey(0),
+            events=crash_events(n, rounds, 5, 3),
+        )
+        assert (np.asarray(final.status) != int(SUSPECT)).all()
+        assert int(np.asarray(per.suspects_entered).sum()) == 0
+        assert int(np.asarray(per.refutations).sum()) == 0
+        assert (np.asarray(mc.first_suspect) == -1).all()
+
+
+# ---------------------------------------------------------------------------
+# engine parity: one policy, same lifecycle events, sim vs UDP (fast lane)
+# ---------------------------------------------------------------------------
+
+
+class TestEngineParity:
+    def test_partition_confirm_parity_sim_vs_udp(self):
+        """A never-healing partition under suspicion: both engines walk
+        each cross-side entry SUSPECT -> FAILED (same confirmed subject
+        sets, zero same-side confirms, suspicion observed before the
+        confirms) and end fully split."""
+        from gossipfs_tpu.detector.sim import SimDetector
+        from gossipfs_tpu.detector.udp import UdpCluster
+
+        n = 10
+        side_a, side_b = set(range(5)), set(range(5, 10))
+        sc = split_halves(n, start=5, end=1000)
+        params = SuspicionParams(t_suspect=3)
+
+        # -- tensor sim (ring parity mode, gossip-only + suspicion)
+        cfg = with_suspicion(
+            SimConfig(n=n, remove_broadcast=False, fresh_cooldown=True,
+                      t_cooldown=6),
+            params,
+        )
+        det = SimDetector(cfg, seed=0)
+        det.load_scenario(sc)
+        saw_suspects = False
+        for _ in range(8):
+            det.advance(5)
+            st = det.suspicion_status()
+            saw_suspects = saw_suspects or st["suspects_now"] > 0
+        sim_events = det.drain_events()
+        sim_views = {i: set(det.membership(i)) for i in range(n)}
+        assert saw_suspects and det.suspicion_status()["confirms"] > 0
+
+        # -- asyncio UDP engine, same scenario + same params
+        async def udp_run():
+            c = UdpCluster(n=n, base_port=23800, period=0.05,
+                           fresh_cooldown=True, scenario=sc,
+                           suspicion=params)
+            try:
+                await c.start_all()
+                saw = False
+                for _ in range(8):
+                    await c.run(5)
+                    st = c.suspicion_status()
+                    saw = saw or st["suspects_now"] > 0
+                return (c.drain_events(),
+                        {i: set(c.membership(i)) for i in c.alive_nodes()},
+                        saw, c.suspicion_status())
+            finally:
+                c.stop_all()
+
+        udp_events, udp_views, udp_saw, udp_status = asyncio.run(udp_run())
+        assert udp_saw and udp_status["confirms"] > 0
+
+        for name, events, views in (("sim", sim_events, sim_views),
+                                    ("udp", udp_events, udp_views)):
+            det_by_a = {e.subject for e in events if e.observer in side_a}
+            det_by_b = {e.subject for e in events if e.observer in side_b}
+            assert det_by_a == side_b, (name, det_by_a)
+            assert det_by_b == side_a, (name, det_by_b)
+            for i, view in views.items():
+                assert view == (side_a if i in side_a else side_b), (
+                    name, i, view)
+
+    def test_heal_refute_parity_sim_vs_udp(self):
+        """The partition heals inside the SUSPECT window: both engines
+        refute every pending failure — zero confirmations, refutation
+        counts positive, views fully knit back.  End-to-end refutation
+        in BOTH engines (the acceptance criterion's 'at least one')."""
+        from gossipfs_tpu.detector.sim import SimDetector
+        from gossipfs_tpu.detector.udp import UdpCluster
+
+        n = 10
+        # split [3, 10): ages reach ~7 > t_fail=3; confirm would need
+        # > 3 + 8 = 11 silent rounds — heal at 7 rounds refutes first
+        sc = split_halves(n, start=3, end=10)
+        params = SuspicionParams(t_suspect=8)
+
+        cfg = with_suspicion(
+            SimConfig(n=n, remove_broadcast=False, fresh_cooldown=True,
+                      t_cooldown=6, t_fail=3),
+            params,
+        )
+        det = SimDetector(cfg, seed=0)
+        det.load_scenario(sc)
+        det.advance(30)
+        st = det.suspicion_status()
+        assert det.drain_events() == []          # nothing ever confirmed
+        assert st["refutations"] > 0 and st["confirms"] == 0
+        assert all(set(det.membership(i)) == set(range(n))
+                   for i in range(n))
+
+        async def udp_run():
+            c = UdpCluster(n=n, base_port=23900, period=0.05,
+                           fresh_cooldown=True, t_fail=3, scenario=sc,
+                           suspicion=params)
+            try:
+                await c.start_all()
+                await c.run(30)
+                return (c.drain_events(), c.suspicion_status(),
+                        {i: set(c.membership(i)) for i in c.alive_nodes()})
+            finally:
+                c.stop_all()
+
+        udp_events, udp_status, udp_views = asyncio.run(udp_run())
+        assert udp_events == []
+        assert udp_status["refutations"] > 0 and udp_status["confirms"] == 0
+        assert all(v == set(range(n)) for v in udp_views.values())
+
+
+# ---------------------------------------------------------------------------
+# CLI verbs (shim/cli.py satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestCliVerbs:
+    def _sim(self, n=16):
+        from gossipfs_tpu.cosim import CoSim
+
+        cfg = sus_cfg(n, t_fail=3, t_suspect=10)
+        return CoSim(cfg, seed=0)
+
+    def test_suspicion_status_verb_and_lsm_marks(self):
+        from gossipfs_tpu.scenarios.tensor import compile_tensor  # noqa: F401
+        from gossipfs_tpu.shim import cli
+
+        sim = self._sim()
+        victim = 3
+        # start past the hb<=1 grace so the blackout victim is suspectable
+        sc = FaultScenario(
+            name="blackout", n=16,
+            link_faults=(LinkFault(start=2, end=40, rate=1.0,
+                                   src=(victim,),
+                                   dst=tuple(range(16))),),
+        )
+        sim.load_scenario(sc)
+        sim.tick(9)
+        out = io.StringIO()
+        cli.dispatch(sim, "suspicion status", out=out)
+        text = out.getvalue()
+        assert "refutations=" in text and "suspect entries now" in text
+        out2 = io.StringIO()
+        cli.dispatch(sim, "lsm 0", out=out2)
+        assert f"{victim}?" in out2.getvalue()  # SUSPECT rendered distinctly
+
+    def test_status_verb_without_suspicion(self):
+        from gossipfs_tpu.cosim import CoSim
+        from gossipfs_tpu.shim import cli
+
+        sim = CoSim(SimConfig(n=8, remove_broadcast=False,
+                              fresh_cooldown=True), seed=0)
+        out = io.StringIO()
+        cli.dispatch(sim, "suspicion status", out=out)
+        assert "no suspicion armed" in out.getvalue()
+
+    def test_t_suspect_flag(self):
+        from gossipfs_tpu.shim import cli
+
+        args = cli.make_parser().parse_args(
+            ["--n", "8", "--gossip-only", "--t-suspect", "4"])
+        assert args.t_suspect == 4
+
+
+# ---------------------------------------------------------------------------
+# deploy variant (slow lane): params over the control plane, real processes
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_deploy_suspicion_lifecycle(tmp_path):
+    """The per-process deployment under the same suspicion policy: the
+    launcher pushes SuspicionParams over the control plane, a kill -9
+    victim is SUSPECTED (visible in the ScenarioStatus vitals) before the
+    confirm removes it the protocol way; and a brief partition heals into
+    REFUTATIONS instead of removals."""
+    from gossipfs_tpu.deploy.launcher import Cluster
+    from gossipfs_tpu.scenarios import Partition
+
+    n = 6
+    cluster = Cluster(n, period=0.1, root=str(tmp_path), t_fail=5)
+    try:
+        cluster.start(timeout=90.0)
+        # t_suspect=15 at period 0.1 -> a ~1.5 s observable SUSPECT window
+        acked = cluster.load_suspicion(SuspicionParams(t_suspect=15))
+        assert set(acked) == set(range(n))
+        status = cluster.scenario_status()
+        assert len(status) == n and all(
+            ln["suspicion_armed"] for ln in status)
+
+        # -- refutation via a brief partition: [0,1] cut off for ~1 s
+        # (past t_fail, inside t_suspect), then healed
+        side = (0, 1)
+        sc = FaultScenario(
+            name="brief-split", n=n,
+            partitions=(Partition(start=0, end=10, groups=(side,)),),
+        )
+        cluster.load_scenario(sc)
+        deadline = time.monotonic() + 60.0
+        refuted = False
+        while time.monotonic() < deadline and not refuted:
+            lines = cluster.scenario_status()
+            refuted = any(ln.get("refutations", 0) > 0 for ln in lines)
+            time.sleep(0.2)
+        assert refuted, "no refutation after the brief partition healed"
+        # nothing was confirmed by the transient: views stay complete
+        views = {i: set(cluster.client(i).lsm(i)) for i in range(n)}
+        assert views == {i: set(range(n)) for i in range(n)}, views
+
+        # -- kill -9: SUSPECT first (vitals), then the protocol confirm
+        victim, observer = 4, 2
+        cluster.kill9(victim)
+        suspected = False
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            lines = cluster.scenario_status()
+            if any(victim in (ln.get("suspects") or [])
+                   for ln in lines):
+                suspected = True
+                break
+            time.sleep(0.1)
+        assert suspected, "victim never appeared in any suspects vitals"
+        cluster.wait_detected(victim, observer, timeout=60.0)
+        lines = cluster.scenario_status()
+        assert any(ln.get("confirms", 0) > 0 for ln in lines)
+        # the detection was logged the normal way (distributed grep)
+        hits = []
+        for i in range(n):
+            if i == victim:
+                continue
+            hits += cluster.client(i).call(
+                "Grep", pattern="detected failure"
+            ).get("lines") or []
+        assert any(int(ln["subject"]) == victim for ln in hits)
+    finally:
+        cluster.stop()

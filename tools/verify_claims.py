@@ -35,6 +35,40 @@ def run_json(cmd, timeout=1800):
                        f"{out.stderr[-500:]}")
 
 
+def _suspicion_ok(d: dict) -> float:
+    """suspicion_fpr predicate over the SUSPECT artifact rows.
+
+    Per N: (a) churn — suspicion-on at the fast knob keeps median
+    TTD-first <= t_fail + t_suspect (the t_fail=5-class latency) with
+    FPR within 10x of the t_fail=5 baseline (floor 1e-6 ~ 60 FP events,
+    so a zero-FP baseline window can't fail a handful of events) instead
+    of the raw-t3 storm; (b) loss — suspicion-on FPR strictly below
+    suspicion-off at the same t_fail, with refutations actually doing
+    the suppressing (fp_suppressed > 0).
+    """
+    by = {(r["n"], r["fault"], r["mode"]): r for r in d["rows"]}
+    for n in sorted({r["n"] for r in d["rows"]}):
+        base = by[(n, "churn", "baseline-t5")]
+        on = by[(n, "churn", "suspect-t3")]
+        raw = by[(n, "churn", "raw-t3")]
+        bound = on["t_fail"] + on["t_suspect"]
+        if on["ttd_first_median"] is None or on["ttd_first_median"] > bound:
+            return 0.0
+        if on["false_positive_rate"] > max(
+            10 * base["false_positive_rate"], 1e-6
+        ):
+            return 0.0
+        if not on["false_positive_rate"] < raw["false_positive_rate"]:
+            return 0.0
+        loss_on = by[(n, "loss", "suspect-t3")]
+        loss_raw = by[(n, "loss", "raw-t3")]
+        if not loss_on["false_positive_rate"] < loss_raw["false_positive_rate"]:
+            return 0.0
+        if loss_on["fp_suppressed"] <= 0 or on["fp_suppressed"] <= 0:
+            return 0.0
+    return 1.0
+
+
 CLAIMS = {
     # name: (cmd, extractor, claimed value, relative tolerance)
     # headline: d["value"] is the MEDIAN attempt since round 6 (bench.py
@@ -82,6 +116,16 @@ CLAIMS = {
             for r in d["rows"]
         ) else 0.0,
         1.0, 0.0),
+    # suspicion subsystem (SUSPECT_r08.json is the committed artifact of
+    # the same command): SWIM suspect/refute at the fast knob (t_fail=3 +
+    # t_suspect=2) keeps the t_fail=5-class detection latency WITHOUT the
+    # raw-t3 FP storm (within 10x of the t_fail=5 baseline FPR), and
+    # under a Bernoulli-loss scenario suspicion-on FPR is strictly below
+    # suspicion-off at equal-or-better median TTD.  CPU-pinned.
+    "suspicion_fpr": (
+        ["env", "JAX_PLATFORMS=cpu", sys.executable, "-m",
+         "gossipfs_tpu.bench.curves", "--suspicion", "--ns", "1024"],
+        _suspicion_ok, 1.0, 0.0),
 }
 
 
