@@ -42,7 +42,7 @@ def pad_quantum(block_c: int, topology: str) -> int:
 def run(n: int, rounds: int, block_c: int, crash_at: int, track: int,
         crash_rate: float, seed: int, topology: str, block_r: int,
         arc_align: int = 1, fanout: int | None = None,
-        elementwise: str = "lanes") -> dict:
+        elementwise: str = "lanes", rr_rotate: str = "auto") -> dict:
     import jax
     import numpy as np
 
@@ -50,6 +50,7 @@ def run(n: int, rounds: int, block_c: int, crash_at: int, track: int,
     from gossipfs_tpu.config import SimConfig
     from gossipfs_tpu.core import rounds as R
     from gossipfs_tpu.metrics.detection import summarize
+    from gossipfs_tpu.ops import merge_pallas
 
     # Literal-N support (e.g. the BASELINE-named 100,000): pad up to the
     # next admissible aligned size with permanently-dead pad nodes — never
@@ -61,7 +62,8 @@ def run(n: int, rounds: int, block_c: int, crash_at: int, track: int,
     padded = n_pad != n
 
     over = dict(topology=topology, merge_block_r=block_r,
-                arc_align=arc_align, elementwise=elementwise)
+                arc_align=arc_align, elementwise=elementwise,
+                rr_rotate=rr_rotate)
     if fanout:
         over["fanout"] = fanout
     elif arc_align > 1:
@@ -114,6 +116,19 @@ def run(n: int, rounds: int, block_c: int, crash_at: int, track: int,
         "rounds": rounds,
         "crash_churn": crash_rate,
         "elementwise": elementwise,
+        # self-describing artifact fields: which rr layouts ran, and the
+        # shape's row-budget accounting (ring-rotated + compacted flags —
+        # the round-9 layouts that admit wider stripes at every N)
+        "rr_rotate": rr_rotate,
+        "merge_block_r": block_r,
+        "row_budget_bytes": (
+            merge_pallas.rr_align_scratch_bytes(
+                n_pad, cfg.fanout, block_c, arc_align,
+                rotate=rr_rotate != "off")
+            + merge_pallas.rr_flags_bytes(
+                n_pad, block_c, block_r=block_r, arc_align=arc_align,
+                rotate=rr_rotate != "off")
+        ) if arc_align > 1 else None,
         "tracked_crashes": len(crash_rounds),
         "detected": len(ttd_f),
         "ttd_first_median": statistics.median(ttd_f) if ttd_f else None,
@@ -143,12 +158,17 @@ def main(argv=None) -> None:
                    default="lanes",
                    help="packed-word SWAR elementwise (ops/swar.py) vs "
                         "the widened default")
+    p.add_argument("--rr-rotate", choices=("auto", "off"), default="auto",
+                   help="ring-rotated view build + LANE-compacted flags "
+                        "(round 9) vs the full-T/replicated layouts — "
+                        "same bits, different VMEM row cost")
     args = p.parse_args(argv)
     print(json.dumps(run(args.n, args.rounds, args.block_c, args.crash_at,
                          args.track, args.crash_rate, args.seed,
                          args.topology, args.block_r,
                          arc_align=args.arc_align, fanout=args.fanout,
-                         elementwise=args.elementwise)))
+                         elementwise=args.elementwise,
+                         rr_rotate=args.rr_rotate)))
 
 
 if __name__ == "__main__":

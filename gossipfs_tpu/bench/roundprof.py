@@ -156,6 +156,8 @@ def round_bytes(cfg: SimConfig) -> dict:
             n, cfg.fanout, cfg.merge_block_c,
             arc_align=(cfg.arc_align
                        if cfg.topology == "random_arc" else 1),
+            block_r=cfg.merge_block_r,
+            rotate=cfg.rr_rotate != "off",
         )
         phases = {
             "view_build_read": packed,

@@ -180,6 +180,20 @@ class SimConfig:
                                      # rr_resident_supported); "on": require
                                      # it (error if it cannot fit); "off":
                                      # always stream receiver blocks
+    rr_rotate: str = "auto"          # rr kernel row-budget layouts (round 9):
+                                     # "auto" runs the ring-rotated aligned-
+                                     # arc view build (window group maxes
+                                     # rotate through a fixed ring; only the
+                                     # int8 W gather buffer scales with rows)
+                                     # + the LANE-compacted flags block
+                                     # (1 B/row vs LANE B/row) wherever the
+                                     # blocking admits them — what lifts the
+                                     # sharded aligned rr past ~367k rows at
+                                     # merge_block_c=512.  "off" restores the
+                                     # round-5 full-T/replicated layouts
+                                     # (bench.py's on-chip probe fallback,
+                                     # same bits either way — pinned by the
+                                     # rotate A/B parity tests)
     suspicion: "SuspicionParams | None" = None
                                      # SWIM suspect/refute lifecycle
                                      # (suspicion/params.py): silent
@@ -297,6 +311,8 @@ class SimConfig:
                     self.n, self.fanout, self.merge_block_c,
                     arc_align=(self.arc_align
                                if self.topology == "random_arc" else 1),
+                    block_r=self.merge_block_r,
+                    rotate=self.rr_rotate != "off",
                 ):
                     raise ValueError(
                         f"merge_kernel={self.merge_kernel!r} needs "
@@ -314,6 +330,8 @@ class SimConfig:
                         self.n, self.fanout, self.merge_block_c,
                         arc_align=(self.arc_align
                                    if self.topology == "random_arc" else 1),
+                        block_r=self.merge_block_r,
+                        rotate=self.rr_rotate != "off",
                     ):
                         raise ValueError(
                             "rr_resident='on' needs 3 * n * merge_block_c "
@@ -339,6 +357,8 @@ class SimConfig:
                     )
         if self.rr_resident not in ("auto", "on", "off"):
             raise ValueError(f"unknown rr_resident: {self.rr_resident!r}")
+        if self.rr_rotate not in ("auto", "off"):
+            raise ValueError(f"unknown rr_rotate: {self.rr_rotate!r}")
         if self.elementwise not in ("lanes", "swar"):
             raise ValueError(f"unknown elementwise: {self.elementwise!r}")
         if self.elementwise == "swar" and self.hb_dtype != "int8":

@@ -1479,7 +1479,9 @@ def _use_rr(config: SimConfig, n: int, nloc: int) -> bool:
         return False
     if not merge_pallas.rr_supported(
             n, config.fanout, config.merge_block_c, nloc,
-            config.arc_align if config.topology == "random_arc" else 1):
+            config.arc_align if config.topology == "random_arc" else 1,
+            block_r=config.merge_block_r,
+            rotate=config.rr_rotate != "off"):
         return False
     return (
         config.merge_kernel.endswith("interpret")
@@ -1669,6 +1671,8 @@ def _scan_rounds_rr_packed(
             n, config.fanout, c_blk, nloc,
             arc_align=(config.arc_align
                        if config.topology == "random_arc" else 1),
+            block_r=config.merge_block_r,
+            rotate=config.rr_rotate != "off",
         )
     )
 
@@ -1719,7 +1723,14 @@ def _scan_rounds_rr_packed(
             + refresher.astype(jnp.int32) * 2
             + alive.astype(jnp.int32) * 4
         ).astype(jnp.int8)
-        flags = jnp.broadcast_to(flags[:, None], (n, lane))
+        # LANE-compacted flags layout ([N/LANE, LANE] row-major, 1 B/row
+        # of kernel VMEM instead of the lane-replicated LANE B/row); the
+        # kernel wrapper expands it back only when its blocking cannot
+        # take the compact form (merge_pallas.rr_flags_compact_ok)
+        if n % lane == 0:
+            flags = flags.reshape(n // lane, lane)
+        else:  # pragma: no cover - rr requires lane-aligned N
+            flags = jnp.broadcast_to(flags[:, None], (n, lane))
         edges = topology.in_edges(config, k_edge, None)
         arc_fanout = config.fanout if config.topology == "random_arc" else None
         hb2, as2, cnt_incl, ndet, fobs, rcnt = (
@@ -1734,6 +1745,7 @@ def _scan_rounds_rr_packed(
                 resident=resident, col_offset=ctx.offset,
                 arc_align=config.arc_align,
                 elementwise=config.elementwise,
+                rotate=config.rr_rotate != "off",
             )
         )
         # two count forms (merge_pallas.resident_round_blocked): the

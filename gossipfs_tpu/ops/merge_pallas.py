@@ -32,6 +32,7 @@ formulation).
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -680,25 +681,120 @@ def stripe_supported(n: int, fanout: int, n_cols: int | None = None) -> bool:
 RR_BLOCK_CS = (512, 1024, 2048, 4096)
 
 
+# rows per rr view-build chunk: int32 temporaries over a (chunk, cs, LANE)
+# block are what bounds VMEM here (16 MB per temporary at 1024 rows).
+# Defined up here because the budget helpers below take it as a default.
+RR_CHUNK = 256
+
+# rr view-build DMA pipeline depth (see the chunk-loop comment in _rr_kernel)
+VSLOTS = 4
+
+
+def rr_view_chunk(n: int, c_blk: int, *, resident: bool = False,
+                  chunk: int = RR_CHUNK, arc_align: int = 1) -> int:
+    """The view-build chunk row count the rr kernel will actually use.
+
+    THE derivation — ``resident_round_blocked`` calls this (it is not a
+    mirror of wrapper-local logic), so the budget helpers and the kernel
+    can never disagree about the ring geometry; the scratch-budget lint
+    (tests/test_merge_pallas.py) additionally reconciles both against
+    the kernel's real ``pltpu`` allocations.  The resident cap keeps the
+    widened tick temporaries (which scale with chunk x c_blk) beside the
+    parked lanes; the halving preserves n-divisibility; the arc floor
+    makes chunks cover whole groups."""
+    ch = min(chunk, n)
+    if resident:
+        ch = min(ch, max(64, (1 << 18) // c_blk))
+    while n % ch:
+        ch //= 2
+    if arc_align > 1:
+        ch = max(ch, arc_align)
+    return ch
+
+
+def _rr_block_rows(n: int, block_r: int) -> int:
+    """The receiver-block row count the rr kernel will actually use
+    (shared by the wrapper and the flags-layout gate)."""
+    r_blk = max(min(block_r, n), _FUSED_BLOCK_R_MIN)
+    while n % r_blk:
+        r_blk //= 2
+    return r_blk
+
+
+def rr_ring_supported(fanout: int, arc_align: int, chunk: int) -> bool:
+    """Whether the ring-rotated aligned-arc view build admits this shape.
+
+    Each view-build chunk must cover STRICTLY more whole groups than the
+    window halo (``fanout/align - 1`` rows): the ring carry and the
+    wrap-head save copy halo rows from within a single chunk's output,
+    and the first chunk flushes its ``gpc - halo`` halo-free W rows — at
+    ``gpc == halo`` that flush is an out-of-bounds zero-size slice
+    (found by review: resident c_blk=4096 caps the chunk at 64 rows, so
+    align=8 with fanout=72 hit it).  Every production shape qualifies
+    (chunks cover >= 8 groups, halos are 1-2 rows); the full-T build
+    remains the fallback."""
+    if arc_align <= 1:
+        return False
+    gpc = chunk // arc_align
+    nw = fanout // arc_align
+    return nw == 1 or gpc >= nw
+
+
+def rr_flags_compact_ok(n: int, c_blk: int, *,
+                        block_r: int = _FUSED_BLOCK_R,
+                        resident: bool = False, chunk: int = RR_CHUNK,
+                        arc_align: int = 1) -> bool:
+    """Whether the rr kernel can take the LANE-compacted flags layout.
+
+    Compact flags pack the per-row flag byte as [N/LANE, LANE] row-major
+    (1 B/row of resident VMEM instead of the lane-replicated form's
+    LANE B/row — the same move that took the count accumulator from
+    134 MB to 2 MB in round 5).  Every in-kernel flags slice (view-build
+    chunks, receiver blocks) must then cover whole compact rows, so both
+    the chunk and the receiver block must be LANE-divisible — true for
+    every capacity shape (config.py already forces
+    ``merge_block_r % 128 == 0`` on deep stripes); the kernel expands to
+    the replicated layout otherwise."""
+    ch = rr_view_chunk(n, c_blk, resident=resident, chunk=chunk,
+                       arc_align=arc_align)
+    r_blk = _rr_block_rows(n, block_r)
+    return n % LANE == 0 and ch % LANE == 0 and r_blk % LANE == 0
+
+
+def rr_flags_bytes(n: int, c_blk: int, *, block_r: int = _FUSED_BLOCK_R,
+                   resident: bool = False, chunk: int = RR_CHUNK,
+                   arc_align: int = 1, rotate: bool = True) -> int:
+    """Resident VMEM the flags input block occupies (see
+    :func:`rr_flags_compact_ok`)."""
+    if rotate and rr_flags_compact_ok(
+            n, c_blk, block_r=block_r, resident=resident, chunk=chunk,
+            arc_align=arc_align):
+        return n
+    return n * LANE
+
+
 def rr_supported(n: int, fanout: int, c_blk: int,
-                 n_cols: int | None = None, arc_align: int = 1) -> bool:
+                 n_cols: int | None = None, arc_align: int = 1, *,
+                 block_r: int = _FUSED_BLOCK_R, rotate: bool = True) -> bool:
     if n_cols is None:
         n_cols = n
     if arc_align > 1:
         # aligned-arc mode materializes no view stripe (write-only — the
         # gather reads the window maxes); the VMEM row cost is the
-        # T (bf16) + W (int8) group-row buffers PLUS the per-row buffers
-        # that scale with N regardless of stripe width: the flags block
-        # and, on deep-stripe shapes, the count accumulator (int32 at
-        # N >= 32,768).  Omitting those admitted a 16-way N=262,144
-        # shape whose scratch demanded 225 MB (round-5 review).  The T/W
-        # bytes come from rr_align_scratch_bytes — the SAME function the
-        # kernel's own resident check and rr_resident_supported use —
-        # so the two validation paths cannot disagree near the boundary
-        # (an inlined 3*nb*c_blk approximation here used to drop the
-        # wrap-halo rows, (fanout/align - 1) * c_blk * 2 bytes).
-        row_bytes = rr_align_scratch_bytes(n, fanout, c_blk, arc_align) \
-            + n * LANE
+        # window scratch (ring-rotated by default: only the int8 W buffer
+        # scales with rows — see rr_align_scratch_bytes) PLUS the per-row
+        # buffers that scale with N regardless of stripe width: the flags
+        # block (LANE-compacted where admissible) and, on deep-stripe
+        # shapes, the count accumulator (int32 at N >= 32,768).  Omitting
+        # those admitted a 16-way N=262,144 shape whose scratch demanded
+        # 225 MB (round-5 review).  The scratch bytes come from
+        # rr_align_scratch_bytes — the SAME function the kernel's own
+        # resident check and rr_resident_supported use — so the
+        # validation paths cannot disagree near the boundary.
+        row_bytes = rr_align_scratch_bytes(
+            n, fanout, c_blk, arc_align, rotate=rotate
+        ) + rr_flags_bytes(n, c_blk, block_r=block_r, arc_align=arc_align,
+                           rotate=rotate)
         if n_cols // c_blk > RR_ACC_STRIPES:
             # lane-compacted int32 count accumulator + the grid-resident
             # compact count OUTPUT block (both [N/LANE, LANE] int32)
@@ -731,11 +827,19 @@ RR_RESIDENT_MAX_BYTES = 102 * 1024 * 1024
 RR_RESIDENT_ALIGN_BUDGET = 118 * 1024 * 1024
 
 # Combined VMEM budget for the aligned-arc (stripe-free) row costs: the
-# T/W window buffers + flags + the deep-stripe count accumulator must
-# leave room for the view-build/receiver/iota/flag scratches inside the
-# 126 MB compiler limit.  112 MB admits the measured 8-way N=131,072
-# anchor (109 MB of row costs) and rejects the 16-way N=262,144 shape
-# (218 MB) eagerly instead of via a late Mosaic allocation failure.
+# window scratch + flags + the deep-stripe count accumulator must leave
+# room for the view-build/receiver/iota/flag scratches inside the 126 MB
+# compiler limit.  Under the round-9 layouts (ring-rotated build +
+# LANE-compacted flags) the per-row cost collapses to W's c_blk/align
+# bytes + 1 flag byte (+8 accumulator bytes on deep stripes): 73 B/row
+# at c_blk=512/align=8, so 112 MB admits ~1.5M rows — >= 512k at
+# c_blk=512 with margin, and wider stripes at every anchor (N=262,144
+# admits c_blk=2048 at 64 MB where the round-5 full-T/replicated
+# layouts capped it at c_blk=512 and ~367k rows overall).  The budget
+# still rejects over-size shapes eagerly instead of via a late Mosaic
+# allocation failure, and the scratch-budget lint
+# (tests/test_merge_pallas.py) reconciles it against the kernel's real
+# allocations.
 RR_ALIGN_VMEM_BUDGET = 112 * 1024 * 1024
 
 # Stripe count above which the rr kernel switches its per-receiver count
@@ -746,20 +850,76 @@ RR_ALIGN_VMEM_BUDGET = 112 * 1024 * 1024
 RR_ACC_STRIPES = 16
 
 
-def rr_align_scratch_bytes(n: int, fanout: int, c_blk: int,
-                           arc_align: int) -> int:
-    """VMEM the aligned-arc window scratch needs: bf16 group maxes
-    (+wrap halo) plus the int8 window maxes the gather reads."""
-    if arc_align <= 1:
-        return 0
+def rr_align_scratch_specs(n: int, fanout: int, c_blk: int, arc_align: int,
+                           *, chunk: int | None = None,
+                           resident: bool = False,
+                           rotate: bool = True) -> list:
+    """The aligned-arc window scratch allocations, as ``pltpu.VMEM`` specs.
+
+    This is the SINGLE source the kernel allocates from and the
+    scratch-budget lint reconciles against :func:`rr_align_scratch_bytes`
+    — the budget math can never silently drift from the kernel again.
+
+    Ring-rotated build (the default whenever :func:`rr_ring_supported`):
+
+    * ``W`` int8 [N/align rows] — the gather's random-access target, the
+      ONLY buffer that scales with rows (c_blk/align B/row; 64 B/row at
+      c_blk=512/align=8 vs the full-T build's 192);
+    * ``T ring`` bf16 [groups-per-chunk + halo rows] — each chunk's group
+      maxes land at a FIXED ring position; W rows flush per chunk as soon
+      as their halo is complete, so T stops scaling with N entirely;
+    * ``head`` bf16 [halo rows] — the first chunk's leading group maxes,
+      saved to close the mod-N wrap after the last chunk.
+
+    Fallback (chunks narrower than the halo): the round-5 full-T layout —
+    bf16 group maxes for the WHOLE stripe (+wrap halo) beside W.
+    """
+    cs = c_blk // LANE
     nb = n // arc_align
     nw = fanout // arc_align
-    return (nb + max(nw - 1, 1)) * c_blk * 2 + nb * c_blk
+    if chunk is None:
+        chunk = rr_view_chunk(n, c_blk, resident=resident,
+                              arc_align=arc_align)
+    if rotate and rr_ring_supported(fanout, arc_align, chunk):
+        gpc = chunk // arc_align
+        hw = nw - 1
+        specs = [pltpu.VMEM((nb, cs, LANE), jnp.int8)]
+        if hw:
+            specs += [
+                pltpu.VMEM((gpc + hw, cs, LANE), jnp.bfloat16),
+                pltpu.VMEM((hw, cs, LANE), jnp.bfloat16),
+            ]
+        return specs
+    return [
+        pltpu.VMEM((nb + max(nw - 1, 1), cs, LANE), jnp.bfloat16),
+        pltpu.VMEM((nb, cs, LANE), jnp.int8),
+    ]
+
+
+def rr_align_scratch_bytes(n: int, fanout: int, c_blk: int,
+                           arc_align: int, *, chunk: int | None = None,
+                           resident: bool = False,
+                           rotate: bool = True) -> int:
+    """VMEM the aligned-arc window scratch needs — computed FROM the
+    allocation specs (:func:`rr_align_scratch_specs`), so formula and
+    kernel are one.  ``chunk=None`` derives the kernel's default
+    view-build chunk (non-resident — the widest, hence an upper bound on
+    the ring's fixed bytes for resident callers)."""
+    if arc_align <= 1:
+        return 0
+    return sum(
+        math.prod(s.shape) * jnp.dtype(s.dtype).itemsize
+        for s in rr_align_scratch_specs(n, fanout, c_blk, arc_align,
+                                        chunk=chunk, resident=resident,
+                                        rotate=rotate)
+    )
 
 
 def rr_resident_supported(n: int, fanout: int, c_blk: int,
                           n_cols: int | None = None,
-                          arc_align: int = 1) -> bool:
+                          arc_align: int = 1, *,
+                          block_r: int = _FUSED_BLOCK_R,
+                          rotate: bool = True) -> bool:
     """Whether the floor-traffic resident-lanes rr variant fits VMEM.
 
     With ``arc_align > 1`` the aligned-arc window scratch
@@ -768,21 +928,25 @@ def rr_resident_supported(n: int, fanout: int, c_blk: int,
     check."""
     if n_cols is None:
         n_cols = n
-    align_bytes = rr_align_scratch_bytes(n, fanout, c_blk, arc_align)
+    align_bytes = rr_align_scratch_bytes(n, fanout, c_blk, arc_align,
+                                         resident=True, rotate=rotate)
     # aligned mode materializes no stripe: resident VMEM is the two
-    # parked lanes + the T/W window scratch
+    # parked lanes + the window scratch
     lane_bytes = (2 if arc_align > 1 else 3) * n * c_blk
     # per-row VMEM that scales with N regardless of stripe width: the
-    # flags block, plus the count accumulator on deep-stripe shapes
-    # (int32 at N >= 32,768) — omitting these admitted a resident
-    # N=86,016 aligned shape that demanded 165 MB of VMEM
-    row_extra = n * LANE
+    # flags block (compacted where admissible), plus the count
+    # accumulator on deep-stripe shapes (int32 at N >= 32,768) —
+    # omitting these admitted a resident N=86,016 aligned shape that
+    # demanded 165 MB of VMEM
+    row_extra = rr_flags_bytes(n, c_blk, block_r=block_r, resident=True,
+                               arc_align=arc_align, rotate=rotate)
     if n_cols // c_blk > RR_ACC_STRIPES:
         # lane-compacted int32 count accumulator + the grid-resident
         # compact count OUTPUT block (both [N/LANE, LANE] int32)
         row_extra += n * 8
     return (
-        rr_supported(n, fanout, c_blk, n_cols, arc_align)
+        rr_supported(n, fanout, c_blk, n_cols, arc_align,
+                     block_r=block_r, rotate=rotate)
         and lane_bytes <= RR_RESIDENT_MAX_BYTES
         and lane_bytes + align_bytes + row_extra
         <= RR_RESIDENT_ALIGN_BUDGET
@@ -1183,12 +1347,8 @@ def arc_merge_update_blocked(
 # to (core/rounds.py _membership_update / _gossip_view / _tick).
 # ---------------------------------------------------------------------------
 
-# rows per view-build chunk: int32 temporaries over a (chunk, cs, LANE)
-# block are what bounds VMEM here (16 MB per temporary at 1024 rows)
-RR_CHUNK = 256
-
-# view-build DMA pipeline depth (see the chunk-loop comment in _rr_kernel)
-VSLOTS = 4
+# RR_CHUNK / VSLOTS (the view-build chunk rows and DMA pipeline depth)
+# are defined above the budget helpers, which mirror the chunk geometry.
 
 
 def pack_age_status(age: jax.Array, status: jax.Array) -> jax.Array:
@@ -1439,7 +1599,8 @@ def _rr_kernel(
     arc: bool = False, resident: bool = False, unroll: int = 1,
     view_dt=jnp.int8, stub: frozenset = frozenset(),
     arc_rows: int = ARC_CHUNK, vslots: int = VSLOTS, arc_align: int = 1,
-    rcnt_acc: bool = False, swar_mode: bool = False, *, nstripes: int,
+    rcnt_acc: bool = False, swar_mode: bool = False, ring: bool = False,
+    flags_compact: bool = False, *, nstripes: int,
 ):
     # swar_mode: run the elementwise stages over packed 4-subject words
     # (see the SWAR section above _rr_tick_view_swar).  The view-build
@@ -1455,6 +1616,14 @@ def _rr_kernel(
     # the window maxes), so it is not materialized; any stub keeps the
     # real stripe so the bisect tool's stubbed paths stay valid
     no_stripe = arc and arc_align > 1 and not stub
+    # ring-rotated aligned-arc geometry (see rr_align_scratch_specs):
+    # groups per view-build chunk and the halo (window rows that straddle
+    # a chunk boundary)
+    if arc and arc_align > 1:
+        nb_k = n // arc_align
+        nw_k = n_fanout // arc_align
+        hw_k = nw_k - 1
+        gpc_k = chunk // arc_align
 
     mx = max(chunk, r_blk)
 
@@ -1477,6 +1646,16 @@ def _rr_kernel(
             hb_res, as_res, *arc_scratch = rest
         else:
             rbuf, rsems, *arc_scratch = rest
+        # aligned-arc window scratch, by build (rr_align_scratch_specs'
+        # layouts): ring-rotated — W first, then the fixed T ring + the
+        # wrap head; full-T fallback — whole-stripe T, then W
+        if arc and arc_align > 1:
+            if ring:
+                wbuf_a = arc_scratch[0]
+                tring = arc_scratch[1] if hw_k else None
+                thead = arc_scratch[2] if hw_k else None
+            else:
+                tbuf_a, wbuf_a = arc_scratch
         # The raw lanes arrive ONCE, in ANY memory space; every VMEM
         # crossing is an explicit software-pipelined DMA — BlockSpec-fetched
         # lane inputs measured ~3 ms/round slower here (Mosaic serializes
@@ -1518,18 +1697,25 @@ def _rr_kernel(
             dbuf[...] = r0 - cl
 
         def load_flags(start, size):
-            # materialize the (size, 1, LANE) -> (size, cs, LANE) flag
-            # broadcast ONCE through scratch: Mosaic otherwise re-runs the
+            # materialize the flag broadcast ONCE through scratch into
+            # (size, cs, LANE): Mosaic otherwise re-runs the
             # sublane-broadcast relayout at every use (~1.6 ms/round).
             # Returns the raw int8 block; the widened path casts at the
             # use site, the SWAR path bitcasts to packed words (a word's
             # 4 bytes span the cs axis, where flags are uniform, so flag
             # words are the row's byte replicated — masks fall out of
             # plain word bit-tests)
-            flbuf[pl.ds(0, size)] = jnp.broadcast_to(
-                flags_all[pl.ds(start, size)].reshape(size, 1, LANE),
-                (size, cs, LANE),
-            )
+            if flags_compact:
+                # LANE-compacted layout [N/LANE, LANE]: size/LANE compact
+                # rows reshape back to per-row bytes (lane -> sublane
+                # relayout, the inverse of the count accumulator's) —
+                # callers guarantee LANE-divisible start/size (the
+                # wrapper's flags_compact gate)
+                src = flags_all[pl.ds(start // LANE, size // LANE)].reshape(
+                    size, 1, 1)
+            else:
+                src = flags_all[pl.ds(start, size)].reshape(size, 1, LANE)
+            flbuf[pl.ds(0, size)] = jnp.broadcast_to(src, (size, cs, LANE))
             return flbuf[pl.ds(0, size)]
 
         def issue_into(buf, sems, blk_rows, rows_per, slot):
@@ -1618,9 +1804,7 @@ def _rr_kernel(
                     if arc and arc_align > 1 and "wmax" not in stub:
                         # aligned-arc group max on the packed words (byte
                         # max over WRAPPED encodings, as the widened path)
-                        tbuf = arc_scratch[0]
-                        gpc = chunk // arc_align
-                        gw = enc.reshape(gpc, arc_align, cs // 4, LANE)
+                        gw = enc.reshape(gpc_k, arc_align, cs // 4, LANE)
                         vals = [gw[:, t] for t in range(arc_align)]
                         while len(vals) > 1:
                             nxt = [swar.maxs(vals[m], vals[m + 1])
@@ -1628,8 +1812,21 @@ def _rr_kernel(
                             if len(vals) % 2:
                                 nxt.append(vals[-1])
                             vals = nxt
-                        tbuf[pl.ds(c * gpc, gpc)] = pltpu.bitcast(
-                            vals[0], jnp.int8).astype(tbuf.dtype)
+                        gm8 = pltpu.bitcast(vals[0], jnp.int8)
+                        if ring and hw_k:
+                            # ring build: this chunk's group maxes land at
+                            # the FIXED ring position (rows [hw, hw+gpc));
+                            # the W flush after the tick branches consumes
+                            # them, so T never scales with N
+                            tring[hw_k:hw_k + gpc_k] = gm8.astype(
+                                tring.dtype)
+                        elif ring:
+                            # fanout == align: W[b] IS T[b] — straight to
+                            # the gather buffer, no ring at all
+                            wbuf_a[pl.ds(c * gpc_k, gpc_k)] = gm8
+                        else:
+                            tbuf_a[pl.ds(c * gpc_k, gpc_k)] = gm8.astype(
+                                tbuf_a.dtype)
 
                 def tick_view(eye):
                     if "noflags" in stub:
@@ -1683,16 +1880,23 @@ def _rr_kernel(
                         # maxes, so in aligned mode the stripe itself is
                         # write-only and is not materialized at all
                         # (no_stripe): that frees N x c_blk bytes of VMEM —
-                        # the rr row bound drops to the T/W buffers'
-                        # 0.375 x N x c_blk — and deletes one full store
-                        # pass from the view build
+                        # the rr row bound drops to the window scratch —
+                        # and deletes one full store pass from the view
+                        # build
                         encw = _wrap8(enc) if view_dt == jnp.int8 else enc
-                        tbuf = arc_scratch[0]
-                        gpc = chunk // arc_align
                         gm = jnp.max(
-                            encw.reshape(gpc, arc_align, cs, LANE), axis=1
+                            encw.reshape(gpc_k, arc_align, cs, LANE), axis=1
                         )
-                        tbuf[pl.ds(c * gpc, gpc)] = gm.astype(tbuf.dtype)
+                        if ring and hw_k:
+                            # ring build (see the SWAR branch's comment)
+                            tring[hw_k:hw_k + gpc_k] = gm.astype(
+                                tring.dtype)
+                        elif ring:
+                            wbuf_a[pl.ds(c * gpc_k, gpc_k)] = gm.astype(
+                                wbuf_a.dtype)
+                        else:
+                            tbuf_a[pl.ds(c * gpc_k, gpc_k)] = gm.astype(
+                                tbuf_a.dtype)
 
                 # the diagonal crosses this stripe only in the c_blk-row
                 # band at its own columns: every other chunk skips the
@@ -1717,32 +1921,75 @@ def _rr_kernel(
                             tick_view_swar()
                         else:
                             tick_view(None)
+
+                if (arc and arc_align > 1 and ring and hw_k
+                        and "wmax" not in stub and "wring" not in stub):
+                    # ring-rotated W flush: ring rows [0, hw) hold the
+                    # PREVIOUS chunk's trailing group maxes (the carry),
+                    # rows [hw, hw+gpc) this chunk's — every window row
+                    # whose halo just completed flushes to W NOW, so the
+                    # bf16 T data never outlives one chunk + halo.  The
+                    # first chunk has no carry: it flushes its gpc - hw
+                    # halo-free rows and saves its head for the mod-N
+                    # wrap close after the loop.
+                    @pl.when(c == 0)
+                    def _():
+                        thead[...] = tring[hw_k:2 * hw_k]
+                        w = tring[pl.ds(hw_k, gpc_k - hw_k)]
+                        for gg in range(1, nw_k):
+                            w = jnp.maximum(
+                                w, tring[pl.ds(hw_k + gg, gpc_k - hw_k)])
+                        wbuf_a[pl.ds(0, gpc_k - hw_k)] = w.astype(
+                            wbuf_a.dtype)
+
+                    @pl.when(c > 0)
+                    def _():
+                        w = tring[pl.ds(0, gpc_k)]
+                        for gg in range(1, nw_k):
+                            w = jnp.maximum(w, tring[pl.ds(gg, gpc_k)])
+                        wbuf_a[pl.ds(c * gpc_k - hw_k, gpc_k)] = w.astype(
+                            wbuf_a.dtype)
+
+                    # carry: this chunk's trailing hw group rows become
+                    # the next chunk's leading halo (disjoint copy —
+                    # rr_ring_supported guarantees gpc >= nw > hw)
+                    tring[0:hw_k] = tring[pl.ds(gpc_k, hw_k)]
                 return 0
 
             lax.fori_loop(0, nchunks, body, 0, unroll=False)
-            if arc and arc_align > 1 and "wmax" not in stub:
-                # aligned arc: the group maxes T are already in tbuf (the
-                # view build wrote them).  One pair-max pass over the
-                # N/align group rows finishes the F-window:
+            if arc and arc_align > 1 and ring and "wmax" not in stub:
+                if hw_k and "wring" not in stub:
+                    # close the mod-N wrap: after the last chunk the ring
+                    # carry rows [0, hw) hold T[nb-hw .. nb); appending
+                    # the saved head (T[0 .. hw)) completes the final hw
+                    # window rows — the only W rows whose windows straddle
+                    # the stripe's wrap
+                    tring[hw_k:2 * hw_k] = thead[...]
+                    w = tring[pl.ds(0, hw_k)]
+                    for gg in range(1, nw_k):
+                        w = jnp.maximum(w, tring[pl.ds(gg, hw_k)])
+                    wbuf_a[pl.ds(nb_k - hw_k, hw_k)] = w.astype(wbuf_a.dtype)
+            elif arc and arc_align > 1 and "wmax" not in stub:
+                # full-T fallback (chunks narrower than the halo — see
+                # rr_ring_supported): the group maxes T are already in
+                # tbuf (the view build wrote them).  One pair-max pass
+                # over the N/align group rows finishes the F-window:
                 # W[b] = max_{g < F/align} T[(b + g) mod nb]
-                tbuf, wbuf = arc_scratch
-                nb = n // arc_align
-                nw = n_fanout // arc_align
-                for g in range(nw - 1):
-                    tbuf[pl.ds(nb + g, 1)] = tbuf[pl.ds(g, 1)]  # wrap halo
+                for g in range(nw_k - 1):
+                    tbuf_a[pl.ds(nb_k + g, 1)] = tbuf_a[pl.ds(g, 1)]  # halo
 
                 def wbody(c, _):
                     base = c * w_rows
-                    w = tbuf[pl.ds(base, w_rows)]
-                    for g in range(1, nw):
-                        w = jnp.maximum(w, tbuf[pl.ds(base + g, w_rows)])
-                    wbuf[pl.ds(base, w_rows)] = w.astype(wbuf.dtype)
+                    w = tbuf_a[pl.ds(base, w_rows)]
+                    for g in range(1, nw_k):
+                        w = jnp.maximum(w, tbuf_a[pl.ds(base + g, w_rows)])
+                    wbuf_a[pl.ds(base, w_rows)] = w.astype(wbuf_a.dtype)
                     return 0
 
-                w_rows = min(nb, 256)
-                while nb % w_rows:
+                w_rows = min(nb_k, 256)
+                while nb_k % w_rows:
                     w_rows //= 2
-                lax.fori_loop(0, nb // w_rows, wbody, 0, unroll=False)
+                lax.fori_loop(0, nb_k // w_rows, wbody, 0, unroll=False)
             elif arc and "wmax" not in stub:
                 # arc senders are F consecutive rows: replace the stripe
                 # with its windowed row-max once, so the per-receiver
@@ -1779,7 +2026,7 @@ def _rr_kernel(
         cd = jnp.int32 if view_dt == jnp.int8 else view_dt
         if arc and arc_align > 1:
             shift = arc_align.bit_length() - 1
-            wb = arc_scratch[1]
+            wb = wbuf_a
 
             def gather(t, _):
                 for k in range(unroll):
@@ -1960,7 +2207,7 @@ def _rr_kernel(
         "fanout", "member", "unknown", "failed", "age_clamp", "window",
         "t_fail", "t_cooldown", "block_r", "chunk", "interpret",
         "resident", "gather_unroll", "arc_align", "rcnt_acc", "elementwise",
-        "_stub",
+        "rotate", "_stub",
     ),
 )
 def resident_round_blocked(
@@ -1989,6 +2236,7 @@ def resident_round_blocked(
     arc_align: int = 1,
     rcnt_acc: bool | None = None,
     elementwise: str = "lanes",
+    rotate: bool = True,
     _stub: str = "",
 ) -> tuple[jax.Array, ...]:
     """One whole gossip round (lean crash-only fault model) in one kernel.
@@ -2017,9 +2265,19 @@ def resident_round_blocked(
       ``random_arc`` topology pass arc BASES int32 [N] plus ``fanout=F``:
       the kernel then window-maxes the view stripe once (O(log F)
       vectorized passes) and the per-receiver merge is a single load.
-    * ``flags`` int8 [N, LANE]: bit 0 = active sender this round
-      (alive & group >= min_group), bit 1 = small-group refresher,
-      bit 2 = alive.  Derived per round from the carried member counts.
+    * ``flags`` int8: bit 0 = active sender this round (alive & group >=
+      min_group), bit 1 = small-group refresher, bit 2 = alive.  Derived
+      per round from the carried member counts.  Two accepted layouts:
+      LANE-COMPACTED [N/LANE, LANE] row-major (1 B/row — what capacity
+      callers pass) or lane-replicated [N, LANE] (legacy); the wrapper
+      converts to whichever layout the blocking admits (compact needs
+      LANE-divisible view chunks and receiver blocks —
+      :func:`rr_flags_compact_ok`).
+    * ``rotate`` (default True) enables the ring-rotated aligned-arc
+      view build + the compacted flags layout — the row-budget layouts
+      that lift the aligned rr past ~367k rows at c_blk=512.
+      ``rotate=False`` restores the round-5 full-T/replicated layouts
+      (the on-chip probe fallback, and the A/B baseline for tests).
     * ``sa``/``sb``/``g`` int32 per-subject vectors in the blocked
       [nc, cs, LANE] form: view shift (view_base - hb_base), store shift
       (new_base - hb_base) and grace threshold (hb_grace - hb_base).
@@ -2062,60 +2320,76 @@ def resident_round_blocked(
                 f"(align={arc_align}, fanout={fanout}, n={n})"
             )
     if not rr_supported(n, fanout, cs * LANE, nc * cs * LANE,
-                        arc_align if (arc and not _stub) else 1):
+                        arc_align if (arc and not _stub) else 1,
+                        block_r=block_r, rotate=rotate):
         raise ValueError(
             f"resident round kernel needs lane-aligned N, cs*LANE in "
             f"{RR_BLOCK_CS} and its VMEM row cost within "
             f"{STRIPE_MAX_BYTES} B "
             f"(N={n}, blocked cols={cs * LANE}); use the stripe/XLA path"
         )
-    # aligned-arc window scratch (~0.375 * N * c_blk bytes) is counted
-    # against the resident budget so near-boundary shapes fail with THIS
-    # error, not a late Mosaic VMEM allocation failure; the same math
-    # backs rr_resident_supported, so config-time validation agrees
+    # aligned-arc window scratch is counted against the resident budget
+    # so near-boundary shapes fail with THIS error, not a late Mosaic
+    # VMEM allocation failure; the same math backs rr_resident_supported,
+    # so config-time validation agrees
     align_bytes = rr_align_scratch_bytes(
-        n, fanout, cs * LANE, arc_align if arc else 1)
+        n, fanout, cs * LANE, arc_align if arc else 1,
+        resident=resident, rotate=rotate)
     if resident and not rr_resident_supported(
             n, fanout, cs * LANE, nc * cs * LANE,
-            arc_align=arc_align if arc else 1):
+            arc_align=arc_align if arc else 1,
+            block_r=block_r, rotate=rotate):
         raise ValueError(
             f"resident lanes need 3*N*c_blk <= {RR_RESIDENT_MAX_BYTES} B "
             f"(+ {align_bytes} B aligned-arc scratch within "
             f"{RR_RESIDENT_ALIGN_BUDGET} B total) of VMEM "
             f"(N={n}, c_blk={cs * LANE})"
         )
-    ch = min(chunk, n)
-    if resident:
-        # the parked lanes leave little VMEM headroom: cap the chunk so
-        # the widened tick temporaries (which scale with chunk x c_blk)
-        # fit beside them; the VSLOTS-deep pipeline keeps the smaller
-        # DMAs' latency hidden
-        ch = min(ch, max(64, (1 << 18) // (cs * LANE)))
-    while n % ch:
-        ch //= 2
-    if arc_align > 1:
-        # view-build chunks must cover whole groups (the group max rides
-        # the chunk pass); applied AFTER the resident cap and the
-        # n-divisibility halving so neither can undo it
-        ch = max(ch, arc_align)
-        if ch % arc_align or n % ch:
-            raise ValueError(
-                f"arc_align={arc_align} incompatible with view-build "
-                f"chunk {ch} at n={n}"
-            )
+    # the view-build chunk comes from the SAME derivation the budget
+    # helpers use (rr_view_chunk: the resident VMEM cap, n-divisibility
+    # halving, whole-groups arc floor) — one definition, no drift
+    ch = rr_view_chunk(n, cs * LANE, resident=resident, chunk=chunk,
+                       arc_align=arc_align)
+    if arc_align > 1 and (ch % arc_align or n % ch):
+        raise ValueError(
+            f"arc_align={arc_align} incompatible with view-build "
+            f"chunk {ch} at n={n}"
+        )
     # pipeline depth: deep at narrow chunk DMAs (sub-us transfers whose
     # latency a 2-slot ping-pong left exposed); 2 slots at c_blk=4096,
     # where chunks are ~1 MB and the deep buffers crowd VMEM instead
     vslots = VSLOTS if (resident or cs < 32) else 2
-    r_blk = max(min(block_r, n), _FUSED_BLOCK_R_MIN)
-    while n % r_blk:
-        r_blk //= 2
+    r_blk = _rr_block_rows(n, block_r)
     # auto gather unroll: one iteration should cover ~a native-tile's worth
     # of sublanes — 4 rows at c_blk=1024, 2 at 2048, 1 at 4096
     u = gather_unroll if gather_unroll else max(1, 4096 // (cs * LANE))
     while r_blk % u:
         u //= 2
     hb_min = int(jnp.iinfo(jnp.int8).min)
+
+    # ring-rotated aligned-arc view build: on whenever rotate and the
+    # chunk covers the window halo (every production shape); the full-T
+    # build is the fallback — and the rotate=False A/B baseline
+    ring = (rotate and arc and arc_align > 1
+            and rr_ring_supported(fanout, arc_align, ch))
+    # flags layout: LANE-compacted whenever every in-kernel slice covers
+    # whole compact rows (the same gate the budget math charges by); the
+    # wrapper converts from whichever layout the caller passed (both are
+    # cheap [N]-scale XLA ops)
+    flags_compact = rotate and rr_flags_compact_ok(
+        n, cs * LANE, block_r=block_r, resident=resident, chunk=chunk,
+        arc_align=arc_align)
+    if flags.shape == (n, LANE):
+        if flags_compact:
+            flags = flags[:, 0].reshape(n // LANE, LANE)
+    elif n % LANE == 0 and flags.shape == (n // LANE, LANE):
+        if not flags_compact:
+            flags = jnp.broadcast_to(flags.reshape(n, 1), (n, LANE))
+    else:
+        raise ValueError(
+            f"flags must be [N, {LANE}] (replicated) or [N/{LANE}, {LANE}] "
+            f"(LANE-compacted), got {flags.shape} at N={n}"
+        )
 
     # Tile-aligned view stripe: int8's native tile is (32, 128) sublanes x
     # lanes, so at narrow stripe widths (cs < 32) every per-row gather load
@@ -2204,15 +2478,14 @@ def resident_round_blocked(
         arc_rows //= 2
     ext = arc_rows + fanout - 1
     if arc and arc_align > 1:
-        # tile-aligned arc: T (bf16 group maxes + wrap halo) and W (int8
-        # window maxes over F/align groups, what the gather reads).  The
-        # chunked view build must emit whole groups per chunk.
-        nb = n // arc_align
-        nw = fanout // arc_align
-        arc_scratch = [
-            pltpu.VMEM((nb + max(nw - 1, 1), cs, LANE), jnp.bfloat16),
-            pltpu.VMEM((nb, cs, LANE), jnp.int8),
-        ]
+        # tile-aligned arc window scratch, allocated from the SAME spec
+        # function the budget math sums (rr_align_scratch_specs — the
+        # scratch-budget lint reconciles the two): ring-rotated W + fixed
+        # T ring + wrap head by default, the full-T + W fallback when the
+        # chunk cannot cover the halo.  The chunked view build must emit
+        # whole groups per chunk.
+        arc_scratch = rr_align_scratch_specs(
+            n, fanout, cs * LANE, arc_align, chunk=ch, rotate=ring)
     elif arc:
         arc_scratch = [
             pltpu.VMEM((ext, cs, LANE), jnp.bfloat16),
@@ -2240,7 +2513,7 @@ def resident_round_blocked(
                    stub=frozenset(s for s in _stub.split(",") if s),
                    arc_rows=arc_rows, vslots=vslots, arc_align=arc_align,
                    rcnt_acc=use_acc, swar_mode=elementwise == "swar",
-                   nstripes=nc),
+                   ring=ring, flags_compact=flags_compact, nstripes=nc),
         grid=(nc, n // r_blk),
         # in-place lane update: safe because every [row-block, stripe]
         # region's reads (the i==0 view-build chunk pass and the one-step-
@@ -2255,7 +2528,8 @@ def resident_round_blocked(
                          memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1), lambda j, i: (0, 0),
                          memory_space=pltpu.SMEM),   # global column offset
-            pl.BlockSpec((n, LANE), lambda j, i: (0, 0),
+            pl.BlockSpec((n // LANE, LANE) if flags_compact else (n, LANE),
+                         lambda j, i: (0, 0),
                          memory_space=pltpu.VMEM),   # flags (resident)
             pl.BlockSpec((N_VEC, 1, cs, LANE), lambda j, i: (0, j, 0, 0),
                          memory_space=pltpu.VMEM),   # threshold stack

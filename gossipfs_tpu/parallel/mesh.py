@@ -42,6 +42,45 @@ else:  # pragma: no cover - exercised only on older jax runtimes
     _SM_NOCHECK = {"check_rep": False}
 
 
+def rr_shard_admissible(n: int, shards: int, block_c: int, fanout: int,
+                        arc_align: int = 8, block_r: int = 512,
+                        rotate: bool = True) -> dict:
+    """Row-budget admissibility of ONE shard's resident-round program.
+
+    The sharded aligned rr runs tall-skinny [N global rows x N/shards
+    local columns] shapes — exactly where the kernel's per-row VMEM
+    binds.  Returns the verdict plus the budget components (window
+    scratch, flags, count accumulator) so capacity planning
+    (tools/shard_anchor.py --ladder) can show WHY a shape is in or out.
+    Ring-rotated + LANE-compacted layouts by default (round 9); pass
+    ``rotate=False`` for the round-5 full-T/replicated budget.
+    """
+    from gossipfs_tpu.ops import merge_pallas as mp
+
+    nloc = n // shards
+    scratch = mp.rr_align_scratch_bytes(n, fanout, block_c, arc_align,
+                                        rotate=rotate)
+    flags = mp.rr_flags_bytes(n, block_c, block_r=block_r,
+                              arc_align=arc_align, rotate=rotate)
+    acc = n * 8 if nloc // block_c > mp.RR_ACC_STRIPES else 0
+    return {
+        "n_global": n,
+        "shards": shards,
+        "local_cols": nloc,
+        "merge_block_c": block_c,
+        "fanout": fanout,
+        "arc_align": arc_align,
+        "admissible": mp.rr_supported(n, fanout, block_c, nloc,
+                                      arc_align=arc_align, block_r=block_r,
+                                      rotate=rotate),
+        "window_scratch_bytes": scratch,
+        "flags_bytes": flags,
+        "count_acc_bytes": acc,
+        "row_budget_bytes": scratch + flags + acc,
+        "budget_limit_bytes": mp.RR_ALIGN_VMEM_BUDGET,
+    }
+
+
 def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     """1-D mesh over available devices (v5e-8 -> 8-way column sharding)."""
     if devices is None:

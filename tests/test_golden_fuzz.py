@@ -19,6 +19,7 @@ import pytest
 
 from gossipfs_tpu.config import SimConfig
 from gossipfs_tpu.core.rounds import gossip_round
+from gossipfs_tpu.core.rounds import run_rounds as gossip_run_rounds
 from gossipfs_tpu.core.state import RoundEvents, init_state
 from gossipfs_tpu.core import topology
 from gossipfs_tpu.suspicion import SuspicionParams
@@ -145,6 +146,53 @@ CONFIGS = [
                                            t_suspect=3, lh_multiplier=2,
                                            lh_frac=0.25)), True),
 ]
+
+
+def test_fuzz_rr_rotated_scan_matches_oracle():
+    """Golden fuzz on the round-9 rr path: the ring-rotated aligned-arc
+    view build + LANE-compacted flags (merge_kernel='pallas_rr_interpret',
+    resident lanes), driven by a seeded crash-storm schedule through
+    ``run_rounds`` in segments and checked entry-for-entry against the
+    per-node oracle at every segment boundary.
+
+    The CONFIGS sweep above drives ``gossip_round``, which never reaches
+    the rr kernel (it needs lane-aligned N >= the stripe width and the
+    lean crash-only scan), so this is the one fuzz config the new path
+    gets — crash-only by construction (the rr fault model; scheduled
+    leaves would mean silent death, identical to crash on both sides).
+    Edge replication mirrors core.rounds._scan_rounds_rr's per-round key
+    derivation so the oracle gossips over the same sampled arcs."""
+    cfg = SimConfig(n=1024, topology="random_arc", fanout=16, arc_align=8,
+                    remove_broadcast=False, fresh_cooldown=True,
+                    t_cooldown=12, view_dtype="int8", hb_dtype="int8",
+                    merge_kernel="pallas_rr_interpret", merge_block_c=512,
+                    merge_block_r=128, rr_resident="on")
+    n, rounds, seg = cfg.n, 40, 5
+    rng = pyrandom.Random(909)
+    schedule: dict[int, list[int]] = {}
+    for r in range(2, rounds):
+        if rng.random() < 0.12:
+            schedule[r] = rng.sample(range(1, n), k=rng.randint(1, 3))
+    state = init_state(cfg)
+    naive = NaiveSim(cfg)
+    key = jax.random.PRNGKey(11)
+    for r0 in range(0, rounds, seg):
+        crash = np.zeros((seg, n), dtype=bool)
+        for r in range(r0, r0 + seg):
+            for idx in schedule.get(r, []):
+                crash[r - r0, idx] = True
+        z = jnp.zeros((seg, n), dtype=bool)
+        ev = RoundEvents(crash=jnp.asarray(crash), leave=z, join=z)
+        state, _, _ = gossip_run_rounds(state, cfg, seg, key, events=ev,
+                                        crash_only_events=True)
+        for r in range(r0, r0 + seg):
+            # the rr scan's per-round edge key (core/rounds.py
+            # _scan_rounds_rr_packed.step): fold_in(key, round), split
+            k_edge, _ = jax.random.split(jax.random.fold_in(key, r))
+            bases = topology.in_edges(cfg, k_edge, None)
+            naive.step(np.array(topology.arc_edges(bases, cfg.fanout)),
+                       crash=schedule.get(r, []))
+        compare(state, naive, where=f"rr-rotated round {r0 + seg}")
 
 
 @pytest.mark.parametrize("name,kwargs,introkill", CONFIGS,

@@ -438,6 +438,185 @@ def test_stripe_and_arc_kernel_smoke():
                 topology, kernel)
 
 
+def _rr_tall_skinny_inputs(n, nloc, fanout, arc_align, seed=29):
+    """Random packed-lane inputs at a [N rows x nloc local columns] shard
+    shape — rows >> columns, the sharded capacity regime the square tests
+    never exercise (and where the row budget binds)."""
+    from gossipfs_tpu.ops import merge_pallas as mp
+
+    c_blk = 512
+    nc, cs = nloc // c_blk, c_blk // mp.LANE
+    ks = jax.random.split(jax.random.PRNGKey(seed), 5)
+    hb = jax.random.randint(ks[0], (nc, n, cs, mp.LANE), -128, 127, jnp.int8)
+    age = jax.random.randint(ks[1], (nc, n, cs, mp.LANE), 1, 40, jnp.int32)
+    st = jax.random.randint(ks[2], (nc, n, cs, mp.LANE), 0, 3, jnp.int32)
+    asl = mp.pack_age_status(age, st)
+    fl = jnp.where(jax.random.uniform(ks[3], (n,)) > 0.1, 5, 4).astype(jnp.int8)
+    flags = fl.reshape(n // mp.LANE, mp.LANE)  # LANE-compacted layout
+    sa = jnp.zeros((nc, cs, mp.LANE), jnp.int32)
+    sb = jnp.zeros((nc, cs, mp.LANE), jnp.int32)
+    g = jnp.full((nc, cs, mp.LANE), -120, jnp.int32)
+    bases = (jax.random.randint(ks[4], (n,), 0, n // arc_align, jnp.int32)
+             * arc_align).reshape(n, 1)
+    return hb, asl, flags, sa, sb, g, bases
+
+
+def test_rr_ring_rotated_tall_skinny_shards_match_full():
+    """The ring-rotated view build + LANE-compacted flags at TALL-SKINNY
+    shard shapes (rows >> columns — the sharded capacity regime where the
+    row budget binds, which the square-shape tests never exercise): each
+    shard's [N x nloc] program, run with its global column offset, must
+    reproduce the corresponding stripes of the full single-chip run
+    bit-for-bit — lanes, per-subject reductions, and the per-receiver
+    count partials.  The full run is itself oracle-pinned by the XLA
+    parity tests above, so shard == full implies shard == oracle."""
+    from gossipfs_tpu.config import AGE_CLAMP
+    from gossipfs_tpu.core.state import FAILED, MEMBER, UNKNOWN
+    from gossipfs_tpu.ops import merge_pallas as mp
+
+    n, fanout, align, shards = 2048, 16, 8, 4
+    nloc = n // shards  # 512 local columns against 2048 rows (4:1)
+    hb, asl, flags, sa, sb, g, bases = _rr_tall_skinny_inputs(
+        n, n, fanout, align)
+    kw = dict(fanout=fanout, member=int(MEMBER), unknown=int(UNKNOWN),
+              failed=int(FAILED), age_clamp=AGE_CLAMP, window=126,
+              t_fail=5, t_cooldown=12, block_r=128, arc_align=align,
+              resident=True, interpret=True)
+    full = mp.resident_round_blocked(bases, hb, asl, flags, sa, sb, g, **kw)
+    npc = nloc // 512  # stripes per shard
+    for s in range(shards):
+        sl = slice(s * npc, (s + 1) * npc)
+        shard = mp.resident_round_blocked(
+            bases, hb[sl], asl[sl], flags, sa[sl], sb[sl], g[sl],
+            col_offset=s * nloc, **kw)
+        for k, name in ((0, "hb"), (1, "asl"), (2, "cnt"), (3, "ndet")):
+            assert jnp.array_equal(shard[k], full[k][sl]), (s, name)
+        # fobs is per-subject (column-indexed): the shard's values are the
+        # full run's for its columns
+        assert jnp.array_equal(shard[4], full[4][sl]), (s, "fobs")
+        # per-stripe count partials: the shard's rcnt block is the full
+        # run's column block for its stripes
+        assert jnp.array_equal(
+            shard[5], full[5][:, s * npc * mp.LANE:(s + 1) * npc * mp.LANE]
+        ), (s, "rcnt")
+
+
+def test_rr_rotate_and_flags_layouts_bit_equal():
+    """A/B over the round-9 layouts at a tall-skinny shard shape: the
+    ring-rotated build vs the full-T fallback (rotate=False), and the
+    LANE-compacted vs lane-replicated flags input, must all produce
+    identical outputs — detection semantics stay bit-identical while the
+    hot path's VMEM row cost collapses."""
+    from gossipfs_tpu.config import AGE_CLAMP
+    from gossipfs_tpu.core.state import FAILED, MEMBER, UNKNOWN
+    from gossipfs_tpu.ops import merge_pallas as mp
+
+    n, nloc, fanout, align = 2048, 512, 16, 8
+    hb, asl, flags, sa, sb, g, bases = _rr_tall_skinny_inputs(
+        n, nloc, fanout, align)
+    kw = dict(fanout=fanout, member=int(MEMBER), unknown=int(UNKNOWN),
+              failed=int(FAILED), age_clamp=AGE_CLAMP, window=126,
+              t_fail=5, t_cooldown=12, block_r=128, arc_align=align,
+              resident=True, col_offset=512, interpret=True)
+    want = mp.resident_round_blocked(bases, hb, asl, flags, sa, sb, g,
+                                     rotate=True, **kw)
+    names = ("hb", "asl", "cnt", "ndet", "fobs", "rcnt")
+    # full-T + replicated-flags fallback layouts (the rotate=False probe
+    # fallback bench.py keeps for on-chip regressions)
+    got = mp.resident_round_blocked(bases, hb, asl, flags, sa, sb, g,
+                                    rotate=False, **kw)
+    for a, b, name in zip(got, want, names):
+        assert jnp.array_equal(a, b), f"rotate=False {name}"
+    # legacy lane-replicated flags input (the wrapper compacts it)
+    flags_rep = jnp.broadcast_to(flags.reshape(n, 1), (n, mp.LANE))
+    got = mp.resident_round_blocked(bases, hb, asl, flags_rep, sa, sb, g,
+                                    rotate=True, **kw)
+    for a, b, name in zip(got, want, names):
+        assert jnp.array_equal(a, b), f"replicated flags {name}"
+    # swar over the ring build at the same shard shape
+    got = mp.resident_round_blocked(bases, hb, asl, flags, sa, sb, g,
+                                    rotate=True, elementwise="swar", **kw)
+    for a, b, name in zip(got, want, names):
+        assert jnp.array_equal(a, b), f"swar ring {name}"
+
+
+def test_rr_scratch_budget_lint():
+    """Reconcile rr_align_scratch_bytes against the kernel's ACTUAL pltpu
+    scratch allocations (and the flags input block against the bytes
+    rr_flags_bytes charges), so the budget math can never silently drift
+    from the kernel again: the spec list the wrapper allocates from must
+    appear verbatim in the pallas_call, and its byte sum must equal the
+    budget formula's.  Also pins the headline acceptance: the rotated
+    layouts admit >= 512k rows at c_blk=512 (the old ~367k ceiling), and
+    the budget still rejects the shapes the round-5 reviews caught."""
+    import math
+
+    from gossipfs_tpu.config import AGE_CLAMP
+    from gossipfs_tpu.core.state import FAILED, MEMBER, UNKNOWN
+    from gossipfs_tpu.ops import merge_pallas as mp
+    from jax.experimental import pallas as pl
+
+    n, nloc, fanout, align, c_blk = 2048, 512, 16, 8, 512
+    hb, asl, flags, sa, sb, g, bases = _rr_tall_skinny_inputs(
+        n, nloc, fanout, align)
+    captured = {}
+    real = pl.pallas_call
+
+    def spy(kernel, **kwargs):
+        captured["scratch"] = kwargs.get("scratch_shapes")
+        captured["in_specs"] = kwargs.get("in_specs")
+        return real(kernel, **kwargs)
+
+    mp.pl.pallas_call = spy
+    try:
+        mp.resident_round_blocked(
+            bases, hb, asl, flags, sa, sb, g,
+            fanout=fanout, member=int(MEMBER), unknown=int(UNKNOWN),
+            failed=int(FAILED), age_clamp=AGE_CLAMP, window=126,
+            t_fail=5, t_cooldown=12, block_r=128, arc_align=align,
+            resident=True, interpret=True)
+    finally:
+        mp.pl.pallas_call = real
+
+    def key(s):
+        return (tuple(s.shape), jnp.dtype(s.dtype))
+
+    ch = mp.rr_view_chunk(n, c_blk, resident=True, arc_align=align)
+    specs = mp.rr_align_scratch_specs(n, fanout, c_blk, align, chunk=ch)
+    alloc = []
+    for s in captured["scratch"]:
+        try:
+            alloc.append(key(s))
+        except TypeError:
+            pass  # DMA semaphore specs carry no numeric dtype
+    for s in specs:
+        assert key(s) in alloc, f"budget charges {key(s)}, kernel lacks it"
+    spec_bytes = sum(math.prod(s.shape) * jnp.dtype(s.dtype).itemsize
+                     for s in specs)
+    assert spec_bytes == mp.rr_align_scratch_bytes(
+        n, fanout, c_blk, align, chunk=ch)
+    # ring-rotated: ONLY the int8 W buffer scales with rows — the bf16
+    # ring + head are fixed-size (chunk + halo geometry)
+    nb, nw = n // align, fanout // align
+    assert spec_bytes == (nb * c_blk                      # W
+                          + ((ch // align) + 2 * (nw - 1)) * c_blk * 2)
+    # flags input block: the LANE-compacted [N/LANE, LANE] layout, at the
+    # bytes rr_flags_bytes charges
+    fspec = captured["in_specs"][2]
+    assert tuple(fspec.block_shape) == (n // mp.LANE, mp.LANE)
+    assert mp.rr_flags_bytes(n, c_blk, block_r=128, resident=True,
+                             arc_align=align) == n
+    # acceptance: the rotated layouts lift the sharded aligned rr row
+    # ceiling past 512k rows at c_blk=512 (16,384 local columns — the
+    # 16-chip anchor shard width); the round-5 layouts cap out below 393k
+    assert mp.rr_supported(524288, 24, 512, 16384, arc_align=8, block_r=512)
+    assert mp.rr_supported(786432, 24, 512, 16384, arc_align=8, block_r=512)
+    assert not mp.rr_supported(393216, 24, 512, 16384, arc_align=8,
+                               block_r=512, rotate=False)
+    # wider stripes at existing anchors: N=262,144 now admits c_blk=2048
+    assert mp.rr_supported(262144, 24, 2048, 16384, arc_align=8, block_r=512)
+
+
 @pytest.mark.parametrize("topology,rr_resident,arc_align", [
     ("random", "off", 1),
     ("random", "on", 1),

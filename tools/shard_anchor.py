@@ -1,19 +1,26 @@
-"""Measure ONE chip's share of the sharded N=131,072 rr round, for real.
+"""Measure ONE chip's share of the sharded capacity-class rr round, for real.
 
 The v5e-8 config-4 projection (BASELINE.md) rests on the sharded
 resident-round program: each chip runs the SAME rr kernel over
-[N global rows x N/8 local columns], and the only cross-chip traffic is
-an [N]-vector psum (< 2 MB/round).  This tool runs exactly that
+[N global rows x N/shards local columns], and the only cross-chip traffic
+is an [N]-vector psum (< 2 MB/round).  This tool runs exactly that
 per-chip program — full-N-row stripes, a shard's column count, the
 shard's global column offset — on the single real chip and times it,
 replacing the compute-scaling extrapolation with a measured per-chip
-anchor.  The 512-wide stripe (round 5) is what admits N=131,072 rows:
-N x c_blk = 67 MB fits the 72 MB VMEM stripe budget.
+anchor.  Since round 9 the ring-rotated view build + LANE-compacted
+flags bound the row budget (only the int8 W gather buffer scales with
+rows), which is what admits the >= 512k-row shapes at c_blk=512 and the
+wider stripes at every anchor.
 
     JAX_PLATFORMS=axon python tools/shard_anchor.py \
         --n 131072 --shards 8 --block-c 512
 
-Round-5 artifact: see BASELINE.md's projection section.
+    # the whole capacity ladder in ONE invocation (one JSON object out;
+    # rows are measured on a TPU, budget-verified otherwise):
+    JAX_PLATFORMS=axon python tools/shard_anchor.py --ladder
+    JAX_PLATFORMS=cpu  python tools/shard_anchor.py --ladder --budget-only
+
+Round-5 artifact: ANCHORS_r05.json; round-9: ANCHORS_r09.json.
 """
 
 from __future__ import annotations
@@ -28,21 +35,27 @@ import functools
 import json
 import time
 
+# The capacity ladder --ladder sweeps in one invocation (previously
+# hand-run per-N): (n, shards, block_c, block_r, fanout).  The top rows
+# exist only since the round-9 rotated layouts; the widened-stripe
+# variants of existing anchors come first so a contended TPU window still
+# re-anchors the known shapes before attempting the frontier.
+LADDER = [
+    (65_536, 8, 1024, 512, 16),
+    (98_304, 8, 2048, 512, 24),
+    (131_072, 8, 1024, 512, 24),
+    (196_608, 16, 1024, 512, 24),
+    (262_144, 16, 2048, 512, 24),   # wider stripe the rotated build admits
+    (327_680, 16, 1024, 512, 24),   # ditto (c512 was the r05 edge)
+    (393_216, 16, 512, 512, 24),    # past the old ~367k row ceiling
+    (524_288, 16, 512, 512, 24),    # the round-9 row-budget target
+    (786_432, 16, 512, 512, 24),    # headroom: budget admits ~1.5M rows
+]
 
-def main(argv=None):
-    p = argparse.ArgumentParser()
-    p.add_argument("--n", type=int, default=131_072)
-    p.add_argument("--shards", type=int, default=8)
-    p.add_argument("--block-c", type=int, default=512)
-    p.add_argument("--block-r", type=int, default=512)
-    p.add_argument("--arc-align", type=int, default=8)
-    p.add_argument("--fanout", type=int, default=24)
-    p.add_argument("--rounds", type=int, default=30)
-    p.add_argument("--reps", type=int, default=3)
-    p.add_argument("--shard", type=int, default=0,
-                   help="which shard's column offset to run")
-    args = p.parse_args(argv)
 
+def measure(n: int, shards: int, block_c: int, block_r: int, fanout: int,
+            arc_align: int, rounds: int, reps: int, shard: int = 0) -> dict:
+    """Time one shard's rr program on the local chip; returns the row."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -51,13 +64,13 @@ def main(argv=None):
     from gossipfs_tpu.core.state import FAILED, MEMBER, UNKNOWN
     from gossipfs_tpu.ops import merge_pallas as mp
 
-    n, lane = args.n, mp.LANE
-    nloc = n // args.shards
-    nc, cs = nloc // args.block_c, args.block_c // lane
-    if not mp.rr_supported(n, args.fanout, args.block_c, nloc,
-                       arc_align=args.arc_align):
+    lane = mp.LANE
+    nloc = n // shards
+    nc, cs = nloc // block_c, block_c // lane
+    if not mp.rr_supported(n, fanout, block_c, nloc, arc_align=arc_align,
+                           block_r=block_r):
         raise SystemExit(f"shape not rr-admissible: n={n}, nloc={nloc}, "
-                         f"c_blk={args.block_c}")
+                         f"c_blk={block_c}")
     key = jax.random.PRNGKey(0)
     ks = jax.random.split(key, 4)
 
@@ -88,19 +101,21 @@ def main(argv=None):
     asl = jnp.zeros((nc, n, cs, lane), jnp.int8)
     for j in range(nc):
         asl = put(asl, mk_asl(jax.random.fold_in(ks[1], j)), j)
-    flags = jnp.broadcast_to(jnp.int8(1 + 4), (n, lane)).astype(jnp.int8)
+    # LANE-compacted flags (1 B/row — the round-9 layout the kernel runs)
+    flags = jnp.broadcast_to(jnp.int8(1 + 4), (n // lane, lane)
+                             ).astype(jnp.int8)
     sa = jnp.zeros((nc, cs, lane), jnp.int32)
     sb = jnp.zeros((nc, cs, lane), jnp.int32)
     g = jnp.full((nc, cs, lane), -120, jnp.int32)
-    bases = (jax.random.randint(ks[3], (n,), 0, n // args.arc_align,
-                                jnp.int32) * args.arc_align).reshape(n, 1)
+    bases = (jax.random.randint(ks[3], (n,), 0, n // arc_align,
+                                jnp.int32) * arc_align).reshape(n, 1)
 
     kern = functools.partial(
         mp.resident_round_blocked,
-        fanout=args.fanout, member=int(MEMBER), unknown=int(UNKNOWN),
+        fanout=fanout, member=int(MEMBER), unknown=int(UNKNOWN),
         failed=int(FAILED), age_clamp=AGE_CLAMP, window=126,
-        t_fail=5, t_cooldown=12, block_r=args.block_r,
-        arc_align=args.arc_align, col_offset=args.shard * nloc,
+        t_fail=5, t_cooldown=12, block_r=block_r,
+        arc_align=arc_align, col_offset=shard * nloc,
     )
 
     # donate the lanes (matching the real sharded runner): without
@@ -112,29 +127,92 @@ def main(argv=None):
             hb, asl = carry
             out = kern(bases, hb, asl, flags, sa, sb, g)
             return (out[0], out[1]), out[3].sum()
-        (hb, asl), s = lax.scan(step, (hb, asl), None, length=args.rounds)
+        (hb, asl), s = lax.scan(step, (hb, asl), None, length=rounds)
         return hb, asl, s
 
     hb, asl, s = run(hb, asl)
     jax.block_until_ready(asl)
     best = float("inf")
-    for _ in range(args.reps):
+    for _ in range(reps):
         t0 = time.perf_counter()
         hb, asl, s = run(hb, asl)
         jax.block_until_ready(asl)
         best = min(best, time.perf_counter() - t0)
         time.sleep(2.0)
-    ms = best / args.rounds * 1e3
-    print(json.dumps({
-        "n_global": n, "shards": args.shards, "local_cols": nloc,
-        "entries_per_chip": n * nloc, "merge_block_c": args.block_c,
-        "fanout": args.fanout, "arc_align": args.arc_align,
+    ms = best / rounds * 1e3
+    return {
+        "n_global": n, "shards": shards, "local_cols": nloc,
+        "entries_per_chip": n * nloc, "merge_block_c": block_c,
+        "fanout": fanout, "arc_align": arc_align,
         "ms_per_round_per_chip": round(ms, 2),
         "implied_rounds_per_sec_v5e8": round(1e3 / ms, 2),
         "note": "per-chip share of the sharded rr round, measured on one "
                 "real chip; the sharded program's only cross-chip traffic "
                 "is an [N]-vector psum (< 2 MB/round over ICI)",
-    }))
+    }
+
+
+def run_ladder(args) -> dict:
+    """The full capacity ladder in one invocation: every shape's
+    row-budget verdict (ring-rotated + compacted-flags layouts), plus
+    measured per-chip timings when a TPU is reachable."""
+    import jax
+
+    from gossipfs_tpu.parallel.mesh import rr_shard_admissible
+
+    on_tpu = jax.default_backend() == "tpu"
+    rows = []
+    for n, shards, block_c, block_r, fanout in LADDER:
+        row = rr_shard_admissible(n, shards, block_c, fanout,
+                                  arc_align=args.arc_align, block_r=block_r)
+        row["merge_block_r"] = block_r
+        if row["admissible"] and on_tpu and not args.budget_only:
+            try:
+                row.update(measure(n, shards, block_c, block_r, fanout,
+                                   args.arc_align, args.rounds, args.reps))
+                row["measured"] = True
+            except Exception as e:  # noqa: BLE001 — keep laddering
+                row["measured"] = False
+                row["error"] = str(e)[:200]
+        else:
+            row["measured"] = False
+        rows.append(row)
+    return {
+        "metric": "sharded rr capacity ladder (ring-rotated view build + "
+                  "LANE-compacted flags row budget; measured per-chip "
+                  "where a TPU is reachable, budget-verified otherwise)",
+        "backend": jax.default_backend(),
+        "ladder": rows,
+    }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--n", type=int, default=131_072)
+    p.add_argument("--shards", type=int, default=8)
+    p.add_argument("--block-c", type=int, default=512)
+    p.add_argument("--block-r", type=int, default=512)
+    p.add_argument("--arc-align", type=int, default=8)
+    p.add_argument("--fanout", type=int, default=24)
+    p.add_argument("--rounds", type=int, default=30)
+    p.add_argument("--reps", type=int, default=3)
+    p.add_argument("--shard", type=int, default=0,
+                   help="which shard's column offset to run")
+    p.add_argument("--ladder", action="store_true",
+                   help="emit the full capacity-ladder JSON in one "
+                        "invocation instead of one hand-run row per N")
+    p.add_argument("--budget-only", action="store_true",
+                   help="with --ladder: admissibility + budget bytes only "
+                        "(no device timing; implied off-TPU)")
+    args = p.parse_args(argv)
+
+    if args.ladder:
+        print(json.dumps(run_ladder(args)))
+        return
+
+    print(json.dumps(measure(args.n, args.shards, args.block_c,
+                             args.block_r, args.fanout, args.arc_align,
+                             args.rounds, args.reps, shard=args.shard)))
 
 
 if __name__ == "__main__":

@@ -3,8 +3,14 @@
 Times the raw kernel (100-round scan, carried lanes) with stages stubbed
 out, isolating each stage's cost in the CURRENT build:
 
-    vtick  - view-build tick + view encode replaced by a raw copy
-    wmax   - the arc windowed row-max skipped
+    vtick  - view-build tick + view encode replaced by a raw copy (also
+             skips the aligned group max and the ring flush that ride it)
+    wmax   - the arc window work skipped entirely (group max + ring
+             flush / full-T pass)
+    wring  - aligned arcs only: the group max still rides the view build,
+             but the ring-rotated W flush (per-chunk pair-max + carry +
+             wrap close) is skipped — isolates the rotated build's own
+             pass, the stage the round-9 redesign added
     gather - the per-receiver row gather skipped
     epi    - merge epilogue + every reduction replaced by a passthrough
     rcnt   - the per-receiver member-count side output zeroed
@@ -54,8 +60,10 @@ def build_inputs(n, c_blk, fanout, key, arc_align=1):
     age = jax.random.randint(ks[1], (nc, n, cs, LANE), 0, 40, jnp.int32)
     st = jax.random.randint(ks[2], (nc, n, cs, LANE), 0, 3, jnp.int32)
     asl = merge_pallas.pack_age_status(age, st)
+    # active + alive, LANE-compacted (the round-9 production layout; the
+    # wrapper expands it for blockings that need the replicated form)
     flags = jnp.broadcast_to(
-        jnp.int8(1 + 4), (n, LANE)).astype(jnp.int8)  # active + alive
+        jnp.int8(1 + 4), (n // LANE, LANE)).astype(jnp.int8)
     sa = jnp.zeros((nc, cs, LANE), jnp.int32)
     sb = jnp.zeros((nc, cs, LANE), jnp.int32)
     g = jnp.full((nc, cs, LANE), -120, jnp.int32)
@@ -71,7 +79,8 @@ def build_inputs(n, c_blk, fanout, key, arc_align=1):
 
 
 def time_stub(n, c_blk, block_r, fanout, stub, rounds, reps,
-              arc_align=1, elementwise="lanes", interpret=False):
+              arc_align=1, elementwise="lanes", interpret=False,
+              rotate=True):
     hb, asl, flags, sa, sb, g, bases = build_inputs(
         n, c_blk, fanout, jax.random.PRNGKey(0), arc_align=arc_align)
 
@@ -81,7 +90,7 @@ def time_stub(n, c_blk, block_r, fanout, stub, rounds, reps,
         failed=int(FAILED), age_clamp=AGE_CLAMP, window=126,
         t_fail=5, t_cooldown=12, block_r=block_r, resident=True,
         arc_align=arc_align, elementwise=elementwise, interpret=interpret,
-        _stub=stub,
+        rotate=rotate, _stub=stub,
     )
 
     @jax.jit
@@ -117,11 +126,22 @@ def main():
                    default="lanes")
     p.add_argument("--interpret", action="store_true",
                    help="interpreter-mode kernel (off-TPU tool validation)")
-    p.add_argument("--stubs", nargs="*", default=[
-        "", "rcnt", "gather", "wmax,gather", "epi", "epi,rcnt",
-        "vtick", "vtick,wmax,gather,epi,rcnt",
-    ])
+    p.add_argument("--rr-rotate", choices=("auto", "off"), default="auto",
+                   help="A/B the round-9 ring-rotated build + compacted "
+                        "flags (auto) against the round-5 full-T/"
+                        "replicated layouts (off) — same bits")
+    p.add_argument("--stubs", nargs="*", default=None)
     args = p.parse_args()
+    if args.stubs is None:
+        args.stubs = [
+            "", "rcnt", "gather", "wmax,gather", "epi", "epi,rcnt",
+            "vtick", "vtick,wmax,gather,epi,rcnt",
+        ]
+        if args.arc_align > 1 and args.rr_rotate != "off":
+            # the rotated-build stage stub only exists on aligned arcs
+            # running the ring build — under --rr-rotate off it would be
+            # a no-op row mislabelled as a stage cost
+            args.stubs.insert(3, "wring")
     fanout = max(1, args.n.bit_length() - 1)
     if args.arc_align > 1:
         # round fanout UP to an arc_align multiple, as the production
@@ -134,11 +154,13 @@ def main():
                        stub, args.rounds, args.reps,
                        arc_align=args.arc_align,
                        elementwise=args.elementwise,
-                       interpret=args.interpret)
+                       interpret=args.interpret,
+                       rotate=args.rr_rotate != "off")
         print(json.dumps({
             "stub": stub or "(full)",
             "ms_per_round": round(el / args.rounds * 1e3, 3),
             "elementwise": args.elementwise,
+            "rr_rotate": args.rr_rotate,
             "backend": ("interpret/" if args.interpret else "")
             + jax.default_backend(),
         }), flush=True)

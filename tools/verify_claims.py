@@ -126,6 +126,22 @@ CLAIMS = {
         ["env", "JAX_PLATFORMS=cpu", sys.executable, "-m",
          "gossipfs_tpu.bench.curves", "--suspicion", "--ns", "1024"],
         _suspicion_ok, 1.0, 0.0),
+    # round-9 row-budget claim (CPU-pinned; the scratch-budget lint test
+    # reconciles the same math against the kernel's real allocations):
+    # the ring-rotated view build + LANE-compacted flags admit the whole
+    # capacity ladder — including >= 512k rows at c_blk=512, past the
+    # round-5 ~367k ceiling — within the 112 MB aligned row budget
+    "rr_row_budget": (
+        ["env", "JAX_PLATFORMS=cpu", sys.executable,
+         "tools/shard_anchor.py", "--ladder", "--budget-only"],
+        lambda d: 1.0 if (
+            all(r["admissible"] for r in d["ladder"])
+            and any(r["n_global"] >= 524_288
+                    and r["merge_block_c"] == 512 for r in d["ladder"])
+            and all(r["row_budget_bytes"] <= r["budget_limit_bytes"]
+                    for r in d["ladder"])
+        ) else 0.0,
+        1.0, 0.0),
 }
 
 
