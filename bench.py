@@ -122,7 +122,21 @@ def probe_swar(timeout_s: float = 600.0) -> bool:
         return False
 
 
-def main() -> None:
+def main(argv=None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--trace", type=str, default=None, metavar="PATH",
+                    help="write the measured run's flight-recorder event "
+                         "stream (obs/schema.py JSONL) to PATH — decoded "
+                         "post-scan from outputs the bench reads anyway, "
+                         "so the timed device program is untouched")
+    ap.add_argument("--xprof", type=str, default=None, metavar="DIR",
+                    help="capture a jax.profiler (xprof) trace of ONE "
+                         "extra run after sampling (obs/profile.py); "
+                         "open DIR in Perfetto/TensorBoard or reduce "
+                         "with utils/profiling.op_breakdown")
+    args = ap.parse_args(argv)
     use_tpu = os.environ.get("JAX_PLATFORMS", "") == "axon" and probe_tpu()
     if not use_tpu:
         os.environ["JAX_PLATFORMS"] = "cpu"
@@ -256,6 +270,30 @@ def main() -> None:
     )
     best = rates[-1]
     platform = jax.devices()[0].platform
+
+    trace_events = None
+    if args.trace:
+        # post-scan decode of the LAST sample's outputs — the recorder
+        # consumes arrays summarize-style reads already make; the timed
+        # program above never saw the flag
+        from gossipfs_tpu.obs.recorder import write_trace
+
+        trace_events = write_trace(
+            args.trace, pr, mc, n=n, source="bench", alive=st.alive,
+            suspicion=cfg.suspicion is not None,
+            elementwise=cfg.elementwise, rr_rotate=cfg.rr_rotate,
+            merge_kernel=cfg.merge_kernel, crash_rate=CRASH_RATE,
+        )
+    if args.xprof:
+        # one EXTRA run under the profiler (obs/profile.py) so the trace
+        # never contaminates the sampled rates
+        from gossipfs_tpu.obs.profile import maybe_xprof
+
+        with maybe_xprof(args.xprof):
+            st2, _, _ = run_rounds(state, cfg, ROUNDS, key,
+                                   crash_rate=CRASH_RATE)
+            jax.block_until_ready(st2)
+
     print(
         json.dumps(
             {
@@ -279,6 +317,9 @@ def main() -> None:
                 "unit": "rounds/s",
                 # reference heartbeat loop = 1 round/s of wall clock
                 "vs_baseline": round(median, 2),
+                **({"trace": args.trace, "trace_events": trace_events}
+                   if args.trace else {}),
+                **({"xprof": args.xprof} if args.xprof else {}),
             }
         )
     )

@@ -40,7 +40,8 @@ TRACK = 8
 
 def sweep(ns=DEFAULT_NS, rounds=ROUNDS, crash_rate=0.01, seed=0,
           topology="random", donate=False, hb_dtype="int16",
-          time_rounds=False, arc_align=1, fanout=None) -> dict:
+          time_rounds=False, arc_align=1, fanout=None,
+          trace=None) -> dict:
     """``topology`` sweeps "random" (iid fanout) or "random_arc" (windowed
     arc senders) — the arc rows must match the iid rows within noise, which
     is the protocol-equivalence evidence for the fast arc merge kernel.
@@ -50,7 +51,10 @@ def sweep(ns=DEFAULT_NS, rounds=ROUNDS, crash_rate=0.01, seed=0,
     tracked membership entry) that pushes the frontier to N=49,152.
     ``time_rounds=True`` adds a measured rounds/s per row (a second run on
     a fresh state, so compile time and the donated first state are
-    excluded)."""
+    excluded).  ``trace`` writes each row's flight-recorder event stream
+    (obs/schema.py JSONL; ``tools/timeline.py`` re-derives this row's
+    TTD/FPR from it alone) — to ``trace`` itself for a single N, to
+    ``{trace}.n{N}`` per row otherwise."""
     import time as _time
 
     from gossipfs_tpu.core.rounds import run_rounds_donate
@@ -87,6 +91,17 @@ def sweep(ns=DEFAULT_NS, rounds=ROUNDS, crash_rate=0.01, seed=0,
             crash_only_events=True,
         )
         report = summarize(carry, per_round, crash_rounds)
+        trace_path = None
+        if trace:
+            from gossipfs_tpu.obs.recorder import write_trace
+
+            trace_path = trace if len(ns) == 1 else f"{trace}.n{n}"
+            write_trace(
+                trace_path, per_round, carry, n=n, source="curves",
+                crash_rounds=crash_rounds, alive=final.alive,
+                suspicion=cfg.suspicion is not None,
+                topology=topology, fanout=cfg.fanout,
+            )
         rps = None
         if time_rounds:
             # free the measurement run's final state before allocating the
@@ -120,6 +135,7 @@ def sweep(ns=DEFAULT_NS, rounds=ROUNDS, crash_rate=0.01, seed=0,
                 "ttd_converged_median": statistics.median(ttd_c) if ttd_c else None,
                 "ttd_converged_max": max(ttd_c) if ttd_c else None,
                 "false_positive_rate": report.false_positive_rate,
+                **({"trace": trace_path} if trace_path else {}),
             }
         )
     return {
@@ -425,6 +441,10 @@ def main(argv=None) -> None:
                    help="scenario-engine netsplit rows (split-brain "
                         "duration, view divergence, reconvergence) "
                         "instead of the TTD/FPR sweep")
+    p.add_argument("--trace", type=str, default=None, metavar="PATH",
+                   help="write each row's flight-recorder event stream "
+                        "(obs/ JSONL; analyze with tools/timeline.py) — "
+                        "TTD/FPR sweep rows only")
     p.add_argument("--out", type=str, default=None)
     args = p.parse_args(argv)
     if args.partition:
@@ -440,7 +460,7 @@ def main(argv=None) -> None:
                                hb_dtype=args.hb_dtype,
                                time_rounds=args.time_rounds,
                                arc_align=args.arc_align,
-                               fanout=args.fanout))
+                               fanout=args.fanout, trace=args.trace))
     print(doc)
     if args.out:
         with open(args.out, "w") as f:

@@ -42,7 +42,8 @@ def pad_quantum(block_c: int, topology: str) -> int:
 def run(n: int, rounds: int, block_c: int, crash_at: int, track: int,
         crash_rate: float, seed: int, topology: str, block_r: int,
         arc_align: int = 1, fanout: int | None = None,
-        elementwise: str = "lanes", rr_rotate: str = "auto") -> dict:
+        elementwise: str = "lanes", rr_rotate: str = "auto",
+        trace: str | None = None) -> dict:
     import jax
     import numpy as np
 
@@ -86,19 +87,34 @@ def run(n: int, rounds: int, block_c: int, crash_at: int, track: int,
             hb4, as4, alive, hb_base, rnd, cfg, key, events,
             crash_rate, churn_ok, counts0=counts,
         )
-        # lanes stay on device; only the metrics leave
-        return out[6], out[7]
+        # lanes stay on device; only the [N]-vector liveness and the
+        # metrics leave (alive feeds the flight recorder's ground truth)
+        return out[2], out[6], out[7]
 
     key = jax.random.PRNGKey(seed)
-    mcarry, per_round = go(key, events, churn_ok)
+    alive, mcarry, per_round = go(key, events, churn_ok)
     jax.block_until_ready(mcarry)
     t0 = time.perf_counter()
-    mcarry, per_round = go(key, events, churn_ok)
+    alive, mcarry, per_round = go(key, events, churn_ok)
     jax.block_until_ready(mcarry)
     elapsed = time.perf_counter() - t0
 
     report = summarize(mcarry, per_round, crash_rounds,
                        n_effective=n if padded else None)
+    trace_events = None
+    if trace:
+        # post-scan decode (obs/recorder.py): consumes the outputs the
+        # summarize call above already transferred — the timed scan and
+        # the rr kernel never see the flag
+        from gossipfs_tpu.obs.recorder import write_trace
+
+        trace_events = write_trace(
+            trace, per_round, mcarry, n=n_pad, source="frontier",
+            crash_rounds=crash_rounds, alive=alive,
+            n_effective=n if padded else None,
+            topology=topology, merge_block_c=block_c,
+            elementwise=elementwise, rr_rotate=rr_rotate,
+        )
     ttd_f = [v for v in report.ttd_first.values() if v >= 0]
     ttd_c = [v for v in report.ttd_converged.values() if v >= 0]
     import statistics
@@ -137,6 +153,7 @@ def run(n: int, rounds: int, block_c: int, crash_at: int, track: int,
         "false_positive_rate": report.false_positive_rate,
         "seconds_per_round": round(elapsed / rounds, 4),
         "rounds_per_sec": round(rounds / elapsed, 2),
+        **({"trace": trace, "trace_events": trace_events} if trace else {}),
     }
 
 
@@ -162,13 +179,17 @@ def main(argv=None) -> None:
                    help="ring-rotated view build + LANE-compacted flags "
                         "(round 9) vs the full-T/replicated layouts — "
                         "same bits, different VMEM row cost")
+    p.add_argument("--trace", type=str, default=None, metavar="PATH",
+                   help="write the run's flight-recorder event stream "
+                        "(obs/ JSONL; analyze with tools/timeline.py) — "
+                        "decoded post-scan, the rr kernel is untouched")
     args = p.parse_args(argv)
     print(json.dumps(run(args.n, args.rounds, args.block_c, args.crash_at,
                          args.track, args.crash_rate, args.seed,
                          args.topology, args.block_r,
                          arc_align=args.arc_align, fanout=args.fanout,
                          elementwise=args.elementwise,
-                         rr_rotate=args.rr_rotate)))
+                         rr_rotate=args.rr_rotate, trace=args.trace)))
 
 
 if __name__ == "__main__":

@@ -220,6 +220,18 @@ def main(argv=None) -> None:
     p.add_argument("--only", nargs="*", default=None)
     args = p.parse_args(argv)
 
+    # self-describing header row (obs.schema.ROUNDPROF_SCHEMA): committed
+    # ROUNDPROF_*.jsonl artifacts name their schema, tool, and shape, so
+    # old and new profiles are distinguishable and tools/timeline.py can
+    # ingest them; per-row elementwise/rr_rotate stay authoritative
+    from gossipfs_tpu.obs import schema as obs_schema
+
+    print(json.dumps({
+        "schema": obs_schema.ROUNDPROF_SCHEMA, "tool": "roundprof",
+        "n": args.n, "rounds": args.rounds,
+        "backend": jax.default_backend(),
+    }), flush=True)
+
     rows = {}
     for name, cfg in variants(args.n).items():
         if args.only and name not in args.only:
@@ -229,6 +241,7 @@ def main(argv=None) -> None:
             "ms_per_round": round(per_round * 1e3, 3),
             "rounds_per_sec": round(1.0 / per_round, 1),
             "elementwise": cfg.elementwise,
+            "rr_rotate": cfg.rr_rotate,
             "backend": jax.default_backend(),
             **bandwidth_row(cfg, per_round),
         }

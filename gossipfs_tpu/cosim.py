@@ -79,6 +79,51 @@ class CoSim:
         # partition (see _reachable)
         self.scenario = None
         self._scn_round0 = 0
+        # flight recorder (obs/): forwarded to the detector's protocol
+        # seams; the control plane adds its own events (election,
+        # replica_put/repair) so one stream carries the WHOLE
+        # crash -> ... -> repair timeline
+        self._recorder = None
+
+    # -- observability (obs/) ----------------------------------------------
+    def attach_recorder(self, recorder) -> None:
+        """Arm an obs.FlightRecorder on both planes: the detector's
+        lifecycle events plus the SDFS control plane's."""
+        det = self.detector
+        if hasattr(det, "attach_recorder"):
+            det.attach_recorder(recorder)
+        self._recorder = recorder
+
+    def _rec(self, kind: str, subject: int = -1, observer: int = -1,
+             **detail) -> None:
+        if self._recorder is None:
+            return
+        from gossipfs_tpu.obs.schema import Event
+
+        self._recorder.emit(Event(round=self.round, observer=observer,
+                                  subject=subject, kind=kind,
+                                  detail=detail))
+
+    def vitals(self) -> dict:
+        """The uniform counter set (obs.schema.VITALS_FIELDS) for the
+        CLI ``metrics`` verb and the shim's ``Vitals`` RPC.  The sim
+        knows ground truth, so every field is live; suspicion counters
+        appear only when the lifecycle is armed (consumers render the
+        absence as n/a)."""
+        doc = {
+            "engine": "sim",
+            "round": self.round,
+            "n_alive": len(self.detector.alive_nodes()),
+            "detections": len(self.events),
+            "false_positives": sum(
+                1 for e in self.events if e.false_positive),
+        }
+        sus = self.suspicion_status()
+        if sus is not None:
+            doc.update({k: sus[k] for k in (
+                "suspects_now", "suspects_entered", "refutations",
+                "confirms", "fp_suppressed") if k in sus})
+        return doc
 
     def load_scenario(self, scenario) -> None:
         """Arm a scenarios.FaultScenario on BOTH planes: gossip transport
@@ -188,6 +233,8 @@ class CoSim:
                         kind="election",
                         node=self.cluster.master_node,  # the winner announces
                     )
+                    self._rec("election", subject=self.cluster.master_node,
+                              was=old_master)
             due = [r for r in self._recover_at if r <= now]
             if due:
                 self._recover_at = [r for r in self._recover_at if r > now]
@@ -202,6 +249,9 @@ class CoSim:
                         kind="re_replicate",
                         node=plan.source,
                     )
+                    self._rec("replica_repair", observer=plan.source,
+                              file=plan.file, version=plan.version,
+                              targets=list(plan.new_nodes))
 
     # -- client verbs delegated with sim time ------------------------------
     def put(self, name: str, data: bytes, confirm=None) -> bool:
@@ -213,6 +263,9 @@ class CoSim:
             kind="put",
             node=self.cluster.master_node,
         )
+        if ok:
+            self._rec("replica_put", observer=self.cluster.master_node,
+                      file=name)
         return ok
 
     def get(self, name: str) -> bytes | None:

@@ -223,6 +223,20 @@ class Cluster:
                 acked.append(idx)
         return acked
 
+    def vitals(self) -> list[dict]:
+        """Collect every live node's uniform vitals row (the `Vitals`
+        RPC; obs.schema.VITALS_FIELDS).  Dead nodes are skipped — their
+        absence, not a zeroed row, is the signal."""
+        lines: list[dict] = []
+        for idx, proc in self.procs.items():
+            if proc.poll() is not None:
+                continue
+            try:
+                lines += self.client(idx).call("Vitals").get("lines") or []
+            except Exception:
+                pass
+        return lines
+
     def scenario_status(self) -> list[dict]:
         """Collect every node's ScenarioStatus line (skipping dead nodes)."""
         lines: list[dict] = []

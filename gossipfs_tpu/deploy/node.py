@@ -50,6 +50,7 @@ import time
 import grpc
 
 from gossipfs_tpu.detector.udp import CMD_SEP, UdpNode
+from gossipfs_tpu.obs import schema as obs_schema
 from gossipfs_tpu.sdfs.store import LocalStore
 from gossipfs_tpu.sdfs.types import (
     RECOVERY_DELAY,          # periods after detection before re-replication
@@ -80,6 +81,15 @@ class _Env:
 
     def record_detection(self, observer: int, subject_addr: str) -> None:
         self._daemon.on_detection(subject_addr)
+
+    def record_obs(self, kind: str, observer: int, subject_addr: str,
+                   **detail) -> None:
+        """UdpNode's flight-recorder seam (obs/): in the deployment the
+        recorder IS the node's structured log — suspect/refute/remove
+        events land in node<i>.log as schema rows, so merging the
+        per-node logs (tools/timeline.py) reconstructs the lifecycle."""
+        self._daemon.log(kind, f"{kind} {subject_addr}",
+                         subject=self._daemon.addr_to_idx(subject_addr))
 
     def message_allowed(self, src: int, peer_addr: str) -> bool:
         """UdpNode._send scenario hook: the daemon evaluates the rule
@@ -139,6 +149,16 @@ class NodeDaemon:
         # windows.
         self._scn_runtime = None
         self._scn_round0 = 0
+        # vitals counter: detections this daemon's own detector fired
+        # (drain-free — the Vitals RPC reports cumulative counts)
+        self._det_total = 0
+        # the per-node log IS a schema event stream (obs/schema.py): a
+        # self-describing header row opens it, and every log site's kind
+        # rewrites through LOG_KIND_MAP on write
+        if not self.log_path.exists() or self.log_path.stat().st_size == 0:
+            with open(self.log_path, "a") as f:
+                f.write(json.dumps(obs_schema.header(
+                    "deploy-node", n=n, node=idx)) + "\n")
 
     # -- scenario engine ---------------------------------------------------
 
@@ -160,14 +180,29 @@ class NodeDaemon:
 
     # -- plumbing ----------------------------------------------------------
 
+    def addr_to_idx(self, addr: str) -> int:
+        try:
+            return int(addr.rsplit(":", 1)[1]) - self.udp_base
+        except (ValueError, IndexError):
+            return -1
+
     def log(self, kind: str, message: str, **fields) -> None:
         # ``round`` is the node's OWN protocol-round clock (heartbeat
         # ticks, detector/udp.py UdpNode.rounds): latency read off the
         # log is then in protocol rounds — it stalls with the process
-        # under host load instead of widening like wall-clock windows
+        # under host load instead of widening like wall-clock windows.
+        # ``kind`` rewrites through the schema map (obs/schema.py), so
+        # node<i>.log is a flight-recorder stream the timeline analyzer
+        # merges directly; unmapped operational kinds pass through and
+        # must be listed in UNEXPORTED_LOG_KINDS (the lint test).  The
+        # original site name survives as ``site`` — the distributed-grep
+        # surface keeps matching the historical kind strings.
+        skind = obs_schema.LOG_KIND_MAP.get(kind, kind)
         entry = {"ts": round(time.time(), 3), "node": self.idx,
                  "round": self.udp.rounds,
-                 "kind": kind, "message": message, **fields}
+                 "kind": skind, "message": message, **fields}
+        if skind != kind:
+            entry["site"] = kind
         with open(self.log_path, "a") as f:
             f.write(json.dumps(entry) + "\n")
 
@@ -201,6 +236,7 @@ class NodeDaemon:
         port = int(subject_addr.rsplit(":", 1)[1])
         subject = port - self.udp_base
         self._lost_at.setdefault(subject, time.monotonic())
+        self._det_total += 1
         self.log("detect", f"detected failure of node {subject}",
                  subject=subject)
 
@@ -585,7 +621,7 @@ class NodeDaemon:
         payload = base64.b64decode(req.get("data_b64", "") or "")
         if not payload:
             self._scn_runtime = None
-            self.log("scenario", "scenario cleared")
+            self.log("scenario_clear", "scenario cleared")
             return {"ok": True}
         try:
             sc = FaultScenario.from_json(payload.decode())
@@ -642,7 +678,7 @@ class NodeDaemon:
         payload = base64.b64decode(req.get("data_b64", "") or "")
         if not payload:
             self._env.suspicion = None
-            self.log("suspicion", "suspicion cleared")
+            self.log("suspicion_clear", "suspicion cleared")
             return {"ok": True}
         try:
             params = SuspicionParams.from_json(payload.decode())
@@ -653,6 +689,28 @@ class NodeDaemon:
         self.log("suspicion", f"armed suspicion t_suspect={params.t_suspect}",
                  t_suspect=params.t_suspect)
         return {"ok": True}
+
+    def Vitals(self, req, ctx):
+        """THIS node's uniform vitals row (obs.schema.VITALS_FIELDS),
+        riding GrepReply Struct lines like ScenarioStatus.  Ground-truth
+        fields the per-process deployment cannot know (n_alive,
+        false_positives, fp_suppressed — other processes' liveness) are
+        ABSENT, rendered ``n/a`` by consumers, never 0 (the round-8
+        status-shape convention)."""
+        doc = {
+            "engine": "deploy",
+            "node": self.idx,
+            "round": self.udp.rounds,
+            "members": len(self.udp.members),
+            "detections": self._det_total,
+        }
+        if self.udp._sus is not None:
+            srt = self.udp._sus[1]
+            doc.update(suspects_now=len(srt.suspects),
+                       suspects_entered=srt.entered,
+                       refutations=srt.refutations,
+                       confirms=srt.confirms)
+        return {"lines": [doc]}
 
     def UpdateFileVersion(self, req, ctx):
         """The writer's commit: the pushes landed, publish the placement."""
@@ -704,7 +762,7 @@ class NodeDaemon:
         "Get", "GetDeleteInfo", "DeleteFileData", "Delete", "Ls", "Store",
         "RemoteReput", "Vote", "AssignNewMaster", "AskForConfirmation",
         "UpdateFileVersion", "Lsm", "AliveNodes", "Grep", "ShowMetadata",
-        "ScenarioLoad", "ScenarioStatus", "SuspicionLoad",
+        "ScenarioLoad", "ScenarioStatus", "SuspicionLoad", "Vitals",
     )
 
     # -- lifecycle ---------------------------------------------------------

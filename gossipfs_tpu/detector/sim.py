@@ -81,6 +81,96 @@ class SimDetector:
         # the per-round RoundMetrics both advance paths already produce
         self._sus_totals = {"suspects_entered": 0, "refutations": 0,
                             "fp_suppressed": 0, "confirms": 0}
+        # flight recorder (obs/): when attached, the interactive path
+        # emits schema events per round (the evaluation lane — gated
+        # host polling) and bulk scans decode post-hoc (obs.recorder.
+        # decode_scan; the compiled program is untouched either way)
+        self._recorder = None
+        self._rec_suspects: set[int] = set()
+        self._rec_removed: set[int] = set()
+
+    # -- flight recorder (obs/) --------------------------------------------
+    def attach_recorder(self, recorder) -> None:
+        """Arm an obs.FlightRecorder: every subsequent round emits the
+        schema's lifecycle events.  Interactive rounds poll the state for
+        suspect/remove transitions (O(N^2) host reads — the evaluation
+        lane, like suspicion itself); bulk scans decode their existing
+        outputs instead, off the device hot path."""
+        self._recorder = recorder
+        self._rec_suspects = set()
+        self._rec_removed = set()
+
+    def _rec_emit(self, round_idx: int, kind: str, subject: int,
+                  observer: int = -1, **detail) -> None:
+        from gossipfs_tpu.obs.schema import Event
+
+        self._recorder.emit(Event(round=round_idx, observer=observer,
+                                  subject=subject, kind=kind,
+                                  detail=detail))
+
+    def _record_interactive_round(
+        self, round_idx: int, metrics, af, fo,
+        crashed: set[int], left: set[int], joined: set[int],
+    ) -> None:
+        """One interactive round's schema events (recorder armed only)."""
+        for s in sorted(crashed):
+            self._rec_emit(round_idx, "crash", s)
+            self._rec_emit(round_idx, "hb_freeze", s)
+            self._rec_removed.discard(s)
+        for s in sorted(left):
+            self._rec_emit(round_idx, "leave", s)
+            self._rec_removed.discard(s)
+        for s in sorted(joined):
+            self._rec_emit(round_idx, "join", s)
+            self._rec_removed.discard(s)
+        sus_on = self.config.suspicion is not None
+        detail = {
+            "n_alive": int(metrics.n_alive),
+            "true_detections": int(metrics.true_detections),
+            "false_positives": int(metrics.false_positives),
+        }
+        if sus_on:
+            detail.update(
+                suspects_entered=int(metrics.suspects_entered),
+                refutations=int(metrics.refutations),
+                fp_suppressed=int(metrics.fp_suppressed),
+            )
+        self._rec_emit(round_idx, "round_tick", -1, **detail)
+
+        st = np.asarray(self.state.status)
+        alive = np.asarray(self.state.alive)
+        if af is not None:
+            for subj in np.nonzero(np.asarray(af))[0]:
+                self._rec_emit(round_idx, "confirm", int(subj),
+                               observer=int(np.asarray(fo)[subj]),
+                               false_positive=bool(alive[subj]))
+        if sus_on:
+            now_sus = set(np.nonzero((st == int(SUSPECT)).any(axis=0))[0]
+                          .tolist())
+            for s in sorted(now_sus - self._rec_suspects):
+                self._rec_emit(round_idx, "suspect", s)
+            confirmed = (set(np.nonzero(np.asarray(af))[0].tolist())
+                         if af is not None else set())
+            # a refutation is evidence of life: the entry must be BACK
+            # as a MEMBER somewhere, and not because of a same-round
+            # leave/crash verb — suspects that merely got dropped
+            # (LEAVE marks them FAILED, a remove expires them) were
+            # never refuted, and emitting one would contradict the
+            # round_tick counters (UdpNode's drop-vs-refute split)
+            member_any = (st == int(MEMBER)).any(axis=0)
+            for s in sorted(self._rec_suspects - now_sus - confirmed):
+                if member_any[s] and s not in crashed and s not in left:
+                    self._rec_emit(round_idx, "refute", s)
+            self._rec_suspects = now_sus
+        # cluster-wide removal (the convergence event): a dead subject no
+        # live observer still lists — mirrors _update_carry's all_dropped
+        held = ((st == int(MEMBER)) | (st == int(SUSPECT)))
+        held &= alive[:, None]
+        np.fill_diagonal(held, False)
+        gone = set(np.nonzero(~held.any(axis=0) & ~alive)[0].tolist())
+        for s in sorted(gone - self._rec_removed):
+            self._rec_emit(round_idx, "remove", s)
+        self._rec_removed |= gone
 
     # -- scenario engine ---------------------------------------------------
     def load_scenario(self, scenario) -> None:
@@ -101,8 +191,13 @@ class SimDetector:
             scenario, round0=int(self.state.round)
         )
         self._scenario = scenario
+        if self._recorder is not None:
+            self._rec_emit(int(self.state.round), "scenario_arm", -1,
+                           name=scenario.name, horizon=scenario.horizon)
 
     def clear_scenario(self) -> None:
+        if self._scenario is not None and self._recorder is not None:
+            self._rec_emit(int(self.state.round), "scenario_clear", -1)
         self._scenario = self._scn_tensor = self._scn_config = None
 
     def scenario_status(self) -> dict | None:
@@ -169,6 +264,11 @@ class SimDetector:
                 leave=self._mask(self._pending_leave),
                 join=self._mask(self._pending_join),
             )
+            rec_verbs = None
+            if self._recorder is not None:
+                rec_verbs = (set(self._pending_crash),
+                             set(self._pending_leave),
+                             set(self._pending_join))
             self._pending_crash.clear()
             self._pending_leave.clear()
             self._pending_join.clear()
@@ -205,7 +305,15 @@ class SimDetector:
                     int(metrics.true_detections)
                     + int(metrics.false_positives),
                 )
-            if not bool(jnp.any(any_fail)):
+            eventful = bool(jnp.any(any_fail))
+            if rec_verbs is not None:
+                # recorder armed: the evaluation lane reads the round's
+                # observables every round anyway (round_tick needs them)
+                self._record_interactive_round(
+                    round_idx, metrics,
+                    any_fail if eventful else None, first_obs, *rec_verbs,
+                )
+            if not eventful:
                 # quiet round: one scalar transfer
                 continue
             # eventful round: the per-subject vectors the round computes
@@ -303,6 +411,13 @@ class SimDetector:
                 "join during an active scenario window is not "
                 "transport-filtered"
             )
+        # ground-truth verbs this bulk scan applies on its first round —
+        # captured for the recorder BEFORE _first_round_events clears the
+        # pending sets, so a bulk trace carries the same crash/leave/join
+        # rows the interactive path emits (timeline.py derives TTD from
+        # the crash rows)
+        verbs = (set(self._pending_crash), set(self._pending_leave),
+                 set(self._pending_join))
         events = self._first_round_events(rounds)
 
         if snapshot_every is None:
@@ -311,7 +426,8 @@ class SimDetector:
                 scenario=self._scn_tensor,
             )
             self._pending_bulk.append(
-                (start_round, rounds, mcarry, self.state, [per_round])
+                (start_round, rounds, mcarry, self.state, [per_round],
+                 verbs)
             )
             return None
 
@@ -354,7 +470,7 @@ class SimDetector:
                     prev = st
                 self._publish(prev)
                 self._pending_bulk.append(
-                    (start_round, rounds, mcarry, st, per_rounds)
+                    (start_round, rounds, mcarry, st, per_rounds, verbs)
                 )
             except BaseException as e:  # re-raised by the next _join_bulk
                 self._bulk_error = e
@@ -380,10 +496,35 @@ class SimDetector:
         reports the same first event per subject as the per-round path.
         """
         pending, self._pending_bulk = self._pending_bulk, []
-        for start, rounds, mcarry, state, per_rounds in pending:
+        for start, rounds, mcarry, state, per_rounds, verbs in pending:
             if self.config.suspicion is not None:
                 for pr in per_rounds:
                     self._accumulate_suspicion_bulk(pr)
+            if self._recorder is not None:
+                # bulk backend: expand the scan's existing outputs into
+                # schema events — runs only when results are read anyway.
+                # The verbs the scan applied on its first round become the
+                # ground-truth rows (leave/join don't ride decode_scan's
+                # crash_rounds, so emit them here at the start round).
+                from gossipfs_tpu.core.rounds import RoundMetrics
+                from gossipfs_tpu.obs.recorder import decode_scan
+
+                crashed, left, joined = verbs
+                for s in sorted(left):
+                    self._rec_emit(start, "leave", s)
+                for s in sorted(joined):
+                    self._rec_emit(start, "join", s)
+                flat = RoundMetrics(*(
+                    np.concatenate([np.asarray(getattr(p, f))
+                                    for p in per_rounds])
+                    for f in RoundMetrics._fields
+                ))
+                self._recorder.extend(decode_scan(
+                    flat, mcarry, n=self.config.n, start_round=start,
+                    crash_rounds={s: start for s in sorted(crashed)},
+                    alive=state.alive,
+                    suspicion=self.config.suspicion is not None,
+                ))
             first = np.asarray(mcarry.first_detect)
             observer = np.asarray(mcarry.first_observer)
             alive = np.asarray(state.alive)

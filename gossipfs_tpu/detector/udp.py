@@ -78,6 +78,15 @@ class UdpNode:
             self._sus = (params, SuspicionRuntime(params))
         return self._sus[1]
 
+    def _obs(self, kind: str, subject_addr: str, **detail) -> None:
+        """Flight-recorder seam (obs/): the host — the in-process
+        UdpCluster or the deploy daemon's _Env — decides whether a
+        recorder/structured log is armed and stamps its own round clock.
+        A host without the hook costs one getattr per event site."""
+        hook = getattr(self.cluster, "record_obs", None)
+        if hook is not None:
+            hook(kind, self.idx, subject_addr, **detail)
+
     # -- lifecycle ---------------------------------------------------------
     async def start(self) -> None:
         loop = asyncio.get_running_loop()
@@ -211,8 +220,8 @@ class UdpNode:
             m.hb = hb
         m.ts = self._now()
         rt = self._suspicion()
-        if rt is not None:
-            rt.refute(addr)
+        if rt is not None and rt.refute(addr):
+            self._obs("refute", addr)
 
     def _add_member(self, addr: str) -> None:
         """Introducer path: append + push full list to everyone
@@ -239,6 +248,7 @@ class UdpNode:
             self.fail_list[addr] = (
                 self._now() if self.cluster.fresh_cooldown else member.ts
             )
+            self._obs("remove", addr)
         if self._sus is not None:
             # removed for any reason (LEAVE, a peer's REMOVE): forget the
             # pending suspicion (a confirm already popped it, uncounted)
@@ -254,10 +264,10 @@ class UdpNode:
                 if hb > local.hb:
                     local.hb = hb
                     local.ts = now
-                    if rt is not None:
+                    if rt is not None and rt.refute(addr):
                         # refute-by-advance: a fresher counter observed
                         # while SUSPECT cancels the pending failure
-                        rt.refute(addr)
+                        self._obs("refute", addr)
             elif addr not in self.fail_list:
                 self.members[addr] = _Member(hb, now)
 
@@ -316,6 +326,7 @@ class UdpNode:
                 continue
             if rt is not None:
                 if rt.suspect(addr, now):
+                    self._obs("suspect", addr)
                     msg = f"{addr}{CMD_SEP}SUSPECT"
                     for peer in list(self.members):
                         if peer != self.addr:
@@ -325,8 +336,11 @@ class UdpNode:
                 if not rt.expired(addr, now, window):
                     continue
                 rt.confirm(addr)
-            self._remove_member(addr)
+            # detection first, then the removal it causes — the same
+            # confirm -> remove causal order the tensor engine's events
+            # carry (the flight-recorder parity tests compare sequences)
             c.record_detection(self.idx, addr)
+            self._remove_member(addr)
             msg = f"{addr}{CMD_SEP}REMOVE"
             for peer in list(self.members):
                 if peer != self.addr:
@@ -379,6 +393,11 @@ class UdpCluster:
         self._events: list[DetectionEvent] = []
         self._round = 0
         self.introducer = 0
+        # flight recorder (obs/) + cumulative vitals counters (events
+        # drain, so the `metrics` surface needs its own accounting)
+        self._recorder = None
+        self._det_total = 0
+        self._fp_total = 0
         # scenario engine (scenarios/): armed rule table + the cluster
         # round it was armed at (rule windows are arming-relative)
         self._scn_runtime = None
@@ -399,8 +418,12 @@ class UdpCluster:
             )
         self._scn_runtime = ScenarioRuntime(scenario)
         self._scn_round0 = self._round
+        self._rec_cluster("scenario_arm", -1, name=scenario.name,
+                          horizon=scenario.horizon)
 
     def clear_scenario(self) -> None:
+        if self._scn_runtime is not None:
+            self._rec_cluster("scenario_clear", -1)
         self._scn_runtime = None
 
     def scenario_status(self) -> dict | None:
@@ -468,16 +491,66 @@ class UdpCluster:
             return True
         return not rt.drops(src, dst, self._round - self._scn_round0)
 
+    # -- flight recorder (obs/) ---------------------------------------------
+    def attach_recorder(self, recorder) -> None:
+        """Arm an obs.FlightRecorder on the UdpNode tick/receive seams."""
+        self._recorder = recorder
+
+    def record_obs(self, kind: str, observer: int, subject_addr: str,
+                   **detail) -> None:
+        """UdpNode._obs lands here; the cluster stamps its round clock."""
+        if self._recorder is None:
+            return
+        from gossipfs_tpu.obs.schema import Event
+
+        subject = self._addr_to_idx.get(subject_addr, -1)
+        self._recorder.emit(Event(round=self._round, observer=observer,
+                                  subject=subject, kind=kind,
+                                  detail=detail))
+
+    def _rec_cluster(self, kind: str, subject: int, **detail) -> None:
+        if self._recorder is None:
+            return
+        from gossipfs_tpu.obs.schema import Event
+
+        self._recorder.emit(Event(round=self._round, observer=-1,
+                                  subject=subject, kind=kind,
+                                  detail=detail))
+
+    def vitals(self) -> dict:
+        """The uniform counter set (obs.schema.VITALS_FIELDS).  This
+        engine knows ground-truth aliveness (in-process), so
+        false_positives is live; ``fp_suppressed`` stays absent — the
+        per-refute ground truth only the sim has (rendered n/a)."""
+        doc = {
+            "engine": "udp",
+            "round": self._round,
+            "n_alive": len(self.alive_nodes()),
+            "detections": self._det_total,
+            "false_positives": self._fp_total,
+        }
+        sus = self.suspicion_status()
+        if sus is not None:
+            doc.update({k: sus[k] for k in (
+                "suspects_now", "suspects_entered", "refutations",
+                "confirms") if k in sus})
+        return doc
+
     def record_detection(self, observer: int, subject_addr: str) -> None:
         subject = self._addr_to_idx[subject_addr]
+        fp = self.nodes[subject].alive
+        self._det_total += 1
+        self._fp_total += int(fp)
         self._events.append(
             DetectionEvent(
                 round=self._round,
                 observer=observer,
                 subject=subject,
-                false_positive=self.nodes[subject].alive,
+                false_positive=fp,
             )
         )
+        self.record_obs("confirm", observer, subject_addr,
+                        false_positive=bool(fp))
 
     # -- async lifecycle ----------------------------------------------------
     async def start_all(self) -> None:
@@ -498,9 +571,12 @@ class UdpCluster:
     # -- FailureDetector verbs (used inside the event loop) -----------------
     def crash(self, node: int) -> None:
         self.nodes[node].stop(graceful=False)
+        self._rec_cluster("crash", node)
+        self._rec_cluster("hb_freeze", node)
 
     def leave(self, node: int) -> None:
         self.nodes[node].stop(graceful=True)
+        self._rec_cluster("leave", node)
 
     async def join(self, node: int) -> None:
         """(Re)start a node's process and send JOIN to the introducer
@@ -509,6 +585,7 @@ class UdpCluster:
         if not n.alive:
             await n.start()
         n._send(self.nodes[self.introducer].addr, f"{n.addr}{CMD_SEP}JOIN")
+        self._rec_cluster("join", node)
 
     def membership(self, observer: int) -> list[int]:
         return sorted(
@@ -591,6 +668,13 @@ class UdpDetector:
 
     def scenario_status(self):
         return self._sync(self.cluster.scenario_status)
+
+    # -- observability (same thread discipline) -----------------------------
+    def attach_recorder(self, recorder) -> None:
+        self._sync(self.cluster.attach_recorder, recorder)
+
+    def vitals(self) -> dict:
+        return self._sync(self.cluster.vitals)
 
     # -- suspicion subsystem (same thread discipline) -----------------------
     def load_suspicion(self, params) -> None:

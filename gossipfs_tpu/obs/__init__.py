@@ -1,0 +1,50 @@
+"""Observability: one event schema, a flight recorder, and profiling hooks.
+
+* ``obs/schema.py`` — the versioned record shape + kind registry + the
+  uniform vitals field set (and the lint maps tying every existing
+  metric/log site to it);
+* ``obs/recorder.py`` — the flight recorder's three backends: the
+  post-scan decoder over the tensor sim's existing outputs (no new
+  device work), the ``UdpNode`` seam hook, and the deploy daemons'
+  structured JSONL logs;
+* ``obs/profile.py`` — the opt-in ``jax.profiler`` trace hook around
+  the scan.
+
+``tools/timeline.py`` is the consumer: it merges per-node streams,
+reconstructs per-subject crash -> SUSPECT -> confirm -> REMOVE -> repair
+timelines, and re-derives TTD/FPR from events alone as a standing
+cross-check against ``metrics/detection.summarize``.
+
+The recorder exports resolve LAZILY (module ``__getattr__``), the same
+pattern as ``scenarios/``: the deploy daemons — a documented jax-free
+path that must start in milliseconds — import ``obs.schema`` through
+this package for their structured logs, and an eager recorder import
+would pull numpy into every daemon at boot.
+"""
+
+from gossipfs_tpu.obs.schema import (
+    EVENT_KINDS,
+    SCHEMA,
+    VITALS_FIELDS,
+    Event,
+    render_vitals,
+)
+
+_RECORDER_EXPORTS = ("FlightRecorder", "decode_scan", "write_trace")
+
+__all__ = [
+    "EVENT_KINDS",
+    "SCHEMA",
+    "VITALS_FIELDS",
+    "Event",
+    "render_vitals",
+    *_RECORDER_EXPORTS,
+]
+
+
+def __getattr__(name: str):
+    if name in _RECORDER_EXPORTS:
+        from gossipfs_tpu.obs import recorder
+
+        return getattr(recorder, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,44 @@
+"""Opt-in ``jax.profiler`` hook around the scan — xprof for the headline.
+
+The repo's perf evidence so far is wall-clock + stub bisection; an xprof
+trace of the headline shape (open in Perfetto / TensorBoard, or reduce
+with ``utils/profiling.op_breakdown``) is the missing device-level view.
+This module is the small seam the benches use so the NEXT TPU session
+captures one alongside the BENCH numbers::
+
+    python bench.py --xprof /tmp/xprof_headline
+
+Opt-in by construction: with no directory the context is a no-op and
+the benches' timed loops are untouched.  Import of jax is deferred into
+the armed branch so merely importing this module stays cheap.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import pathlib
+from typing import Iterator
+
+
+@contextlib.contextmanager
+def maybe_xprof(log_dir: str | pathlib.Path | None) -> Iterator[None]:
+    """``with maybe_xprof(args.xprof):`` — jax.profiler.trace when a
+    directory is given, a no-op otherwise."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(str(log_dir)):
+        yield
+
+
+def xprof_summary(log_dir: str | pathlib.Path, top: int = 10) -> list[dict]:
+    """Top device ops from a captured trace (empty on parse failure —
+    the bench must not die because a trace file is missing/odd)."""
+    try:
+        from gossipfs_tpu.utils.profiling import op_breakdown
+
+        return op_breakdown(log_dir, top=top)
+    except Exception:  # noqa: BLE001 — diagnostics only
+        return []

@@ -1,0 +1,212 @@
+"""The flight recorder: three producers, one schema, JSONL out.
+
+Backends (all writing ``obs/schema.py`` records):
+
+1. **Post-scan decoder** (:func:`decode_scan`): expands the tensor sim's
+   EXISTING scan outputs — the stacked ``RoundMetrics`` and the
+   ``MetricsCarry`` per-subject first-detection/convergence vectors —
+   into events on the host, after ``run_rounds`` returns.  No new device
+   work: the rr/pallas fast paths are untouched and the compiled program
+   is bit-identical with or without recording (the <2% overhead bound in
+   the acceptance criteria is structural, then measured).
+
+2. **Socket-engine seam hook**: ``detector/udp.py`` ``UdpCluster`` (and
+   the deploy ``_Env``) expose ``record_obs``; ``UdpNode``'s tick and
+   receive paths call it at the suspect/refute/remove/confirm seams.
+   :class:`FlightRecorder` is what a cluster attaches.
+
+3. **Deploy structured logs**: ``deploy/node.py`` writes its per-node
+   JSONL through ``schema.LOG_KIND_MAP``, so ``node<i>.log`` IS a schema
+   stream ``tools/timeline.py`` merges directly.
+
+This module imports numpy only — the deploy daemons (a documented
+jax-free path) use it too.
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+import numpy as np
+
+from gossipfs_tpu.obs import schema
+from gossipfs_tpu.obs.schema import Event
+
+
+class FlightRecorder:
+    """Accumulates schema events, optionally mirrored to a JSONL file.
+
+    The header row is written on construction; events append in arrival
+    order.  ``events`` is always available in memory (the parity tests
+    and the timeline selfcheck read it without touching disk).
+    """
+
+    def __init__(self, path: str | pathlib.Path | None = None,
+                 source: str = "sim", n: int | None = None, **meta):
+        self.header = schema.header(source, n=n, **meta)
+        self.events: list[Event] = []
+        self._fh = None
+        if path is not None:
+            self._fh = open(path, "w", encoding="utf-8")
+            self._fh.write(schema.dumps(self.header) + "\n")
+
+    def emit(self, ev: Event) -> None:
+        self.events.append(ev)
+        if self._fh is not None:
+            self._fh.write(schema.dumps(ev.to_record()) + "\n")
+
+    def extend(self, events) -> None:
+        for ev in events:
+            self.emit(ev)
+
+    def flush(self) -> None:
+        if self._fh is not None:
+            self._fh.flush()
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    # convenience for tests / the analyzer
+    def kinds(self, subject: int | None = None) -> list[str]:
+        return [e.kind for e in self.events
+                if subject is None or e.subject == subject]
+
+
+def decode_scan(
+    per_round,
+    mcarry,
+    *,
+    n: int,
+    start_round: int = 0,
+    crash_rounds: dict[int, int] | None = None,
+    alive=None,
+    suspicion: bool = False,
+    n_effective: int | None = None,
+) -> list[Event]:
+    """Expand a finished scan's outputs into schema events (host-side).
+
+    ``per_round``: the stacked ``RoundMetrics`` over the horizon;
+    ``mcarry``: the final ``MetricsCarry``; ``start_round``: the state's
+    round counter when the scan began (events stamp absolute rounds);
+    ``crash_rounds``: {node: round} for scheduled/tracked faults (emits
+    ground-truth ``crash`` + ``hb_freeze`` rows); ``alive``: final
+    ground-truth liveness [N] — when given, ``confirm`` events carry
+    ``detail.false_positive`` exactly like the interactive path's
+    DetectionEvents.  ``suspicion``: whether the SWIM lifecycle was
+    armed (gates the per-subject ``suspect`` rows and the suspicion
+    counters in ``round_tick``).  ``n_effective``: live-cohort size for
+    PADDED runs (bench/frontier.py's literal-N padding) — permanently
+    dead alignment pads past it "converge" at the scan's first round,
+    and without the mask each would export a phantom ``remove`` row.
+
+    Consumes arrays the scan already returned — every np.asarray below
+    is a host transfer of data the caller's ``summarize`` reads anyway.
+    """
+    events: list[Event] = []
+    tp = np.asarray(per_round.true_detections)
+    fp = np.asarray(per_round.false_positives)
+    na = np.asarray(per_round.n_alive)
+    se = np.asarray(per_round.suspects_entered)
+    rf = np.asarray(per_round.refutations)
+    fs = np.asarray(per_round.fp_suppressed)
+    rounds = len(tp)
+
+    # ground-truth fault rows first (they precede everything they cause)
+    for node, r0 in sorted((crash_rounds or {}).items()):
+        events.append(Event(round=int(r0), observer=-1, subject=int(node),
+                            kind="crash", detail={"scheduled": True}))
+        events.append(Event(round=int(r0), observer=-1, subject=int(node),
+                            kind="hb_freeze"))
+
+    # one round_tick per round — the RoundMetrics row as an event.  Every
+    # round is emitted (not just eventful ones): the analyzer's FPR
+    # denominator needs n_alive for the whole horizon.
+    for i in range(rounds):
+        detail = {
+            "n_alive": int(na[i]),
+            "true_detections": int(tp[i]),
+            "false_positives": int(fp[i]),
+        }
+        if suspicion:
+            detail.update(suspects_entered=int(se[i]),
+                          refutations=int(rf[i]),
+                          fp_suppressed=int(fs[i]))
+        events.append(Event(round=start_round + i, observer=-1, subject=-1,
+                            kind="round_tick", detail=detail))
+
+    first = np.asarray(mcarry.first_detect)
+    obs_v = np.asarray(mcarry.first_observer)
+    conv = np.asarray(mcarry.converged)
+    first_sus = np.asarray(mcarry.first_suspect)
+    alive_h = None if alive is None else np.asarray(alive)
+    end = start_round + rounds
+
+    n_eff = n if n_effective is None else n_effective
+
+    def window(v: np.ndarray) -> np.ndarray:
+        # subjects whose event landed in THIS scan's horizon — nonzero
+        # over the vector, so a quiet N=100k trace costs O(events) python.
+        # Alignment pads (subjects >= n_eff) never export: they were
+        # never members, so their carries are artifacts, not lifecycle.
+        in_w = (v >= start_round) & (v < end)
+        in_w[n_eff:] = False
+        return np.nonzero(in_w)[0]
+
+    if suspicion:
+        for j in window(first_sus):
+            events.append(Event(round=int(first_sus[j]), observer=-1,
+                                subject=int(j), kind="suspect"))
+    for j in window(first):
+        detail = {}
+        if alive_h is not None:
+            detail["false_positive"] = bool(alive_h[j])
+        events.append(Event(round=int(first[j]), observer=int(obs_v[j]),
+                            subject=int(j), kind="confirm", detail=detail))
+    for j in window(conv):
+        events.append(Event(round=int(conv[j]), observer=-1,
+                            subject=int(j), kind="remove"))
+    events.sort(key=lambda e: e.round)
+    return events
+
+
+def write_trace(
+    path: str | pathlib.Path,
+    per_round,
+    mcarry,
+    *,
+    n: int,
+    source: str,
+    start_round: int = 0,
+    crash_rounds: dict[int, int] | None = None,
+    alive=None,
+    suspicion: bool = False,
+    n_effective: int | None = None,
+    **meta,
+) -> int:
+    """One-call trace emission for the bench ``--trace PATH`` flags.
+
+    Decodes the scan and writes header + events; returns the event
+    count.  ``crash_rounds`` lands in the header too, so the analyzer
+    can compute TTD without re-deriving the fault schedule, and
+    ``n_effective`` both masks the pad subjects out of the decode and
+    names the FPR cohort in the header.
+    """
+    if crash_rounds:
+        meta["crash_rounds"] = {str(k): int(v)
+                                for k, v in sorted(crash_rounds.items())}
+    if n_effective is not None:
+        meta["n_effective"] = int(n_effective)
+    rec = FlightRecorder(path, source=source, n=n,
+                         start_round=start_round, suspicion=suspicion,
+                         **meta)
+    try:
+        rec.extend(decode_scan(
+            per_round, mcarry, n=n, start_round=start_round,
+            crash_rounds=crash_rounds, alive=alive, suspicion=suspicion,
+            n_effective=n_effective,
+        ))
+    finally:
+        rec.close()
+    return len(rec.events)

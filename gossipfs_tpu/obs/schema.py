@@ -1,0 +1,240 @@
+"""The versioned, typed observability event schema — ONE language for
+what happened, across all three transport engines.
+
+Before this module the repo's detection lifecycles lived in three
+disjoint forms: ``RoundMetrics`` arrays out of the tensor scan, per-
+process free-text-ish log files in deploy, and ``ScenarioStatus`` vitals
+over gRPC — answering "what happened to node 777 between crash and
+repair" meant hand-correlating artifacts.  SWIM (PAPERS.md #2) and
+Lifeguard (PAPERS.md #3) both argue from *per-event* evidence
+(suspect/refute/confirm sequences, local-health signals); this schema
+makes that evidence streamable and machine-checkable.
+
+One record shape everywhere::
+
+    {"round": r, "observer": i, "subject": j, "kind": k, "detail": {...}}
+
+``observer``/``subject`` are node ids; ``-1`` means "not a single node"
+(cluster-wide / ground-truth events).  Streams are JSONL whose FIRST row
+is a header (``{"schema": SCHEMA, "source": ..., "n": ...}``) so every
+artifact is self-describing; ``tools/timeline.py`` merges streams, and
+``obs/recorder.py`` holds the three producers (post-scan decoder, the
+``UdpNode`` seam hook, the deploy daemons' structured log).
+
+The maps at the bottom are the LINT surface (tests/test_obs.py): every
+``RoundMetrics``/``MetricsCarry`` field and every deploy/cosim log site
+must map to a schema kind or be explicitly listed as unexported — new
+metrics cannot silently bypass the recorder.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+# Version tag stamped into every stream header.  Bump on any breaking
+# record-shape change; the analyzer refuses unknown majors.
+SCHEMA = "gossipfs-obs/v1"
+
+# Sibling schema for the profiler artifacts (ROUNDPROF_*.jsonl /
+# stub-bisect rows): a header row stamped by bench/roundprof.py and
+# tools/stub_bisect.py so old and new profile artifacts are
+# self-describing and the analyzer can ingest them.
+ROUNDPROF_SCHEMA = "gossipfs-roundprof/v1"
+
+# ---------------------------------------------------------------------------
+# Event kinds — the full lifecycle
+# ---------------------------------------------------------------------------
+
+EVENT_KINDS: dict[str, str] = {
+    # -- time / per-round observables
+    "round_tick": "one completed protocol round; detail carries the "
+                  "round's scalar counters (n_alive, true_detections, "
+                  "false_positives, suspects_entered, refutations, "
+                  "fp_suppressed) — the RoundMetrics row, as an event",
+    # -- ground-truth membership events (observer == -1)
+    "crash": "subject crash-stopped (CTRL+C / kill -9 / scheduled fault)",
+    "hb_freeze": "subject's own heartbeat counter stopped advancing "
+                 "(emitted alongside crash: a dead process bumps nothing)",
+    "leave": "subject broadcast LEAVE and exited voluntarily",
+    "join": "subject (re)joined through the introducer",
+    # -- the SWIM detection lifecycle (suspicion/)
+    "suspect": "observer marked subject SUSPECT (first local staleness "
+               "evidence; observer -1 = 'some observer', from the scan's "
+               "any-observer carry)",
+    "refute": "a pending suspicion of subject was cancelled by evidence "
+              "of life (heartbeat/incarnation advance)",
+    "confirm": "a detector declared subject FAILED (the lifecycle's "
+               "actual failure declaration; detail.false_positive is "
+               "ground truth where the engine knows it)",
+    "remove": "subject dropped from a membership list; observer -1 = "
+              "dropped from EVERY live observer's list (the scan's "
+              "convergence carry)",
+    # -- fault injection (scenarios/)
+    "scenario_arm": "a FaultScenario rule table was armed",
+    "scenario_clear": "the armed scenario was cleared / healed",
+    "suspicion_arm": "SuspicionParams armed (suspicion/)",
+    "suspicion_clear": "suspicion disarmed",
+    # -- SDFS control plane
+    "election": "a master election resolved (subject = the new master)",
+    "replica_put": "a file version committed (detail.file / version)",
+    "replica_repair": "a replica re-replicated after loss "
+                      "(detail.file / source / target)",
+    "replica_lost": "no live replica of a file remains",
+    # -- operational
+    "node_start": "a deploy node process came up",
+}
+
+# Kinds that constitute a subject's detection-lifecycle timeline, in
+# canonical order — tools/timeline.py renders/validates against this.
+LIFECYCLE_KINDS = (
+    "crash", "hb_freeze", "leave", "join",
+    "suspect", "refute", "confirm", "remove",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    """One schema record (see module docstring for field semantics)."""
+
+    round: int
+    observer: int
+    subject: int
+    kind: str
+    detail: dict = dataclasses.field(default_factory=dict)
+
+    def to_record(self) -> dict:
+        rec = {"round": self.round, "observer": self.observer,
+               "subject": self.subject, "kind": self.kind}
+        if self.detail:
+            rec["detail"] = self.detail
+        return rec
+
+    @staticmethod
+    def from_record(rec: dict) -> "Event":
+        # deploy node logs carry the writing node as "node" (their
+        # Machine.log heritage) — it IS the observer for schema purposes
+        observer = rec.get("observer", rec.get("node", -1))
+        return Event(
+            round=int(rec.get("round", -1)),
+            observer=int(observer),
+            subject=int(rec.get("subject", -1)),
+            kind=rec["kind"],
+            detail=rec.get("detail") or {},
+        )
+
+
+def header(source: str, n: int | None = None, **meta) -> dict:
+    """The self-describing first row of every event stream."""
+    doc = {"schema": SCHEMA, "source": source}
+    if n is not None:
+        doc["n"] = int(n)
+    doc.update(meta)
+    return doc
+
+
+def is_header(rec: dict) -> bool:
+    return "schema" in rec and "kind" not in rec
+
+
+def dumps(rec: dict) -> str:
+    return json.dumps(rec, separators=(",", ":"))
+
+
+# ---------------------------------------------------------------------------
+# Uniform vitals — the counter set every engine's `metrics` surface renders
+# ---------------------------------------------------------------------------
+
+# One ordered field list for the CLI `metrics` verb, the shim/deploy
+# `Vitals` RPC, and the launcher's collector.  A field an engine cannot
+# know (ground-truth aliveness off the sim; per-refute aliveness off the
+# socket engines) is ABSENT from its document and rendered as `n/a` —
+# never as a measured 0 (the round-8 status-shape convention).
+VITALS_FIELDS = (
+    "engine",           # "sim" | "udp" | "deploy"
+    "round",            # the engine's protocol-round clock
+    "n_alive",          # ground-truth live count (sim/udp only)
+    "members",          # size of the reporting node's view (deploy rows)
+    "detections",       # cumulative detector firings seen by the surface
+    "false_positives",  # of those, subject actually alive (ground truth)
+    "suspects_now",     # live SUSPECT entries (suspicion armed only)
+    "suspects_entered",
+    "refutations",
+    "confirms",
+    "fp_suppressed",    # sim-only: refutations of actually-alive subjects
+)
+
+
+def render_vitals(doc: dict) -> str:
+    """One-line uniform rendering; absent fields print as ``n/a``."""
+    parts = []
+    for f in VITALS_FIELDS:
+        v = doc.get(f)
+        parts.append(f"{f}={'n/a' if v is None else v}")
+    return " ".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# Lint maps — how every existing metric/log site reaches this schema
+# ---------------------------------------------------------------------------
+
+# core.rounds.RoundMetrics / MetricsCarry field -> the event kind (or
+# round_tick counter) the post-scan decoder exports it through.  The
+# schema-lint test asserts every field of both NamedTuples appears here
+# or in SCAN_UNEXPORTED.
+SCAN_FIELD_MAP: dict[str, str] = {
+    # RoundMetrics -> round_tick detail counters (one row per round)
+    "true_detections": "round_tick",
+    "false_positives": "round_tick",
+    "n_alive": "round_tick",
+    "suspects_entered": "round_tick",
+    "refutations": "round_tick",
+    "fp_suppressed": "round_tick",
+    # MetricsCarry -> per-subject lifecycle events
+    "first_detect": "confirm",     # confirm.round
+    "first_observer": "confirm",   # confirm.observer
+    "converged": "remove",         # remove.round (observer -1)
+    "first_suspect": "suspect",    # suspect.round (observer -1)
+}
+
+# Scan fields deliberately NOT exported as events (none today; list them
+# here WITH a reason if that ever changes, so the lint keeps passing
+# honestly instead of being loosened).
+SCAN_UNEXPORTED: dict[str, str] = {}
+
+# deploy/node.py + cosim.py log-site kind -> schema kind.  NodeDaemon.log
+# rewrites through this map at write time, so the per-node JSONL logs ARE
+# schema streams (the structured replacement for the free-text logs) and
+# tools/timeline.py ingests them directly.
+LOG_KIND_MAP: dict[str, str] = {
+    "detect": "confirm",
+    "failure_detected": "confirm",   # cosim's EventLog kind
+    "re_replicate": "replica_repair",
+    "reput": "replica_repair",
+    "put": "replica_put",
+    "lost": "replica_lost",
+    "elected": "election",
+    "new_master": "election",
+    "scenario": "scenario_arm",
+    "suspicion": "suspicion_arm",
+    "start": "node_start",
+}
+
+# Log sites that are operational noise, not lifecycle evidence — each
+# with the reason it stays out of the event stream.  The lint test
+# asserts every `log("<kind>"...)` / `kind="<kind>"` site is in
+# LOG_KIND_MAP, UNEXPORTED_LOG_KINDS, or already a schema kind.
+UNEXPORTED_LOG_KINDS: dict[str, str] = {
+    "repair_error": "per-attempt RPC failure; the retry loop re-detects "
+                    "the deficit — the outcome events are replica_repair "
+                    "/ replica_lost",
+    "reput_miss": "a refused RemoteReput (no local copy); the master's "
+                  "retry rotates sources — outcome events cover it",
+    "scenario_error": "a rejected ScenarioLoad payload (bad JSON / wrong "
+                      "n); nothing armed, no lifecycle state changed",
+    "suspicion_error": "a rejected SuspicionLoad payload; same",
+    "election_stall": "a no-majority election attempt; retried every "
+                      "control tick — the outcome event is `election`",
+    "control_error": "control-loop exception kept non-fatal; diagnostics, "
+                     "not protocol evidence",
+}

@@ -11,6 +11,10 @@ Command surface matches README.md:8-29 plus fault/time controls the sim adds:
   show_metadata | check              master's file->replica map
   advance <r>                        advance simulated time by r rounds
   events                             detection events so far
+  metrics                            the uniform vitals counter line
+                                     (obs/schema.py VITALS_FIELDS — the
+                                     same set the deploy Vitals RPC
+                                     serves; unknowable fields as n/a)
   scenario load <file.json>          arm a declarative fault scenario
                                      (gossipfs_tpu/scenarios/ schema:
                                      partitions, link loss, slow nodes;
@@ -193,6 +197,13 @@ def dispatch(
         elif cmd == "events":
             for ev in sim.events:
                 print(ev, file=out)
+        elif cmd == "metrics":
+            # the uniform vitals line (obs.schema.VITALS_FIELDS): the
+            # SAME counter set the deploy `Vitals` RPC renders per node;
+            # fields this engine cannot know print as n/a, never 0
+            from gossipfs_tpu.obs.schema import render_vitals
+
+            print(render_vitals(sim.vitals()), file=out)
         elif cmd == "scenario":
             sub = args[0] if args else "status"
             if sub == "load":

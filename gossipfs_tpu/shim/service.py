@@ -251,6 +251,14 @@ class ShimServicer:
             )
             return {"round_target": start + rounds, "snapshot_every": every}
 
+    def Vitals(self, req, ctx):
+        """The uniform vitals counter set (obs.schema.VITALS_FIELDS) as
+        one GrepReply Struct line — the same verb the deploy daemons
+        serve per node, so one client renders live counters identically
+        across engines (sim-only fields are simply absent elsewhere)."""
+        with self._lock:
+            return {"lines": [self.sim.vitals()]}
+
     def Events(self, req, ctx):
         """Detection events from cursor ``since`` (default 0) on; the reply's
         ``next`` is the cursor for the following poll, so long-running
@@ -517,7 +525,7 @@ class ShimServicer:
     # -- plumbing -----------------------------------------------------------
     METHODS = [
         "Join", "Leave", "Crash", "Lsm", "AliveNodes", "Advance",
-        "AdvanceBulk", "Events",
+        "AdvanceBulk", "Events", "Vitals",
         "Grep", "GetPutInfo", "GetFileData", "GetFileInfo",
         "AskForConfirmation", "GetDeleteInfo", "DeleteFileData", "RemoteReput",
         "Vote", "AssignNewMaster", "UpdateFileVersion", "GetUpdateMeta",

@@ -72,6 +72,11 @@ METHOD_TYPES: dict[str, tuple] = {
     # payload disarms); per-node suspicion vitals ride ScenarioStatus's
     # Struct lines — no new reply shape needed
     "SuspicionLoad": (pb.PutRequest, pb.OkReply),
+    # observability (obs/): the uniform vitals counter set
+    # (obs.schema.VITALS_FIELDS) as GrepReply Struct lines — one line
+    # from the embedded shim's CoSim, one line per node from the deploy
+    # daemons; same extension-verb pattern as ScenarioStatus
+    "Vitals": (pb.Empty, pb.GrepReply),
 }
 
 

@@ -142,6 +142,19 @@ def main():
             # running the ring build — under --rr-rotate off it would be
             # a no-op row mislabelled as a stage cost
             args.stubs.insert(3, "wring")
+    # self-describing header row (obs.schema.ROUNDPROF_SCHEMA) — same
+    # convention as bench/roundprof.py, so stub-bisect JSONL artifacts
+    # carry their schema/shape/knobs and the analyzer can ingest them
+    from gossipfs_tpu.obs import schema as obs_schema
+
+    print(json.dumps({
+        "schema": obs_schema.ROUNDPROF_SCHEMA, "tool": "stub_bisect",
+        "n": args.n, "block_c": args.block_c, "block_r": args.block_r,
+        "arc_align": args.arc_align, "elementwise": args.elementwise,
+        "rr_rotate": args.rr_rotate,
+        "backend": ("interpret/" if args.interpret else "")
+        + jax.default_backend(),
+    }), flush=True)
     fanout = max(1, args.n.bit_length() - 1)
     if args.arc_align > 1:
         # round fanout UP to an arc_align multiple, as the production

@@ -142,6 +142,17 @@ CLAIMS = {
                     for r in d["ladder"])
         ) else 0.0,
         1.0, 0.0),
+    # observability (obs/): the flight-recorder <-> summarize oracle.
+    # timeline.py --selfcheck records a fresh N=1024 churn run at the
+    # fast suspicion knob, decodes the scan into a trace, re-derives
+    # TTD/FPR from events alone, and requires (a) event-derived per-crash
+    # TTD and FPR == summarize's EXACTLY (nonzero — the knob guarantees
+    # live suppression counts), and (b) no subject confirms FAILED
+    # without a preceding SUSPECT.  CPU-pinned.
+    "trace_invariants": (
+        ["env", "JAX_PLATFORMS=cpu", sys.executable, "tools/timeline.py",
+         "--selfcheck", "--n", "1024"],
+        lambda d: 1.0 if d["ok"] else 0.0, 1.0, 0.0),
 }
 
 
