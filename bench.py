@@ -103,6 +103,57 @@ def probe_rr_rotate(timeout_s: float = 600.0) -> bool:
         return False
 
 
+_RR_SUSPICION_PROBE = """
+import jax, jax.numpy as jnp
+from gossipfs_tpu.config import SimConfig
+from gossipfs_tpu.core.rounds import run_rounds
+from gossipfs_tpu.core.state import init_state
+from gossipfs_tpu.scenarios import split_halves
+from gossipfs_tpu.scenarios.tensor import compile_tensor
+from gossipfs_tpu.suspicion.params import SuspicionParams
+tsc = compile_tensor(split_halves(4096, start=2, end=8))
+outs = {}
+for kern in ("xla", "pallas_rr"):
+    cfg = SimConfig(n=4096, topology="random_arc", fanout=16, arc_align=8,
+                    remove_broadcast=False, fresh_cooldown=True,
+                    t_cooldown=12, merge_kernel=kern, t_fail=3,
+                    merge_block_c=2048, view_dtype="int8", hb_dtype="int8",
+                    rr_resident="auto", merge_block_r=512,
+                    elementwise="swar" if kern != "xla" else "lanes",
+                    suspicion=SuspicionParams(t_suspect=2))
+    out = run_rounds(init_state(cfg), cfg, 10, jax.random.PRNGKey(0),
+                     crash_rate=0.01, scenario=tsc, crash_only_events=True)
+    outs[kern] = jax.tree.leaves(out)
+assert all(bool(jnp.array_equal(a, b))
+           for a, b in zip(outs["xla"], outs["pallas_rr"]))
+"""
+
+
+def probe_rr_suspicion(timeout_s: float = 600.0) -> bool:
+    """Compiled-Mosaic validation of the round-11 fused fast path before
+    an on-chip suspicion anchor trusts it: 10 aligned-arc rr/SWAR rounds
+    at N=4,096 with the SWIM lifecycle armed AND a timed partition
+    scenario loaded, compiled rr vs the XLA-lanes oracle bit-equal ON
+    THE CHIP — every lane, the first_suspect carry and the suspicion
+    counters.  The interpret-mode suite (oracle grid + golden fuzz +
+    verify_claims fastpath_parity) pins the semantics on CPU; this probe
+    gates the COMPILED form (Mosaic lowering of the fused suspect/
+    confirm selects, the refute mask, the packed suspicion-count
+    reduction and the edge_filter masked gather), in a subprocess so a
+    lowering failure costs the staged fallback (--suspicion runs drop to
+    elementwise="lanes", then to the XLA oracle config), not the bench
+    run."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _RR_SUSPICION_PROBE],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def probe_swar(timeout_s: float = 600.0) -> bool:
     """Compiled-Mosaic validation of the SWAR elementwise path before the
     headline uses it: 4 aligned-arc rr rounds at N=4,096, swar vs lanes
@@ -136,6 +187,14 @@ def main(argv=None) -> None:
                          "extra run after sampling (obs/profile.py); "
                          "open DIR in Perfetto/TensorBoard or reduce "
                          "with utils/profiling.op_breakdown")
+    ap.add_argument("--suspicion", action="store_true",
+                    help="arm the SWIM lifecycle (t_fail=3, t_suspect=2 "
+                         "— the SUSPECT_r08 fast knob) on the headline "
+                         "config: the round-11 fused-fast-path anchor.  "
+                         "On TPU the fused rr/SWAR form is gated on "
+                         "probe_rr_suspicion() (on-chip parity "
+                         "subprocess) with staged lanes/XLA fallbacks, "
+                         "mirroring the swar and rr_rotate probes")
     args = ap.parse_args(argv)
     use_tpu = os.environ.get("JAX_PLATFORMS", "") == "axon" and probe_tpu()
     if not use_tpu:
@@ -208,19 +267,37 @@ def main(argv=None) -> None:
         # "off" restores the round-5 layouts (identical bits, more VMEM)
         rr_rotate=("auto" if not use_tpu or probe_rr_rotate() else "off"),
     )
+    import dataclasses
+
+    if args.suspicion:
+        # round-11 fused fast path: suspicion rides the CONFIGURED
+        # kernel (no substitution).  On TPU the compiled fused form must
+        # first prove bit-equality on-chip (probe_rr_suspicion); a probe
+        # failure drops the anchor to the XLA oracle config — still a
+        # valid suspicion-on number, honestly labeled by the emitted
+        # merge_kernel field — rather than silently benching an
+        # unvalidated lowering
+        from gossipfs_tpu.config import fallback_config
+        from gossipfs_tpu.suspicion.params import SuspicionParams
+
+        cfg = dataclasses.replace(
+            cfg, t_fail=3, suspicion=SuspicionParams(t_suspect=2))
+        if use_tpu and not probe_rr_suspicion():
+            cfg = fallback_config(cfg)
     key = jax.random.PRNGKey(0)
     state = init_state(cfg)
 
     # warmup: compile + one short run, with staged fallbacks if the
     # headline-shape compile fails where the small-shape probes passed:
     # first the widened lanes path, then the pre-rotation rr layouts
-    import dataclasses
-
+    # (suspicion runs append the XLA-oracle config as the last resort)
     fallbacks = []
     if cfg.elementwise == "swar":
         fallbacks.append(dict(elementwise="lanes"))
     if cfg.rr_rotate != "off":
         fallbacks.append(dict(elementwise="lanes", rr_rotate="off"))
+    if args.suspicion and cfg.merge_kernel != "xla":
+        fallbacks.append(dict(elementwise="lanes", merge_kernel="xla"))
     while True:
         try:
             st, mc, pr = run_rounds(state, cfg, ROUNDS, key,
@@ -314,6 +391,7 @@ def main(argv=None) -> None:
                 "elementwise": cfg.elementwise,
                 "rr_rotate": cfg.rr_rotate,
                 "merge_kernel": cfg.merge_kernel,
+                "suspicion": cfg.suspicion is not None,
                 "unit": "rounds/s",
                 # reference heartbeat loop = 1 round/s of wall clock
                 "vs_baseline": round(median, 2),

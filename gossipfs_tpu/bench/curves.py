@@ -152,7 +152,37 @@ def sweep(ns=DEFAULT_NS, rounds=ROUNDS, crash_rate=0.01, seed=0,
     }
 
 
-def partition_sweep(ns=(1024,), seed=0, split_at=5) -> dict:
+def _kernel_overrides(n: int, merge_kernel: str, elementwise: str) -> dict:
+    """SimConfig overrides for a --merge-kernel/--elementwise passthrough.
+
+    Round 11 (fast-path unification): scenario and suspicion rows run on
+    ANY merge kernel, so the A/B sweeps accept the kernel knobs.  The
+    rr/SWAR forms pull in the all-int8 state they require (config.py
+    gates); merge_block_c picks the largest admissible stripe width at
+    this n.
+    """
+    kw: dict = dict(merge_kernel=merge_kernel, elementwise=elementwise)
+    if merge_kernel.startswith("pallas_rr") or elementwise == "swar":
+        kw.update(view_dtype="int8", hb_dtype="int8")
+    elif merge_kernel.startswith("pallas"):
+        kw.update(view_dtype="int8", hb_dtype="int16",
+                  merge_block_c=16_384)
+    if merge_kernel.startswith("pallas_rr"):
+        from gossipfs_tpu.ops.merge_pallas import RR_BLOCK_CS
+
+        admissible = [c for c in RR_BLOCK_CS if n % c == 0 and c <= n]
+        if not admissible:
+            raise SystemExit(
+                f"--merge-kernel {merge_kernel} needs n divisible by an "
+                f"rr stripe width {RR_BLOCK_CS} (got n={n}); pick a "
+                "power-of-two n >= 512 or use --merge-kernel xla"
+            )
+        kw["merge_block_c"] = max(admissible)
+    return kw
+
+
+def partition_sweep(ns=(1024,), seed=0, split_at=5,
+                    merge_kernel="xla", elementwise="lanes") -> dict:
     """Scenario-engine partition rows — the committed netsplit artifact.
 
     Per N: split the cohort into halves for ``t_fail + t_cooldown +
@@ -171,7 +201,13 @@ def partition_sweep(ns=(1024,), seed=0, split_at=5) -> dict:
         diameter) — after heal the views knit back purely by gossip.
 
     CPU-feasible at N=1024-4096; tools/verify_claims.py re-runs the
-    N=1024 row as the ``partition_reconv`` claim.
+    N=1024 row as the ``partition_reconv`` claim.  ``merge_kernel`` /
+    ``elementwise`` (round 11): the configured kernel knobs — scenario
+    runs no longer force the XLA merge.  NOTE: this sweep steps the
+    interactive SimDetector lane, which runs scenario-armed rounds on
+    the XLA-oracle form regardless (detector/sim.py); the knobs here
+    select the config the bulk/fast paths would run and are primarily
+    for the suspicion sweep's A/B — kept symmetric for completeness.
     """
     import math
 
@@ -195,7 +231,7 @@ def partition_sweep(ns=(1024,), seed=0, split_at=5) -> dict:
             remove_broadcast=False,   # scenario runs are gossip-only
             fresh_cooldown=True,      # (scenarios/tensor.py gating)
             t_cooldown=6,
-            merge_kernel="xla",       # the filterable merge path
+            **_kernel_overrides(n, merge_kernel, elementwise),
         )
         diameter = math.ceil(math.log(n) / math.log(fanout + 1))
         split_len = cfg.t_fail + cfg.t_cooldown + diameter + 8
@@ -313,7 +349,8 @@ def sweep_t_fail(n=4096, t_fails=(3, 5, 8, 12), t_suspects=(0, 2),
 
 def suspicion_sweep(ns=(1024,), rounds=ROUNDS, seed=0, t_fail_fast=3,
                     t_suspect=2, t_fail_base=5, loss_rate=0.9,
-                    loss_frac=16) -> dict:
+                    loss_frac=16, merge_kernel="xla",
+                    elementwise="lanes") -> dict:
     """Suspicion A/B — the committed SUSPECT artifact (suspicion/).
 
     Per N, two fault regimes x three detector modes:
@@ -333,17 +370,25 @@ def suspicion_sweep(ns=(1024,), rounds=ROUNDS, seed=0, t_fail_fast=3,
     stays within 10x of the t_fail=5 baseline instead of the raw-t3
     storm; and under the loss scenario suspicion-on FPR is strictly
     below suspicion-off at the same t_fail.  CPU-feasible at N=1024.
+
+    ``merge_kernel`` / ``elementwise`` (round 11): the rows run on the
+    CONFIGURED kernel — suspicion and scenario runs no longer force the
+    XLA merge, so e.g. ``--merge-kernel pallas_rr_interpret
+    --elementwise swar`` drives the fused fast path through the same
+    A/B (Bernoulli-loss rows need a per-edge topology: 'random' here).
     """
+    import dataclasses as _dc
+
     from gossipfs_tpu.scenarios import FaultScenario, LinkFault
     from gossipfs_tpu.scenarios.tensor import compile_tensor
-    from gossipfs_tpu.suspicion import SuspicionParams, with_suspicion
+    from gossipfs_tpu.suspicion import SuspicionParams
 
     rows = []
     for n in ns:
         base_kw = dict(
             n=n, topology="random", fanout=SimConfig.log_fanout(n),
             remove_broadcast=False, fresh_cooldown=True, t_cooldown=12,
-            merge_kernel="xla",
+            **_kernel_overrides(n, merge_kernel, elementwise),
         )
         # lossy senders: the first n/loss_frac nodes drop loss_rate of
         # their outgoing gossip (asymmetric: their inbound is fine) —
@@ -365,7 +410,12 @@ def suspicion_sweep(ns=(1024,), rounds=ROUNDS, seed=0, t_fail_fast=3,
                     **base_kw, t_fail=t_fail,
                 )
                 if sus is not None:
-                    cfg = with_suspicion(cfg, sus)
+                    # round 11: arm the lifecycle ON the configured
+                    # kernel (dataclasses.replace, not the deprecated
+                    # with_suspicion oracle substitution) — identical
+                    # configs on the default xla/lanes knobs, so the
+                    # committed SUSPECT_r08 rows stay reproducible
+                    cfg = _dc.replace(cfg, suspicion=sus)
                 events, crash_rounds, churn_ok = tracked_crash_events(
                     cfg, rounds, TRACK, CRASH_AT
                 )
@@ -445,13 +495,27 @@ def main(argv=None) -> None:
                    help="write each row's flight-recorder event stream "
                         "(obs/ JSONL; analyze with tools/timeline.py) — "
                         "TTD/FPR sweep rows only")
+    p.add_argument("--merge-kernel", type=str, default="xla",
+                   help="merge kernel for the --suspicion/--partition "
+                        "rows (round 11: suspicion + scenarios run on "
+                        "every kernel; e.g. pallas_rr_interpret for the "
+                        "CPU form of the fused fast path)")
+    p.add_argument("--elementwise", choices=["lanes", "swar"],
+                   default="lanes",
+                   help="elementwise form for the --suspicion/"
+                        "--partition rows (swar = the packed-word fast "
+                        "path; pulls in the all-int8 state)")
     p.add_argument("--out", type=str, default=None)
     args = p.parse_args(argv)
     if args.partition:
-        doc = json.dumps(partition_sweep(ns=tuple(args.ns)))
+        doc = json.dumps(partition_sweep(
+            ns=tuple(args.ns), merge_kernel=args.merge_kernel,
+            elementwise=args.elementwise))
     elif args.suspicion:
-        doc = json.dumps(suspicion_sweep(ns=tuple(args.ns),
-                                         rounds=args.rounds))
+        doc = json.dumps(suspicion_sweep(
+            ns=tuple(args.ns), rounds=args.rounds,
+            merge_kernel=args.merge_kernel,
+            elementwise=args.elementwise))
     elif args.t_fail_sweep:
         doc = json.dumps(sweep_t_fail(rounds=args.rounds))
     else:

@@ -110,6 +110,39 @@ def variants(n: int) -> dict[str, SimConfig]:
     return out
 
 
+def suspicion_variants(n: int, interpret: bool = True) -> dict[str, SimConfig]:
+    """Round-11 fast-path A/B: suspicion-on vs -off on the SAME kernel.
+
+    The rows the committed ROUNDPROF_r11.jsonl artifact carries (CPU:
+    ``--suspicion --n 2048``): the fused SWIM lifecycle must ride the
+    resident-round kernel at ~no cost — the acceptance bar is
+    suspicion-on within 1.2x of suspicion-off on the same kernel config
+    — while the XLA pair gives the compiled-epilogue delta on any
+    backend.  ``interpret=False`` is the on-chip form (the next TPU
+    session's probe_rr_suspicion runs the same A/B compiled).
+    """
+    from gossipfs_tpu.suspicion.params import SuspicionParams
+
+    sus = SuspicionParams(t_suspect=2)
+    xla = dataclasses.replace(
+        base_config(n), hb_dtype="int8", elementwise="swar", t_fail=3,
+    )
+    rr = SimConfig(
+        n=n, topology="random_arc", fanout=-(-SimConfig.log_fanout(n) // 8) * 8,
+        arc_align=8, remove_broadcast=False, fresh_cooldown=True,
+        t_cooldown=12, t_fail=3,
+        merge_kernel="pallas_rr_interpret" if interpret else "pallas_rr",
+        merge_block_c=min(2048, n // 2), view_dtype="int8", hb_dtype="int8",
+        merge_block_r=128, rr_resident="on", elementwise="swar",
+    )
+    return {
+        "xla_swar": xla,
+        "xla_swar_sus": dataclasses.replace(xla, suspicion=sus),
+        "rr_swar": rr,
+        "rr_swar_sus": dataclasses.replace(rr, suspicion=sus),
+    }
+
+
 # v5e HBM peak (one chip): 819 GB/s
 HBM_PEAK_GBS = 819.0
 
@@ -218,6 +251,15 @@ def main(argv=None) -> None:
     p.add_argument("--n", type=int, default=16_384)
     p.add_argument("--rounds", type=int, default=100)
     p.add_argument("--only", nargs="*", default=None)
+    p.add_argument("--suspicion", action="store_true",
+                   help="round-11 fast-path A/B rows: suspicion-on vs "
+                        "-off on the same kernel config (XLA/SWAR "
+                        "compiled pair + rr pair; rr rows run the "
+                        "interpret kernel off-TPU — the ROUNDPROF_r11 "
+                        "artifact's command is --suspicion --n 2048)")
+    p.add_argument("--compiled-rr", action="store_true",
+                   help="with --suspicion: compiled pallas_rr rows "
+                        "(TPU) instead of the interpret form")
     args = p.parse_args(argv)
 
     # self-describing header row (obs.schema.ROUNDPROF_SCHEMA): committed
@@ -229,11 +271,14 @@ def main(argv=None) -> None:
     print(json.dumps({
         "schema": obs_schema.ROUNDPROF_SCHEMA, "tool": "roundprof",
         "n": args.n, "rounds": args.rounds,
+        **({"mode": "suspicion_ab"} if args.suspicion else {}),
         "backend": jax.default_backend(),
     }), flush=True)
 
+    table = (suspicion_variants(args.n, interpret=not args.compiled_rr)
+             if args.suspicion else variants(args.n))
     rows = {}
-    for name, cfg in variants(args.n).items():
+    for name, cfg in table.items():
         if args.only and name not in args.only:
             continue
         per_round = time_config(cfg, args.rounds)
@@ -242,6 +287,8 @@ def main(argv=None) -> None:
             "rounds_per_sec": round(1.0 / per_round, 1),
             "elementwise": cfg.elementwise,
             "rr_rotate": cfg.rr_rotate,
+            "merge_kernel": cfg.merge_kernel,
+            "suspicion": cfg.suspicion is not None,
             "backend": jax.default_backend(),
             **bandwidth_row(cfg, per_round),
         }

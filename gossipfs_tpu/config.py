@@ -114,28 +114,20 @@ class SimConfig:
                                      # "*_interpret" variants run the same
                                      # kernels in interpreter mode (CPU
                                      # tests only — slow).
-                                     # Scenario engine (scenarios/): runs
-                                     # with active link faults fall back
-                                     # to "xla" (run_rounds substitutes it
-                                     # via scenarios.tensor.
-                                     # xla_fallback_config) — the pallas/rr
-                                     # kernels fuse gather+epilogue over
-                                     # unfiltered edge semantics; the XLA
-                                     # path consumes per-edge-filtered
-                                     # edges natively.  Same protocol
-                                     # arithmetic, fault-free transport
-                                     # stays on the fast kernels.
-                                     # Suspicion subsystem (suspicion/):
-                                     # same gating rule — a config with
-                                     # ``suspicion`` set requires
-                                     # merge_kernel="xla" (the pallas/rr
-                                     # kernels fuse the MEMBER-only
-                                     # tick/epilogue in-kernel and know
-                                     # nothing of the SUSPECT lifecycle);
-                                     # suspicion.with_suspicion(cfg, p)
-                                     # substitutes it like
-                                     # xla_fallback_config does for
-                                     # scenario runs
+                                     # Round 11 (fast-path unification):
+                                     # scenario edge filters and the
+                                     # suspicion lifecycle run on EVERY
+                                     # merge kernel — scenario runs
+                                     # rewrite the sampled [N, F] edges
+                                     # (aligned arcs: group-granular
+                                     # match masks) before any gather,
+                                     # and the SUSPECT/refute transitions
+                                     # are fused into the pallas/rr
+                                     # epilogues and the rr packed tick.
+                                     # The old forced-"xla" substitution
+                                     # is retired; fallback_config()
+                                     # below remains for explicitly
+                                     # requesting the oracle path.
     view_dtype: str = "int16"        # gossip-view storage: "int16" | "int8".
                                      # int8 halves the merge's HBM traffic but
                                      # its 126-round rebase window only covers
@@ -204,12 +196,16 @@ class SimConfig:
                                      # crash-on-timeout.  Requires the
                                      # gossip-only protocol mode
                                      # (remove_broadcast off + fresh
-                                     # cooldown), merge_kernel="xla" and
-                                     # elementwise="lanes" — see
-                                     # suspicion/tensor.py (the scenario
-                                     # engine's gating pattern); build
-                                     # configs via
-                                     # suspicion.with_suspicion(cfg, p)
+                                     # cooldown).  Round 11: runs on
+                                     # every merge kernel and both
+                                     # elementwise forms (the lifecycle
+                                     # is fused into the rr/SWAR fast
+                                     # path); one graceful degradation —
+                                     # lh_multiplier > 0 needs
+                                     # per-receiver SUSPECT counts the rr
+                                     # kernel doesn't carry, so those
+                                     # runs take the stripe/XLA merge
+                                     # (core/rounds._use_rr), same bits
     fused_tick: str = "auto"         # "auto": rounds with no join/leave events
                                      # and remove_broadcast off fuse the
                                      # heartbeat tick (bump/detect/cooldown)
@@ -368,10 +364,14 @@ class SimConfig:
         if self.fused_tick not in ("auto", "off"):
             raise ValueError(f"unknown fused_tick: {self.fused_tick!r}")
         if self.suspicion is not None:
-            # SWIM suspect/refute lifecycle: enforce the engine gating at
-            # construction (suspicion/tensor.py documents the why) so a
-            # fast-kernel + suspicion config is unconstructible rather
-            # than silently running the suspicion-free kernels
+            # SWIM suspect/refute lifecycle.  Round 11 removed the
+            # merge_kernel="xla" / elementwise="lanes" construction gates:
+            # the lifecycle is fused into every merge path (XLA lanes +
+            # SWAR epilogues, the stripe/arc kernels' in-kernel epilogue,
+            # and the resident-round packed tick/merge — see
+            # ops/merge_pallas.py and suspicion/tensor.py's capability
+            # notes).  What remains checkable at construction is the
+            # dissemination mode and the age-lane clock budget.
             from gossipfs_tpu.suspicion.params import SuspicionParams
             from gossipfs_tpu.suspicion.tensor import (
                 require_suspicion_config,
@@ -383,21 +383,8 @@ class SimConfig:
                     f"{type(self.suspicion).__name__}"
                 )
             # the dissemination-mode requirements have ONE owner
-            # (suspicion/tensor.py documents the why); only the kernel
-            # gates below — the ones with_suspicion substitutes rather
-            # than requires — live here
+            # (suspicion/tensor.py documents the why)
             require_suspicion_config(self)
-            if self.merge_kernel != "xla":
-                raise ValueError(
-                    "suspicion requires merge_kernel='xla' (the pallas/rr "
-                    "kernels fuse the MEMBER-only round in-kernel; use "
-                    "suspicion.with_suspicion, which substitutes it)"
-                )
-            if self.elementwise != "lanes":
-                raise ValueError(
-                    "suspicion requires elementwise='lanes' (the SWAR word "
-                    "constants encode the 3-state status machine)"
-                )
             worst = self.suspicion.max_confirm_after(self.t_fail)
             if worst >= AGE_CLAMP:
                 raise ValueError(
@@ -458,6 +445,23 @@ class SimConfig:
         return max(1, math.ceil(math.log2(max(n, 2))))
 
     @classmethod
+    def suspicion_rr(cls, n: int, block_c: int = 1024, t_fail: int = 3,
+                     t_suspect: int = 2, interpret: bool = False,
+                     **overrides) -> "SimConfig":
+        """The rr capacity profile with the SWIM lifecycle armed at the
+        fast knob (SUSPECT_r08's t_fail=3 + t_suspect=2) — the round-11
+        fused fast path's production config, shared by the benches and
+        the fastpath-parity tests so none of them drift."""
+        from gossipfs_tpu.suspicion.params import SuspicionParams
+
+        kw = dict(
+            t_fail=t_fail,
+            suspicion=SuspicionParams(t_suspect=t_suspect),
+        )
+        kw.update(overrides)
+        return cls.packed_rr(n, block_c, interpret=interpret, **kw)
+
+    @classmethod
     def packed_rr(cls, n: int, block_c: int = 1024,
                   interpret: bool = False, **overrides) -> "SimConfig":
         """The resident-round capacity profile — ONE definition of the
@@ -472,3 +476,32 @@ class SimConfig:
         )
         kw.update(overrides)
         return cls(**kw)
+
+
+def fallback_config(
+    config: SimConfig, suspicion: "SuspicionParams | None" = None
+) -> SimConfig:
+    """THE oracle-path substitution (one owner — round 11).
+
+    Returns the ``merge_kernel="xla"`` + ``elementwise="lanes"`` form of
+    ``config`` (optionally arming ``suspicion``), preserving everything
+    protocol-level (dtypes, thresholds, topology, dissemination mode).
+
+    Since the fast-path unification the fast kernels run scenarios and
+    suspicion natively, so nothing *requires* this substitution anymore;
+    it survives for explicitly requesting the XLA oracle — parity
+    baselines, A/B bisection, the deprecated
+    ``scenarios.tensor.xla_fallback_config`` /
+    ``suspicion.with_suspicion`` aliases.
+    """
+    rep: dict = {}
+    if suspicion is not None:
+        from gossipfs_tpu.suspicion.tensor import require_suspicion_config
+
+        require_suspicion_config(config)
+        rep["suspicion"] = suspicion
+    if config.merge_kernel != "xla":
+        rep["merge_kernel"] = "xla"
+    if config.elementwise != "lanes":
+        rep["elementwise"] = "lanes"
+    return dataclasses.replace(config, **rep) if rep else config

@@ -595,15 +595,21 @@ def _tick_swar(
     carry-safe bitwise word ops.  Per-receiver masks (active/refresher/
     alive) are uniform across a word's 4 bytes, so they enter as -1/0
     whole-word masks; per-subject thresholds pack 4 to a word; only the
-    diagonal (bump) mask differs per byte (:func:`_eye_words`).  Pinned
-    bit-equal to the lanes branch by the swar parity tests and the golden
-    fuzz suite.
+    diagonal (bump) mask differs per byte (:func:`_eye_words`).  The
+    suspicion branch (round 11) mirrors :func:`_tick`'s SWIM lifecycle —
+    SUSPECT entry, confirmation at the (possibly Lifeguard-stretched)
+    per-receiver threshold, small-group revert — with the per-receiver
+    confirm threshold entering as a replicated word (thresholds are < 63,
+    so the byte replication cannot carry).  Pinned bit-equal to the lanes
+    branch by the swar parity tests and the golden fuzz suite.
     """
     n = state.n
     hb, age, status, alive = state.hb, state.age, state.status, state.alive
     nd, shp = hb.ndim, hb.shape
+    sus = config.suspicion
     MEM = swar.word(int(MEMBER))
     FLW = swar.word(int(FAILED))
+    SUS = swar.word(int(SUSPECT))
     SENT = swar.word(0x80)  # the -128 floor-sentinel byte
     hbw, agew, stw = swar.pack(hb), swar.pack(age), swar.pack(status)
 
@@ -614,8 +620,17 @@ def _tick_swar(
     eye_b = _eye_words(n, shp, ctx)
     stm_b = swar.to_bytes(swar.eq(stw, MEM))
 
-    # small groups only refresh timestamps
-    agew = swar.sel(ref_m & stm_b, jnp.int32(0), agew)
+    # small groups only refresh timestamps; under suspicion the refresh
+    # also reverts SUSPECT -> MEMBER (detection is disabled below
+    # min_group, so suspicion is moot there)
+    if sus is None:
+        agew = swar.sel(ref_m & stm_b, jnp.int32(0), agew)
+    else:
+        sus_pre_b = swar.to_bytes(swar.eq(stw, SUS))
+        listed_b = swar.to_bytes(swar.ne(stw & swar.L, 0))  # status bit 0
+        refresh_b = ref_m & listed_b
+        agew = swar.sel(refresh_b, jnp.int32(0), agew)
+        stw = swar.sel(refresh_b & sus_pre_b, MEM, stw)
     # sentinel-sticky diagonal bump + stamp
     bump_b = eye_b & act_m & stm_b & swar.to_bytes(swar.ne(hbw, SENT))
     hbw = swar.add(hbw, bump_b & swar.L)
@@ -627,11 +642,44 @@ def _tick_swar(
     thr8 = jnp.clip(config.hb_grace - basec + 1, -128, 127).astype(jnp.int8)
     thrw = swar.pack(thr8)[None]
     past_h = swar.ges(hbw, thrw) & swar.ne(hbw, SENT)
-    fail_b = (
+    stale_b = (
         act_m & stm_b & ~eye_b
         & swar.to_bytes(past_h & swar.gts(agew, swar.word(config.t_fail)))
     )
-    stw = swar.sel(fail_b, FLW, stw)
+    if sus is None:
+        fail_b = stale_b
+        stw = swar.sel(fail_b, FLW, stw)
+    else:
+        # SWIM lifecycle (mirrors _tick's lanes branch): stale MEMBER ->
+        # SUSPECT (the age lane keeps running — it is the clock); SUSPECT
+        # confirms to FAILED past the per-receiver threshold.  Lifeguard
+        # local health anchors on the PRE-tick status counts, exactly as
+        # the lanes branch's status0 anchor.
+        if sus.lh_multiplier > 0:
+            cnt_sus = ctx.psum(jnp.sum(
+                (status == SUSPECT).astype(jnp.int32),
+                axis=_subj_axes(status)))
+            cnt_listed = ctx.psum(jnp.sum(
+                _listed(status, config).astype(jnp.int32),
+                axis=_subj_axes(status)))
+            degraded = (cnt_sus.astype(jnp.float32)
+                        > sus.lh_frac * cnt_listed.astype(jnp.float32))
+            confirm_age = (config.t_fail + sus.t_suspect
+                           * (1 + jnp.where(degraded, sus.lh_multiplier, 0)))
+            # per-receiver threshold replicated into all 4 bytes of a
+            # word (thr < AGE_CLAMP = 63, so the multiply cannot carry)
+            thr_sus_w = (confirm_age.astype(jnp.int32)
+                         * jnp.int32(0x01010101)).reshape(
+                             (n,) + (1,) * (nd - 1))
+        else:
+            thr_sus_w = swar.word(config.t_fail + sus.t_suspect)
+        confirm_b = (
+            act_m & sus_pre_b & ~eye_b
+            & swar.to_bytes(swar.gts(agew, thr_sus_w))
+        )
+        stw = swar.sel(stale_b, SUS, stw)
+        stw = swar.sel(confirm_b, FLW, stw)
+        fail_b = confirm_b
     if config.fresh_cooldown:
         agew = swar.sel(fail_b, jnp.int32(0), agew)
 
@@ -773,7 +821,8 @@ def _membership_update(
     if narrow and config.elementwise == "swar" and swar_lanes_ok(hb):
         # packed-word formulation of the all-int8 epilogue (4 subjects
         # per i32 op) — complete, including the age advance
-        return _membership_update_swar(state, best_rel, shift_a, shift_b)
+        return _membership_update_swar(state, best_rel, shift_a, shift_b,
+                                       config)
     vdtype = jnp.int8 if config.view_dtype == "int8" else jnp.int16
     any_member = best_rel >= 0
     recv = _rx(alive, nd)
@@ -867,6 +916,7 @@ def _membership_update_swar(
     best_rel: jax.Array,
     shift_a: jax.Array,
     shift_b: jax.Array,
+    config: SimConfig,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """SWAR formulation of :func:`_membership_update`'s all-int8 branch.
 
@@ -876,10 +926,14 @@ def _membership_update_swar(
     ops/swar.py).  The per-subject saturation thresholds are the narrow
     branch's exact clip math (i32 vector ops, packed once); byte adds and
     subs wrap mod 2^8 exactly like the narrow branch's int8 arithmetic.
-    Pinned bit-equal by the swar parity tests and the golden fuzz suite.
+    Under suspicion (round 11) the advance eligibility widens to LISTED
+    (one status-bit-0 word test: MEMBER=1 | SUSPECT=3) and every update
+    writes MEMBER — the advance-on-SUSPECT IS the refutation.  Pinned
+    bit-equal by the swar parity tests and the golden fuzz suite.
     """
     hb, age, status, alive = state.hb, state.age, state.status, state.alive
     n, nd, shp = state.n, hb.ndim, hb.shape
+    sus = config.suspicion is not None
     MEM = swar.word(int(MEMBER))
     FLOOR = swar.word(0x80)  # the int8 storage floor, -128
     sb32 = shift_b
@@ -901,8 +955,12 @@ def _membership_update_swar(
     bestw = swar.pack(best_rel)
     recv_m = swar.bool_mask(alive).reshape((n,) + (1,) * (nd - 1))
     anym_h = ~bestw & swar.H  # best_rel >= 0: sign bit clear
+    elig_h = (
+        swar.ne(stw & swar.L, 0)  # listed: MEMBER | SUSPECT (bit 0)
+        if sus else swar.eq(stw, MEM)
+    )
     adv_b = recv_m & swar.to_bytes(
-        swar.eq(stw, MEM) & anym_h
+        elig_h & anym_h
         & swar.gts(bestw, cmp_deepw)
         & swar.gts(swar.add(bestw, sa_nw), hbw)  # the wrapping int8 lhs
     )
@@ -921,7 +979,10 @@ def _membership_update_swar(
     )
     hbw = swar.sel(upd_b, up_val, keep_val)
     agew = swar.sel(upd_b, jnp.int32(0), agew)
-    stw = swar.sel(add_b, MEM, stw)
+    # every update writes MEMBER: adds learn the entry, and an advance on
+    # a SUSPECT entry is the refutation (suspicion off, advance lanes are
+    # MEMBER already — same bits as the old add-only select)
+    stw = swar.sel(upd_b, MEM, stw)
     agew = swar.mins(swar.add(agew, swar.L), swar.word(AGE_CLAMP))
     return swar.unpack(hbw), swar.unpack(agew), swar.unpack(stw)
 
@@ -950,6 +1011,7 @@ def _merge(
     colmax_est: jax.Array,
     ctx: ShardCtx = LOCAL_CTX,
     detect_stats: bool = False,
+    arc_match: jax.Array | None = None,
 ) -> tuple[SimState, jax.Array | None, jax.Array | None, jax.Array | None]:
     """Gossip exchange: gather sender rows over in-edges, elementwise-max merge.
 
@@ -1006,10 +1068,20 @@ def _merge(
     # fused kernel can write each [N, N] lane exactly once.
     use_pallas = _use_pallas(config, fanout, state.n, _nsubj(hb.shape))
     stripe_kernel = config.merge_kernel.startswith(("pallas_stripe", "pallas_rr"))
+    suspect = int(SUSPECT) if config.suspicion is not None else None
     best_rel = None  # set on the paths that share the XLA membership update
     cnt_incl = None  # per-subject live-member count (self included)
     k_ndet = k_fobs = None  # in-kernel detection stats (detect_stats only)
-    if use_pallas and hb.ndim == 4 and arc and stripe_kernel:
+    if arc and arc_match is not None:
+        # scenario-filtered aligned arcs: group-granular match masks over
+        # the per-group maxes (scenarios/tensor.py arc_match_edges).  The
+        # arc stripe kernels fuse the UNfiltered window max, so filtered
+        # rounds take the XLA group form; the rr scan has its own fused
+        # edge_filter path (merge_pallas.resident_round_blocked)
+        best_rel = merge_pallas.arc_group_window_max_xla(
+            view, arc_match, fanout, config.arc_align
+        )
+    elif use_pallas and hb.ndim == 4 and arc and stripe_kernel:
         # arc topology: windowed row-max over the resident stripe (O(log F)
         # shared passes) + one vector load per receiver + the block-wide
         # epilogue, all in one kernel — each lane read and written once
@@ -1021,6 +1093,7 @@ def _merge(
                 age_clamp=AGE_CLAMP, failed=int(FAILED),
                 detect_stats=detect_stats, block_r=config.merge_block_r,
                 interpret=config.merge_kernel.endswith("interpret"),
+                suspect=suspect,
             )
         )
     elif use_pallas:
@@ -1045,7 +1118,7 @@ def _merge(
                 merge_pallas.stripe_merge_update_blocked(
                     view, edges, hb, age, status, shift_a, shift_b, alive32,
                     failed=int(FAILED), detect_stats=detect_stats,
-                    **stripe_kwargs
+                    suspect=suspect, **stripe_kwargs
                 )
             )
         elif hb.ndim == 4:
@@ -1056,7 +1129,7 @@ def _merge(
                 merge_pallas.fused_merge_update_blocked(
                     view, edges, hb, age, status, shift_a, shift_b, alive32,
                     failed=int(FAILED), detect_stats=detect_stats,
-                    **kernel_kwargs
+                    suspect=suspect, **kernel_kwargs
                 )
             )
         else:
@@ -1064,7 +1137,8 @@ def _merge(
             # per-round reshapes — acceptable for the parity mode
             hb, age, status = merge_pallas.fused_merge_update(
                 view, edges, hb, age, status, shift_a, shift_b, alive32,
-                block_c=config.merge_block_c, **kernel_kwargs
+                block_c=config.merge_block_c, suspect=suspect,
+                **kernel_kwargs
             )
     elif arc:
         # XLA arc formulation: windowed row-max + one gather, F-independent
@@ -1102,6 +1176,8 @@ def _round_core(
     ctx: ShardCtx = LOCAL_CTX,
     matrix_events: bool = True,
     edge_filter=None,
+    sends: jax.Array | None = None,
+    arc_match: jax.Array | None = None,
 ) -> tuple[SimState, RoundMetrics, jax.Array, jax.Array, jax.Array,
            jax.Array | None, jax.Array | None]:
     """One round, layout- and shard-generic (state may be 2-D or blocked,
@@ -1112,6 +1188,10 @@ def _round_core(
     scenarios/tensor.py).  Only passed on paths whose edges are the
     explicit [N, F] form and were not already filtered by the caller
     (the ring mode, whose edges derive from the post-tick tables here).
+    ``sends``/``arc_match``: the aligned-arc scenario form — sender mute
+    mask (a muted sender's view row encodes absent) and the [N, 2]
+    (base, group-match bitmask) pairs for the group-granular partition
+    filter (scenarios.tensor.sends_mask / arc_match_edges).
 
     Returns (state, metrics, fail, any_fail [nloc], first_obs [nloc],
     member_col [nloc] | None — see :func:`_merge`, any_suspect [nloc] |
@@ -1144,8 +1224,10 @@ def _round_core(
     # _merge also advances age for every entry not refreshed this round
     # (refreshes wrote 0, then everything ages by one, saturating at
     # AGE_CLAMP — beyond every protocol threshold, config.py)
+    senders = active if sends is None else active & sends
     state, member_col, k_ndet, k_fobs = _merge(
-        state, edges, active, config, colmax_est, ctx, detect_stats=det_ok
+        state, edges, senders, config, colmax_est, ctx, detect_stats=det_ok,
+        arc_match=arc_match,
     )
     state = state._replace(round=state.round + 1)
 
@@ -1153,9 +1235,11 @@ def _round_core(
     any_sus = None
     if sus_on:
         # Suspicion observables, all off the three status snapshots the
-        # round already produced (pre-tick, post-tick, post-merge) — the
-        # suspicion lane runs XLA-only (suspicion/tensor.py gating), so
-        # these full-matrix reductions never touch the kernel fast path.
+        # round already produced (pre-tick, post-tick, post-merge).
+        # Round 11: suspicion runs on the stripe/arc pallas kernels
+        # through this function too, so these full-matrix reductions DO
+        # run alongside those kernels; only the rr scan avoids them (its
+        # counters are in-kernel sums, _scan_rounds_rr_packed).
         status_f, alive_f = state.status, state.alive
         shp_f = status_f.shape
         entered = (tick_status == SUSPECT) & (pre_status != SUSPECT)
@@ -1223,15 +1307,13 @@ def _fused_ok(config: SimConfig, matrix_events: bool, n: int, nloc: int) -> bool
         or matrix_events
         or config.remove_broadcast
         or config.topology == "ring"
-        # suspicion runs take the separate-pass round: the lifecycle's
-        # observables (suspects entered / refuted, the first-suspect
-        # carry) read the post-tick status snapshot the fused round
-        # exists to never materialize — one code path, pinned by the
-        # golden suspicion tests, beats a second fused variant on what
-        # is an XLA-only evaluation lane anyway
-        or config.suspicion is not None
     ):
         return False
+    # Round 11: suspicion runs take the fused round too — the lifecycle's
+    # observables (suspects entered / refuted, the first-suspect carry)
+    # are column reductions over the recomputed tick, the same consumer-
+    # fusion pattern the fail reductions already use, so the post-tick
+    # lanes still never materialize.
     return not _use_pallas(config, config.fanout, n, nloc)
 
 
@@ -1241,7 +1323,10 @@ def _round_core_fused(
     edges: jax.Array,
     config: SimConfig,
     ctx: ShardCtx = LOCAL_CTX,
-) -> tuple[SimState, RoundMetrics, jax.Array, jax.Array, jax.Array | None]:
+    sends: jax.Array | None = None,
+    arc_match: jax.Array | None = None,
+) -> tuple[SimState, RoundMetrics, jax.Array, jax.Array, jax.Array,
+           jax.Array | None]:
     """One crash-only round with the tick recomputed around the merge kernel.
 
     Semantically identical to :func:`_round_core` under
@@ -1253,20 +1338,34 @@ def _round_core_fused(
     materializes, only its column reductions.  Serves the XLA merge paths
     (CPU, shards, shapes without a stripe kernel); stripe-kernel shapes use
     the separate-pass round, whose in-kernel epilogue already writes each
-    lane once (see :func:`_fused_ok`).
+    lane once (see :func:`_fused_ok`).  Suspicion runs (round 11) fuse
+    here too: the lifecycle's transitions live in :func:`_tick` /
+    :func:`_membership_update`, and its observables are column reductions
+    over the recomputed tick — more consumers, no new materialization.
+    ``sends``/``arc_match``: the aligned-arc scenario form, as in
+    :func:`_round_core`.
 
-    Returns (state, metrics, member_col, any_fail, first_obs).
+    Returns (state, metrics, member_col, any_fail, first_obs, any_suspect).
     """
     n = state.n
+    sus_on = config.suspicion is not None
     state = state._replace(alive=state.alive & ~crash)
     active, refresher, colmax_est = _pre_tick(state, config, ctx)
     shift_a, shift_b, store_base = _rebase_shifts(state, config, colmax_est)
     # one traced tick: XLA fuses it into the view build and the fail
     # reductions below (the arrays of st2 that feed neither are dead code)
     st2, fail = _tick(state, config, ctx, active=active, refresher=refresher)
-    view = _gossip_view(st2, active, shift_a, config)
+    senders = active if sends is None else active & sends
+    view = _gossip_view(st2, senders, shift_a, config)
 
-    best_rel = _merge_best(st2, view, edges, config)
+    if arc_match is not None and config.topology == "random_arc":
+        from gossipfs_tpu.ops import merge_pallas
+
+        best_rel = merge_pallas.arc_group_window_max_xla(
+            view, arc_match, config.fanout, config.arc_align
+        )
+    else:
+        best_rel = _merge_best(st2, view, edges, config)
     # The tick feeds consumers on BOTH sides of the opaque merge kernel:
     # the view build above and the membership update below.  Left alone,
     # XLA CSEs the two into one tick whose post-tick lanes then
@@ -1289,10 +1388,11 @@ def _round_core_fused(
     )
     # per-subject live-observer count off the fresh status (fuses as a
     # consumer of the update pass; replaces _update_carry's full-matrix
-    # all_dropped reduction)
+    # all_dropped reduction).  Listed = MEMBER | SUSPECT under suspicion:
+    # a SUSPECT holder has not dropped the entry
     member_col = jnp.sum(
         (
-            (status == MEMBER)
+            _listed(status, config)
             & _rx(new_state.alive, status.ndim)
             & ~_eye(n, status.shape, ctx)
         ).astype(jnp.int32),
@@ -1300,11 +1400,29 @@ def _round_core_fused(
     ).reshape(_nsubj(status.shape))
     new_state = new_state._replace(round=state.round + 1)
 
+    sus_stats = None
+    any_sus = None
+    if sus_on:
+        # suspicion observables — the same three snapshots _round_core
+        # anchors on (post-events pre-tick, post-tick, post-merge), all
+        # available here as fusion consumers of the recomputed tick
+        shp_f = status.shape
+        entered = (st2.status == SUSPECT) & (state.status != SUSPECT)
+        refuted = (st2.status == SUSPECT) & (status == MEMBER)
+        alive_col = _sj(new_state.alive, shp_f, ctx)
+        sus_stats = (
+            ctx.psum(jnp.sum(entered, dtype=jnp.int32)),
+            ctx.psum(jnp.sum(refuted, dtype=jnp.int32)),
+            ctx.psum(jnp.sum(refuted & alive_col, dtype=jnp.int32)),
+        )
+        any_sus = jnp.any(status == SUSPECT, axis=0).reshape(_nsubj(shp_f))
+
     nloc = _nsubj(fail.shape)
     n_det = jnp.sum(fail, axis=0, dtype=jnp.int32).reshape(nloc)
     first_obs_now = jnp.argmax(fail, axis=0).astype(jnp.int32).reshape(nloc)
-    metrics, any_fail = _round_stats(n_det, new_state, ctx)
-    return new_state, metrics, member_col, any_fail, first_obs_now
+    metrics, any_fail = _round_stats(n_det, new_state, ctx,
+                                     sus_stats=sus_stats)
+    return new_state, metrics, member_col, any_fail, first_obs_now, any_sus
 
 
 def _gossip_round_impl(
@@ -1364,12 +1482,26 @@ def _gossip_round_scenario_impl(
 
     Same contract as :func:`_gossip_round_impl`, plus ``tsc`` (a
     scenarios.tensor.TensorScenario) and a per-round ``key`` for the
-    Bernoulli loss draws.  Scenario configs are XLA-merge
-    (scenarios.tensor.xla_fallback_config — callers pass that config
-    here), so the state stays 2-D and no blocked relayout happens.
+    Bernoulli loss draws.  The interactive evaluation lane runs the
+    XLA-oracle config (detector.sim substitutes config.fallback_config),
+    so the state stays 2-D and no blocked relayout happens; aligned-arc
+    configs take the group-granular filter form (the per-edge rewrite
+    has no arc shape — scenarios/tensor.py).
     """
-    from gossipfs_tpu.scenarios.tensor import filter_edges
+    from gossipfs_tpu.scenarios.tensor import (
+        arc_match_edges,
+        filter_edges,
+        sends_mask,
+    )
 
+    if config.topology == "random_arc":
+        sends = sends_mask(tsc, state.n, state.round)
+        arc_match = arc_match_edges(tsc, edges, state.round,
+                                    config.fanout, config.arc_align)
+        state, metrics, _fail, any_fail, first_obs, _, _ = _round_core(
+            state, events, edges, config, sends=sends, arc_match=arc_match
+        )
+        return state, metrics, any_fail, first_obs
     ef = lambda e: filter_edges(tsc, e, state.round, key)  # noqa: E731
     state, metrics, _fail, any_fail, first_obs, _, _ = _round_core(
         state, events, edges, config, edge_filter=ef
@@ -1472,6 +1604,14 @@ def _use_rr(config: SimConfig, n: int, nloc: int) -> bool:
         or config.fused_tick != "auto"
     ):
         return False
+    if config.suspicion is not None and config.suspicion.lh_multiplier > 0:
+        # the Lifeguard local-health stretch derives a per-receiver
+        # confirmation threshold from per-receiver SUSPECT counts, which
+        # the rr kernel does not carry — such runs degrade gracefully to
+        # the stripe/XLA merge (same bits, slower path); the plain
+        # lifecycle (lh_multiplier == 0, the SUSPECT_r08 production knob)
+        # is fully fused
+        return False
     if config.topology == "random_arc" and (
         config.n % merge_pallas.ARC_CHUNK
         or not 1 < config.fanout <= merge_pallas.ARC_CHUNK
@@ -1490,7 +1630,8 @@ def _use_rr(config: SimConfig, n: int, nloc: int) -> bool:
 
 
 def _rr_scan_eligible(config: SimConfig, n: int, nloc: int,
-                      matrix_events: bool, ctx: ShardCtx) -> bool:
+                      matrix_events: bool, ctx: ShardCtx,
+                      scenario=None) -> bool:
     """Single rr-scan gate, shared by the dispatch in :func:`_scan_rounds`
     and the layout decision in :func:`_run_rounds_impl` — two separately
     maintained copies would let the relayout and the dispatch drift (a
@@ -1501,8 +1642,23 @@ def _rr_scan_eligible(config: SimConfig, n: int, nloc: int,
     ``run_rounds_sharded`` executes the same resident-round program the
     v5e-8 projection models.  ``nloc`` (the shard's columns) carries the
     per-shard stripe-width divisibility through ``_use_rr``.
+
+    Round 11: an armed scenario is eligible too — explicit-edge runs
+    rewrite the sampled [N, F] edges before the in-kernel gather, and
+    aligned arcs run the kernel's ``edge_filter`` masked-gather form
+    (group-match mask packed in an int32 — hence the nw bound; the rule
+    compatibility itself was validated at the run entry,
+    scenarios.tensor.require_scenario_config).
     """
-    return not matrix_events and _use_rr(config, n, nloc)
+    if matrix_events or not _use_rr(config, n, nloc):
+        return False
+    if scenario is not None and config.topology == "random_arc":
+        from gossipfs_tpu.ops.merge_pallas import ARC_MATCH_MAX_GROUPS
+
+        return (config.arc_align > 1
+                and config.fanout // config.arc_align
+                <= ARC_MATCH_MAX_GROUPS)
+    return True
 
 
 def _scan_rounds_rr(
@@ -1514,6 +1670,7 @@ def _scan_rounds_rr(
     churn_ok: jax.Array | None,
     mcarry0: MetricsCarry | None = None,
     ctx: ShardCtx = LOCAL_CTX,
+    scenario=None,
 ) -> tuple[SimState, MetricsCarry, RoundMetrics]:
     """The lean crash-only scan over the resident-round kernel.
 
@@ -1544,7 +1701,7 @@ def _scan_rounds_rr(
         _scan_rounds_rr_packed(
             hb4, as4, state.alive, state.hb_base, state.round,
             config, key, events, crash_rate, churn_ok, mcarry0,
-            ctx=ctx,
+            ctx=ctx, scenario=scenario,
         )
     )
     age_w, st_w = merge_pallas.unpack_age_status(as4)
@@ -1635,6 +1792,7 @@ def _scan_rounds_rr_packed(
     mcarry0: MetricsCarry | None = None,
     counts0: jax.Array | None = None,
     ctx: ShardCtx = LOCAL_CTX,
+    scenario=None,
 ) -> tuple:
     """The rr scan core over stripe-major PACKED lanes.
 
@@ -1657,6 +1815,14 @@ def _scan_rounds_rr_packed(
     """
     from gossipfs_tpu.ops import merge_pallas
 
+    if scenario is not None:
+        from gossipfs_tpu.scenarios.tensor import (
+            arc_match_edges as scn_arc_match,
+            filter_edges as scn_filter_edges,
+            sends_mask as scn_sends_mask,
+        )
+    sus = config.suspicion
+    arc_topo = config.topology == "random_arc"
     interp = config.merge_kernel.endswith("interpret")
     lane = merge_pallas.LANE
     nc, n, cs, _ = hb4.shape
@@ -1684,10 +1850,13 @@ def _scan_rounds_rr_packed(
     if counts0 is None:
         # a full pass over the packed lane; per-round drivers
         # (detector.sim.PackedDetector) thread the carried counts back in
-        # instead of paying it every advance
+        # instead of paying it every advance.  Listed = MEMBER | SUSPECT
+        # under suspicion (a suspect still counts toward min_group) —
+        # status bit 0 is the listed bit in the core/state.py encoding
+        st0 = merge_pallas.unpack_age_status(as4)[1]
+        listed0 = (st0 & 1) == 1 if sus is not None else st0 == MEMBER
         counts0 = ctx.psum(jnp.sum(
-            (merge_pallas.unpack_age_status(as4)[1] == MEMBER)
-            .astype(jnp.int32),
+            listed0.astype(jnp.int32),
             axis=(0, 2, 3),
         ))
 
@@ -1718,10 +1887,18 @@ def _scan_rounds_rr_packed(
             hb4.dtype, basec, config, colmax_est
         )
         g = config.hb_grace - basec
+        muted = None
+        if scenario is not None and arc_topo:
+            # aligned-arc slow-sender mute rides the flags (bit 3): the
+            # kernel's view encode drops the whole row — the sender-side
+            # equivalent of rewriting all its out-edges (the per-edge
+            # form aligned arcs don't have)
+            muted = ~scn_sends_mask(scenario, n, rnd)
         flags = (
             active.astype(jnp.int32)
             + refresher.astype(jnp.int32) * 2
             + alive.astype(jnp.int32) * 4
+            + (muted.astype(jnp.int32) * 8 if muted is not None else 0)
         ).astype(jnp.int8)
         # LANE-compacted flags layout ([N/LANE, LANE] row-major, 1 B/row
         # of kernel VMEM instead of the lane-replicated LANE B/row); the
@@ -1732,8 +1909,24 @@ def _scan_rounds_rr_packed(
         else:  # pragma: no cover - rr requires lane-aligned N
             flags = jnp.broadcast_to(flags[:, None], (n, lane))
         edges = topology.in_edges(config, k_edge, None)
-        arc_fanout = config.fanout if config.topology == "random_arc" else None
-        hb2, as2, cnt_incl, ndet, fobs, rcnt = (
+        arc_fanout = config.fanout if arc_topo else None
+        edge_filter = False
+        if scenario is not None:
+            # same per-round key derivation as the non-rr scan, so a
+            # horizon is bit-identical across dispatches
+            k_scn = jax.random.fold_in(k, 0x5CE)
+            if arc_topo:
+                # group-granular partition filter: (base, match-mask)
+                # pairs drive the kernel's masked gather
+                edges = scn_arc_match(scenario, edges, rnd,
+                                      config.fanout, config.arc_align)
+                edge_filter = True
+            else:
+                # explicit-edge rewrite: a dropped message's edge points
+                # at the receiver — the kernel gathers the receiver's own
+                # view row, a no-op merge (scenarios/tensor.py)
+                edges = scn_filter_edges(scenario, edges, rnd, k_scn)
+        hb2, as2, cnt_incl, ndet, fobs, rcnt, nsus, nref, suscnt = (
             merge_pallas.resident_round_blocked(
                 edges, hb4, as4, flags,
                 sa.reshape(subj_shape), sb.reshape(subj_shape),
@@ -1746,6 +1939,9 @@ def _scan_rounds_rr_packed(
                 arc_align=config.arc_align,
                 elementwise=config.elementwise,
                 rotate=config.rr_rotate != "off",
+                suspect=int(SUSPECT) if sus is not None else None,
+                t_suspect=sus.t_suspect if sus is not None else 0,
+                edge_filter=edge_filter,
             )
         )
         # two count forms (merge_pallas.resident_round_blocked): the
@@ -1766,14 +1962,32 @@ def _scan_rounds_rr_packed(
         cols = _Cols(alive=alive, n=n)
         n_det = ndet.reshape(nloc)
         first_obs = fobs.reshape(nloc)
-        metrics, any_fail = _round_stats(n_det, cols, ctx)
+        sus_stats = None
+        any_sus = None
+        if sus is not None:
+            # suspicion observables off the kernel's per-subject
+            # reductions — the XLA path's full-matrix snapshot reductions
+            # never happen on the fused fast path
+            nsus_v = nsus.reshape(nloc)
+            nref_v = nref.reshape(nloc)
+            alive_l = ctx.slice_cols(alive, nloc)
+            sus_stats = (
+                ctx.psum(jnp.sum(nsus_v)),
+                ctx.psum(jnp.sum(nref_v)),
+                ctx.psum(jnp.sum(jnp.where(alive_l, nref_v, 0))),
+            )
+            any_sus = suscnt.reshape(nloc) > 0
+        metrics, any_fail = _round_stats(n_det, cols, ctx,
+                                         sus_stats=sus_stats)
+        # the diagonal is never SUSPECT (self-suspicion needs staleness,
+        # which excludes self), so the MEMBER test is the listed test
         self_member = ctx.slice_cols(alive, nloc) & (
             merge_pallas.unpack_age_status(diag(as2))[1] == MEMBER
         )
         member_col = cnt_incl.reshape(nloc) - self_member.astype(jnp.int32)
         rejoined = jnp.zeros_like(alive)  # constant: resets fold away
         mc = _update_carry(mc, cols, rejoined, any_fail, first_obs, rnd,
-                           ctx, member_col=member_col)
+                           ctx, member_col=member_col, any_suspect=any_sus)
         return (hb2, as2, alive, store_base, rnd + 1, mc, counts_next), metrics
 
     if mcarry0 is None:
@@ -1820,15 +2034,17 @@ def _scan_rounds(
     """
     if scenario is not None:
         from gossipfs_tpu.scenarios.tensor import (
+            arc_match_edges as scn_arc_match,
             filter_edges as scn_filter_edges,
+            sends_mask as scn_sends_mask,
         )
     if _rr_scan_eligible(config, state.n, _nsubj(state.hb.shape),
-                         matrix_events, ctx):
+                         matrix_events, ctx, scenario=scenario):
         # whole round in one kernel; rejoin_rate is 0 here (a nonzero rate
         # forces matrix_events at the caller)
         return _scan_rounds_rr(
             state, config, key, events, crash_rate, churn_ok, mcarry0,
-            ctx=ctx,
+            ctx=ctx, scenario=scenario,
         )
     fused = _fused_ok(config, matrix_events, state.n, _nsubj(state.hb.shape))
 
@@ -1854,9 +2070,21 @@ def _scan_rounds(
         if scenario is not None:
             k_scn = jax.random.fold_in(k, 0x5CE)
             ef = lambda e: scn_filter_edges(scenario, e, st.round, k_scn)  # noqa: E731
+        sends = arc_match = None
         if config.topology == "ring":
             edges = None  # derived per-round from the membership tables
             ring_filter = ef  # applied inside _round_core, post-derivation
+        elif config.topology == "random_arc":
+            edges = topology.in_edges(config, k_edge, None)  # arc bases
+            ring_filter = None
+            if scenario is not None:
+                # aligned-arc scenario form: group-granular partition
+                # match masks + sender mute (scenarios/tensor.py) — the
+                # per-edge rewrite has no arc form, the group form is
+                # exactly equivalent for align-closed sides
+                sends = scn_sends_mask(scenario, st.n, st.round)
+                arc_match = scn_arc_match(scenario, edges, st.round,
+                                          config.fanout, config.arc_align)
         else:
             edges = topology.in_edges(config, k_edge, None)
             if ef is not None:
@@ -1868,15 +2096,16 @@ def _scan_rounds(
             # matrix_events is False here, so scheduled leaves (if any) can
             # only mean silent death — same liveness effect as a crash
             # (non-ring only, so any scenario filter already ran above)
-            st, metrics, member_col, any_fail, first_obs = _round_core_fused(
-                st, ev.crash | ev.leave, edges, config, ctx
+            (st, metrics, member_col, any_fail, first_obs,
+             any_sus) = _round_core_fused(
+                st, ev.crash | ev.leave, edges, config, ctx,
+                sends=sends, arc_match=arc_match,
             )
-            any_sus = None  # _fused_ok excludes suspicion runs
         else:
             (st, metrics, _fail, any_fail, first_obs, member_col,
              any_sus) = _round_core(
                 st, ev, edges, config, ctx, matrix_events=matrix_events,
-                edge_filter=ring_filter,
+                edge_filter=ring_filter, sends=sends, arc_match=arc_match,
             )
         # joins lost to a dead introducer don't reset metrics (slave.go:22 SPOF)
         if matrix_events:
@@ -1928,15 +2157,6 @@ def _run_rounds_impl(
     around it; the XLA merge path partitions cleanly either way.
     """
     n = config.n
-    if scenario is not None and config.merge_kernel != "xla":
-        # scenario runs arrive through the run_rounds wrappers, which
-        # substitute the XLA-merge fallback config (scenarios/tensor.py
-        # xla_fallback_config) — the rr scan below samples its own edges
-        # in-kernel and would silently ignore the filter
-        raise ValueError(
-            "scenario runs require merge_kernel='xla' (use "
-            "run_rounds(..., scenario=...), which substitutes it)"
-        )
     # static: no scheduled events + no random rejoins => the leave/join
     # matrix rewrites drop out of the compiled round entirely.
     # ``crash_only_events`` is the caller's static promise that scheduled
@@ -1954,7 +2174,7 @@ def _run_rounds_impl(
 
     blocked = _use_blocked(config, config.fanout, n)
     if not blocked and _rr_scan_eligible(config, n, n, matrix_events,
-                                         LOCAL_CTX):
+                                         LOCAL_CTX, scenario=scenario):
         # the rr scan accepts narrower stripe widths than the stripe
         # kernels _use_blocked models (rr_supported vs stripe_supported);
         # it consumes the blocked layout regardless
@@ -2021,14 +2241,17 @@ def run_rounds(
     """Jitted entry for :func:`_run_rounds_impl` (same signature/docs).
 
     ``scenario``: a compiled scenarios.tensor.TensorScenario (or None).
-    Scenario runs execute the XLA-merge fallback config — same protocol
-    arithmetic, per-edge filterable transport (scenarios/tensor.py).
+    Round 11: scenario runs keep the CONFIGURED merge kernel — the rr
+    scan rewrites the sampled edges (or runs the aligned-arc masked
+    gather) and the XLA/stripe paths consume filtered edges natively;
+    only the per-scenario capability matrix is validated here
+    (scenarios.tensor.require_scenario_config).
     """
     check_crash_only_promise(events, crash_only_events)
     if scenario is not None:
-        from gossipfs_tpu.scenarios.tensor import xla_fallback_config
+        from gossipfs_tpu.scenarios.tensor import require_scenario_config
 
-        config = xla_fallback_config(config)
+        require_scenario_config(config, scenario)
     return _run_rounds_jit(
         state, config, num_rounds, key, events, crash_rate, rejoin_rate,
         churn_ok, mcarry0, crash_only_events, scenario,
@@ -2054,9 +2277,9 @@ def run_rounds_donate(
     """
     check_crash_only_promise(events, crash_only_events)
     if scenario is not None:
-        from gossipfs_tpu.scenarios.tensor import xla_fallback_config
+        from gossipfs_tpu.scenarios.tensor import require_scenario_config
 
-        config = xla_fallback_config(config)
+        require_scenario_config(config, scenario)
     return _run_rounds_donate_jit(
         state, config, num_rounds, key, events, crash_rate, rejoin_rate,
         churn_ok, mcarry0, crash_only_events, scenario,

@@ -185,6 +185,10 @@ class SimDetector:
                 f"scenario is for n={scenario.n}, detector has "
                 f"n={self.config.n}"
             )
+        # arc capability checks need the rule tables (Bernoulli loss has
+        # no group form; partition sides must be align-group-closed), so
+        # the config-only check inside xla_fallback_config is not enough
+        scn_tensor.require_scenario_config(self.config, scenario)
         self._join_bulk()
         self._scn_config = scn_tensor.xla_fallback_config(self.config)
         self._scn_tensor = scn_tensor.compile_tensor(

@@ -215,6 +215,7 @@ def fanout_max_merge(
 def _fused_kernel(
     n: int, n_fanout: int, r_blk: int, slots: int,
     member: int, unknown: int, age_clamp: int, failed: int, detect_stats: bool,
+    suspect: int | None = None,
 ):
     def kernel(
         edges_ref, view_ref, hb_hbm, age_hbm, status_hbm, alive_ref, sa_ref, sb_ref,
@@ -276,6 +277,7 @@ def _fused_kernel(
             recv, sa_ref[0][None], sb_ref[0][None],
             hb_out, age_out, status_out, cnt_out, ndet_out, fobs_out,
             i, r_blk, member, unknown, age_clamp, failed, detect_stats, n,
+            suspect=suspect,
         )
 
     return kernel
@@ -344,6 +346,7 @@ def fused_merge_update(
     block_c: int = 8192,  # match SimConfig.merge_block_c's default
     slots: int = 4,
     interpret: bool = False,
+    suspect: int | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """2-D convenience wrapper around :func:`fused_merge_update_blocked`.
 
@@ -369,6 +372,7 @@ def fused_merge_update(
         block_r=block_r,
         slots=slots,
         interpret=interpret,
+        suspect=suspect,
     )
     return h4.reshape(n, n), a4.reshape(n, n), s4.reshape(n, n)
 
@@ -377,7 +381,7 @@ def fused_merge_update(
     jax.jit,
     static_argnames=(
         "member", "unknown", "age_clamp", "failed", "detect_stats",
-        "block_r", "slots", "interpret"
+        "block_r", "slots", "interpret", "suspect"
     ),
 )
 def fused_merge_update_blocked(
@@ -398,6 +402,7 @@ def fused_merge_update_blocked(
     block_r: int = _FUSED_BLOCK_R,
     slots: int = 4,
     interpret: bool = False,
+    suspect: int | None = None,
 ) -> tuple[jax.Array, ...]:
     """Gossip merge + membership update + age advance in one pass.
 
@@ -455,7 +460,7 @@ def fused_merge_update_blocked(
     view4 = view
     out = pl.pallas_call(
         _fused_kernel(n, fanout, r_blk, n_slots, member, unknown, age_clamp,
-                      failed, detect_stats),
+                      failed, detect_stats, suspect=suspect),
         grid=(nc, n // r_blk),
         # in-place lane update: outputs 0-2 reuse the (post-tick) input
         # lane buffers — see the kernel's DMA comment for why it's safe.
@@ -514,6 +519,7 @@ def _epilogue_and_count(
     hb_out, age_out, status_out, cnt_out, ndet_out, fobs_out,
     i, r_blk: int, member: int, unknown: int, age_clamp: int,
     failed: int, detect_stats: bool, n: int, fail=None,
+    suspect: int | None = None,
 ):
     """Block-wide merge epilogue shared by the stripe kernels.
 
@@ -521,7 +527,14 @@ def _epilogue_and_count(
     ``_membership_update``'s int32+clip formulation; ``hb``/``age``/``st``
     arrive widened to int32, ``recv`` is the receiver-liveness mask), plus
     per-subject reductions accumulated across the consecutive receiver
-    blocks that revisit the same output block (grid: j outer, i inner):
+    blocks that revisit the same output block (grid: j outer, i inner).
+
+    ``suspect`` (round 11): the SWIM SUSPECT status value when the config
+    arms suspicion, else None.  A SUSPECT entry is still listed — it
+    advances (the advance IS the refutation: the status write below flips
+    it back to MEMBER) and counts toward the membership tallies; the
+    suspect/confirm transitions themselves live in the tick
+    (core/rounds.py ``_tick``), which runs before these kernels.
 
     * ``cnt_out`` — live observers holding the entry (self included — the
       caller subtracts the diagonal);
@@ -538,7 +551,10 @@ def _epilogue_and_count(
     ~6x slower than minor-axis reductions.
     """
     any_member = best_rel >= 0
-    advance = recv & any_member & (st == member) & (best_rel > hb - sa)
+    listed = (st == member) if suspect is None else (
+        (st == member) | (st == suspect)
+    )
+    advance = recv & any_member & listed & (best_rel > hb - sa)
     add = recv & any_member & (st == unknown)
     upd = advance | add
     new_hb = jnp.where(upd, best_rel + (sa - sb), hb - sb)
@@ -548,10 +564,17 @@ def _epilogue_and_count(
     hb_out[:, 0] = new_hb.astype(hb_out.dtype)
     new_age = jnp.minimum(jnp.where(upd, 0, age) + 1, age_clamp)
     age_out[:, 0] = new_age.astype(age_out.dtype)
-    st_new = jnp.where(add, member, st)
+    # every update writes MEMBER: an add learns the entry, and an advance
+    # on a SUSPECT entry is the refutation (suspicion off: advance lanes
+    # are already MEMBER, so the write is the same bits as the old
+    # add-only select)
+    st_new = jnp.where(upd, member, st)
     status_out[:, 0] = st_new.astype(status_out.dtype)
 
-    part = jnp.sum((recv & (st_new == member)).astype(jnp.int32), axis=0)[None]
+    listed_new = (st_new == member) if suspect is None else (
+        (st_new == member) | (st_new == suspect)
+    )
+    part = jnp.sum((recv & listed_new).astype(jnp.int32), axis=0)[None]
     if detect_stats:
         # recv-masked even though today's writers make it redundant (the
         # detector is the only writer of FAILED/age=0 and it only fires on
@@ -584,6 +607,7 @@ def _epilogue_and_count(
 def _stripe_kernel(
     n: int, n_fanout: int, r_blk: int, member: int, unknown: int,
     age_clamp: int, failed: int, detect_stats: bool,
+    suspect: int | None = None,
 ):
     def kernel(
         edges_ref, view_ref, hb_hbm, age_hbm, status_hbm, alive_ref, sa_ref, sb_ref,
@@ -648,6 +672,7 @@ def _stripe_kernel(
             recv, sa_ref[0][None], sb_ref[0][None],
             hb_out, age_out, status_out, cnt_out, ndet_out, fobs_out,
             i, r_blk, member, unknown, age_clamp, failed, detect_stats, n,
+            suspect=suspect,
         )
 
     return kernel
@@ -853,7 +878,8 @@ RR_ACC_STRIPES = 16
 def rr_align_scratch_specs(n: int, fanout: int, c_blk: int, arc_align: int,
                            *, chunk: int | None = None,
                            resident: bool = False,
-                           rotate: bool = True) -> list:
+                           rotate: bool = True,
+                           edge_filter: bool = False) -> list:
     """The aligned-arc window scratch allocations, as ``pltpu.VMEM`` specs.
 
     This is the SINGLE source the kernel allocates from and the
@@ -873,6 +899,13 @@ def rr_align_scratch_specs(n: int, fanout: int, c_blk: int, arc_align: int,
 
     Fallback (chunks narrower than the halo): the round-5 full-T layout —
     bf16 group maxes for the WHOLE stripe (+wrap halo) beside W.
+
+    ``edge_filter`` (round 11, scenario-armed aligned runs): ONE full
+    int8 T (+wrap halo) and nothing else — group maxes are read directly
+    by the per-receiver masked gather, so no W is precomputed and no
+    ring rotates.  Same c_blk/align B/row order as the ring build's W
+    (int8 either way), so the rotate-based budget the admissibility
+    helpers charge remains an upper bound for scenario runs.
     """
     cs = c_blk // LANE
     nb = n // arc_align
@@ -880,6 +913,8 @@ def rr_align_scratch_specs(n: int, fanout: int, c_blk: int, arc_align: int,
     if chunk is None:
         chunk = rr_view_chunk(n, c_blk, resident=resident,
                               arc_align=arc_align)
+    if edge_filter:
+        return [pltpu.VMEM((nb + max(nw - 1, 0), cs, LANE), jnp.int8)]
     if rotate and rr_ring_supported(fanout, arc_align, chunk):
         gpc = chunk // arc_align
         hw = nw - 1
@@ -957,7 +992,7 @@ def rr_resident_supported(n: int, fanout: int, c_blk: int,
     jax.jit,
     static_argnames=(
         "member", "unknown", "age_clamp", "failed", "detect_stats",
-        "block_r", "interpret",
+        "block_r", "interpret", "suspect",
     ),
 )
 def stripe_merge_update_blocked(
@@ -977,6 +1012,7 @@ def stripe_merge_update_blocked(
     detect_stats: bool = False,
     block_r: int = _FUSED_BLOCK_R,
     interpret: bool = False,
+    suspect: int | None = None,
 ) -> tuple[jax.Array, ...]:
     """Gossip merge + membership update + age advance, stripe-resident.
 
@@ -1022,7 +1058,7 @@ def stripe_merge_update_blocked(
     )
     out = pl.pallas_call(
         _stripe_kernel(n, fanout, r_blk, member, unknown, age_clamp,
-                       failed, detect_stats),
+                       failed, detect_stats, suspect=suspect),
         grid=(nc, n // r_blk),
         in_specs=[
             pl.BlockSpec(
@@ -1070,6 +1106,14 @@ def stripe_merge_update_blocked(
 # v5e Mosaic has no narrow-int vector max (arith.maxsi on i8 fails to
 # legalize); bf16 max is native and exact for the int8 view range.
 ARC_CHUNK = 1024
+
+# Widest per-receiver group-match bitmask the scenario edge_filter can
+# pack into one int32 lane (bit 31 stays clear — the sign bit): the
+# fanout/arc_align group count must not exceed this.  Shared by the
+# kernel validation below, the rr dispatch gate (core/rounds
+# _rr_scan_eligible) and the scenario capability check
+# (scenarios/tensor._require_arc_scenario) so the three can't drift.
+ARC_MATCH_MAX_GROUPS = 31
 
 
 def _windowmax_inplace(stripe, bufa, bufb, halo, fanout: int, nchunks: int,
@@ -1131,6 +1175,7 @@ def _windowmax_inplace(stripe, bufa, bufb, halo, fanout: int, nchunks: int,
 def _arc_update_kernel(
     n: int, fanout: int, r_blk: int, member: int, unknown: int,
     age_clamp: int, failed: int, detect_stats: bool,
+    suspect: int | None = None,
 ):
     nchunks = n // ARC_CHUNK
 
@@ -1187,6 +1232,7 @@ def _arc_update_kernel(
             recv, sa_ref[0][None], sb_ref[0][None],
             hb_out, age_out, status_out, cnt_out, ndet_out, fobs_out,
             i, r_blk, member, unknown, age_clamp, failed, detect_stats, n,
+            suspect=suspect,
         )
 
     return kernel
@@ -1196,7 +1242,7 @@ def _arc_update_kernel(
     jax.jit,
     static_argnames=(
         "fanout", "member", "unknown", "age_clamp", "failed", "detect_stats",
-        "block_r", "interpret",
+        "block_r", "interpret", "suspect",
     ),
 )
 def arc_merge_update_blocked(
@@ -1217,6 +1263,7 @@ def arc_merge_update_blocked(
     detect_stats: bool = False,
     block_r: int = _FUSED_BLOCK_R,
     interpret: bool = False,
+    suspect: int | None = None,
 ) -> tuple[jax.Array, ...]:
     """Arc merge + membership update + age advance + member count, fused.
 
@@ -1257,7 +1304,7 @@ def arc_merge_update_blocked(
     )
     out = pl.pallas_call(
         _arc_update_kernel(n, fanout, r_blk, member, unknown, age_clamp,
-                           failed, detect_stats),
+                           failed, detect_stats, suspect=suspect),
         grid=(nc, n // r_blk),
         in_specs=[
             pl.BlockSpec(
@@ -1411,7 +1458,7 @@ N_VEC = 11
 
 
 def _rr_tick_packed(hb, asl, act_r, ref_r, eye, thr_g, member, failed,
-                    t_fail, t_cooldown):
+                    t_fail, t_cooldown, suspect=None, confirm_thr=0):
     """The heartbeat tick over i32-widened hb + PACKED age|status.
 
     Mirrors core/rounds.py ``_tick`` (lean crash-only path: small-group
@@ -1429,33 +1476,72 @@ def _rr_tick_packed(hb, asl, act_r, ref_r, eye, thr_g, member, failed,
     false.  (_tick keeps the reference's explicit self-exclusion; dropping
     it here removes an iota-mask AND from the hot pass — measured
     ~0.3 ms/round at N=16k.)
+
+    ``suspect`` (round 11) arms the fused SWIM lifecycle: a stale MEMBER
+    enters SUSPECT (status bits 1 -> 3, the AGE LANE keeps running — it IS
+    the suspicion clock, ``age - t_fail`` = rounds in SUSPECT), and a
+    SUSPECT lane confirms to FAILED once ``age > confirm_thr``
+    (= t_fail + t_suspect; the rr path runs lh_multiplier == 0 — see
+    core/rounds._use_rr).  ``fail`` then carries the CONFIRMATIONS, the
+    lifecycle's actual failure declarations, exactly as the XLA ``_tick``.
+    The confirm compare carries no ``~eye`` term either: the diagonal is
+    never SUSPECT (self-suspicion needs ``stale``, which excludes self).
     """
     st_bits = asl & 3
     st_mem = st_bits == member
     nsent = hb != -128
-    refresh = ref_r & st_mem
+    if suspect is None:
+        refresh = ref_r & st_mem
+        refresh_val = st_bits - 128
+    else:
+        # small-group refreshers revert SUSPECT -> MEMBER with the fresh
+        # stamp (detection is disabled below min_group, so suspicion is
+        # moot there) — one write: every listed lane becomes (MEMBER, 0)
+        refresh = ref_r & (st_mem | (st_bits == suspect))
+        refresh_val = member - 128
     if eye is None:
         # caller knows the diagonal does not cross this block: the whole
         # bump chain drops out at trace time
-        asl = jnp.where(refresh, st_bits - 128, asl)
+        asl = jnp.where(refresh, refresh_val, asl)
     else:
         bump = eye & act_r & st_mem & nsent
         hb = hb + bump.astype(jnp.int32)
-        asl = jnp.where(refresh | bump, st_bits - 128, asl)
-    # refresh/bump preserve status, so st_mem still reads the current
-    # status here; `past` needs no sentinel re-test (the bump cannot move
-    # a lane off -128 — it is gated on nsent)
+        # the diagonal is never SUSPECT, so the bump write's st_bits is
+        # MEMBER — shared select with the refresh stamp either way
+        asl = jnp.where(refresh, refresh_val, asl)
+        asl = jnp.where(bump, st_bits - 128, asl)
+    # refresh/bump writes touch disjoint rows from the detection below
+    # (act_r vs ref_r), so st_mem still reads the relevant status here;
+    # `past` needs no sentinel re-test (the bump cannot move a lane off
+    # -128 — it is gated on nsent)
     past = (hb >= thr_g) & nsent
-    fail = (
+    stale = (
         act_r & st_mem & past
         & (asl > ((t_fail << 2) | member) - 128)
     )
-    asl = jnp.where(fail, failed - 128, asl)
+    if suspect is None:
+        fail = stale
+        asl = jnp.where(fail, failed - 128, asl)
+        elig = st_mem & ~fail
+    else:
+        st_sus = st_bits == suspect
+        confirm = (
+            act_r & st_sus
+            & (asl > ((confirm_thr << 2) | suspect) - 128)
+        )
+        # member -> suspect is one status bit (1 -> 3): age bits unchanged
+        # (the clock keeps running); both masks derive from the pre-write
+        # status, so an entry spends >= 1 round SUSPECT before confirming
+        asl = jnp.where(stale, asl | 2, asl)
+        asl = jnp.where(confirm, failed - 128, asl)
+        fail = confirm
+        elig = (st_mem | st_sus) & ~fail
     expire = ((asl & 3) == failed) & (asl > ((t_cooldown << 2) | failed) - 128)
     asl = jnp.where(expire, asl & -4, asl)
-    # post-tick membership, for free: fail is the only member-removing
-    # transition (expire acts on FAILED lanes)
-    return hb, asl, fail, st_mem & ~fail
+    # post-tick membership (gossip eligibility), for free: fail is the
+    # only member-removing transition (expire acts on FAILED lanes), and
+    # a newly-SUSPECT entry keeps gossiping (still a list entry)
+    return hb, asl, fail, elig
 
 
 def _wrap8(x):
@@ -1465,14 +1551,21 @@ def _wrap8(x):
     return ((x + 128) & 255) - 128
 
 
-def _rr_merge_packed(hb, asl, best, recv, vec, member, unknown, age_clamp):
+def _rr_merge_packed(hb, asl, best, recv, vec, member, unknown, age_clamp,
+                     suspect=None):
     """Merge epilogue (advance / add / rebase / age advance), i32 packed.
 
     Mirrors core/rounds.py ``_membership_update``'s narrow branch
     (rounds.py:584-638) term for term; every clipped threshold arrives
     precomputed in ``vec`` (widened i8 -> i32 values, so compares are the
     narrow path's sign-extended compares and adds/subs wrap on the final
-    int8 store).  Returns (hb', asl') as i32.
+    int8 store).  Returns (hb', asl', refute) as i32 — ``refute`` the
+    SUSPECT -> MEMBER refutation mask (None when ``suspect`` is).
+
+    Suspicion (round 11): a SUSPECT entry is still listed, so it takes
+    the advance compare — and an advance on a SUSPECT entry IS SWIM's
+    refutation (the update write below lands it back at (MEMBER, age 0),
+    the same bits every advance writes).
 
     ``lhs`` is wrapped explicitly: the reference computes it in int8, and
     in the ``shift_a < -128`` regime (reachable after a rejoin drops the
@@ -1482,8 +1575,11 @@ def _rr_merge_packed(hb, asl, best, recv, vec, member, unknown, age_clamp):
     """
     st = asl & 3
     any_m = best >= 0
+    listed = (st == member) if suspect is None else (
+        (st == member) | (st == suspect)
+    )
     advance = (
-        recv & (st == member) & any_m
+        recv & listed & any_m
         & (best > vec[V_CMP_DEEP]) & (_wrap8(best + vec[V_SA_N]) > hb)
     )
     add = recv & (st == unknown) & any_m
@@ -1495,9 +1591,14 @@ def _rr_merge_packed(hb, asl, best, recv, vec, member, unknown, age_clamp):
     )
     keep_val = jnp.where(hb <= vec[V_KEEP_THR], -128, keep_val)
     new_hb = jnp.where(upd, up_val, keep_val)
-    base = jnp.where(add, member - 128, jnp.where(advance, st - 128, asl))
+    # every update writes (MEMBER, age 0): adds learn the entry, advances
+    # refresh it — and refute it if it was SUSPECT.  (Suspicion off this
+    # is the same bits as the old add/advance split: advance lanes were
+    # already MEMBER.)
+    base = jnp.where(upd, member - 128, asl)
     new_asl = jnp.where(base >= (age_clamp << 2) - 128, base, base + 4)
-    return new_hb, new_asl
+    refute = (advance & (st == suspect)) if suspect is not None else None
+    return new_hb, new_asl, refute
 
 
 # ---------------------------------------------------------------------------
@@ -1519,7 +1620,8 @@ def _rr_merge_packed(hb, asl, best, recv, vec, member, unknown, age_clamp):
 
 
 def _rr_tick_view_swar(hb, asl, act_h, ref_h, vec, member, failed,
-                       t_fail, t_cooldown):
+                       t_fail, t_cooldown, suspect=None, confirm_thr=0,
+                       send_h=None):
     """SWAR mirror of :func:`_rr_tick_packed` (diagonal-free chunks) plus
     the gossip-view encode, over packed words.
 
@@ -1528,47 +1630,86 @@ def _rr_tick_view_swar(hb, asl, act_h, ref_h, vec, member, failed,
     per-byte eye mask and covers at most c_blk of N rows per stripe), so
     the whole bump chain drops out exactly as in the widened eye=None
     branch.  Returns (hb, asl', fail_h, enc) — ``enc`` the encoded view
-    words (absent lanes 0xFF = -1), ``fail_h`` an hmask.
+    words (absent lanes 0xFF = -1), ``fail_h`` an hmask (the
+    CONFIRMATIONS when ``suspect`` arms the fused SWIM lifecycle —
+    see :func:`_rr_tick_packed`).  ``send_h``: optional per-row
+    sends-this-round hmask (scenario slow-sender mute — a muted row's
+    view lanes encode absent, its tick is untouched).
     """
     st_bits = asl & swar.word(3)
     stm_h = swar.eq(st_bits, swar.word(member))
     nsent_h = swar.ne(hb, swar.H)
-    asl = swar.sel(swar.to_bytes(ref_h & stm_h), st_bits | swar.H, asl)
+    if suspect is None:
+        refresh_b = swar.to_bytes(ref_h & stm_h)
+        # st_bits | H == word(member - 128) on the refreshed (MEMBER)
+        # bytes — kept as the bit-op form (one OR, no select operand)
+        asl = swar.sel(refresh_b, st_bits | swar.H, asl)
+    else:
+        sus_pre_h = swar.eq(st_bits, swar.word(suspect))
+        refresh_b = swar.to_bytes(ref_h & (stm_h | sus_pre_h))
+        # listed refreshers land at (MEMBER, age 0) — the SUSPECT ->
+        # MEMBER small-group revert rides the same constant write
+        asl = swar.sel(refresh_b, swar.word(member - 128), asl)
     past_h = swar.ges(hb, vec[V_THR_G]) & nsent_h
-    fail_h = (
+    stale_h = (
         act_h & stm_h & past_h
         & swar.gts(asl, swar.word(((t_fail << 2) | member) - 128))
     )
-    asl = swar.sel(swar.to_bytes(fail_h), swar.word(failed - 128), asl)
+    if suspect is None:
+        fail_h = stale_h
+        asl = swar.sel(swar.to_bytes(fail_h), swar.word(failed - 128), asl)
+        elig_h = stm_h & ~fail_h
+    else:
+        confirm_h = (
+            act_h & sus_pre_h
+            & swar.gts(asl, swar.word(((confirm_thr << 2) | suspect) - 128))
+        )
+        # member -> suspect: set status bit 1, age bits untouched (the
+        # age lane IS the suspicion clock)
+        asl = asl | (swar.to_bytes(stale_h) & swar.word(2))
+        asl = swar.sel(swar.to_bytes(confirm_h), swar.word(failed - 128),
+                       asl)
+        fail_h = confirm_h
+        elig_h = (stm_h | sus_pre_h) & ~fail_h
     expire_h = (
         swar.eq(asl & swar.word(3), swar.word(failed))
         & swar.gts(asl, swar.word(((t_cooldown << 2) | failed) - 128))
     )
     asl = swar.sel(swar.to_bytes(expire_h), asl & swar.word(0xFC), asl)
-    stm_out = stm_h & ~fail_h
     goss_h = (
-        stm_out & act_h
+        elig_h & act_h
         & (swar.ges(hb, vec[V_SA_N]) | swar.ne(vec[V_SA_ALL], 0))
         & swar.les(hb, vec[V_HI_N])
         & nsent_h
     )
+    if send_h is not None:
+        goss_h = goss_h & send_h
     enc = swar.sel(swar.to_bytes(goss_h), swar.sub(hb, vec[V_SA_N]),
                    swar.word(0xFF))
     return hb, asl, fail_h, enc
 
 
-def _rr_merge_swar(hb, asl, best, recv_b, vec, member, unknown, age_clamp):
+def _rr_merge_swar(hb, asl, best, recv_b, vec, member, unknown, age_clamp,
+                   suspect=None):
     """SWAR mirror of :func:`_rr_merge_packed` over packed words.
 
     ``recv_b`` is a full-byte receiver mask (uniform across a word's 4
     subjects); ``vec`` holds the per-subject threshold stack as packed
     words.  Byte adds/subs wrap mod 2^8 — the widened path's store-wrap
-    (and its explicit ``_wrap8`` on ``lhs``) for free.
+    (and its explicit ``_wrap8`` on ``lhs``) for free.  Returns
+    (hb', asl', refute_b) — ``refute_b`` the full-byte SUSPECT -> MEMBER
+    refutation mask (None when ``suspect`` is); the listed test under
+    suspicion is one status-bit-0 word test (MEMBER=1 and SUSPECT=3 both
+    carry it; the wrapper asserts the encoding).
     """
     st = asl & swar.word(3)
     anym_h = ~best & swar.H  # best >= 0: sign bit clear
+    if suspect is None:
+        listed_h = swar.eq(st, swar.word(member))
+    else:
+        listed_h = swar.ne(st & swar.L, 0)  # status bit 0: MEMBER|SUSPECT
     adv_b = recv_b & swar.to_bytes(
-        swar.eq(st, swar.word(member)) & anym_h
+        listed_h & anym_h
         & swar.gts(best, vec[V_CMP_DEEP])
         & swar.gts(swar.add(best, vec[V_SA_N]), hb)
     )
@@ -1583,13 +1724,19 @@ def _rr_merge_swar(hb, asl, best, recv_b, vec, member, unknown, age_clamp):
     keep_val = swar.sel(swar.to_bytes(swar.les(hb, vec[V_KEEP_THR])),
                         swar.H, keep_val)
     new_hb = swar.sel(upd_b, up_val, keep_val)
-    base = swar.sel(add_b, swar.word(member - 128),
-                    swar.sel(adv_b, st | swar.H, asl))
+    # every update lands at (MEMBER, age 0) — adds learn, advances
+    # refresh/refute (suspicion off: advance lanes are MEMBER already, so
+    # the unified select is the same bits as the old add/advance split)
+    base = swar.sel(upd_b, swar.word(member - 128), asl)
     new_asl = swar.sel(
         swar.to_bytes(swar.ges(base, swar.word((age_clamp << 2) - 128))),
         base, swar.add(base, swar.word(4)),
     )
-    return new_hb, new_asl
+    refute_b = (
+        adv_b & swar.to_bytes(swar.eq(st, swar.word(suspect)))
+        if suspect is not None else None
+    )
+    return new_hb, new_asl, refute_b
 
 
 def _rr_kernel(
@@ -1600,7 +1747,8 @@ def _rr_kernel(
     view_dt=jnp.int8, stub: frozenset = frozenset(),
     arc_rows: int = ARC_CHUNK, vslots: int = VSLOTS, arc_align: int = 1,
     rcnt_acc: bool = False, swar_mode: bool = False, ring: bool = False,
-    flags_compact: bool = False, *, nstripes: int,
+    flags_compact: bool = False, suspect: int | None = None,
+    confirm_thr: int = 0, edge_filter: bool = False, *, nstripes: int,
 ):
     # swar_mode: run the elementwise stages over packed 4-subject words
     # (see the SWAR section above _rr_tick_view_swar).  The view-build
@@ -1609,9 +1757,25 @@ def _rr_kernel(
     # path — both formulations are bit-equal, so mixing is invisible.
     # nstripes is the GRID's stripe count — the local nc under column
     # sharding, where deriving it from the global n would be wrong (the
-    # last-stripe count flush would never fire); callers pass it
+    # last-stripe count flush would never fire); callers pass it.
+    # suspect (round 11): the fused SWIM lifecycle — suspect/confirm in
+    # the tick stages, refute-on-advance in the merge stages, plus three
+    # per-subject suspicion reductions (entered / refuted / held-SUSPECT)
+    # accumulated exactly like ndet.  edge_filter (round 11): the
+    # scenario-armed aligned-arc build — group maxes land in a FULL int8
+    # T buffer (no W pass, no ring) and the per-receiver gather is an
+    # nw-way masked max driven by the (base, group-match-bitmask) pairs
+    # in the edges input; a dropped group contributes the absent encoding
+    # (-1), the same value "no sender carried it" produces.
     nchunks = n // chunk
     nblocks = n // r_blk
+    sus = suspect is not None
+    # the "sus" stage stub (tools/stub_bisect.py) skips the suspicion
+    # OBSERVABLE reductions (entered/refuted/held masks + their three
+    # per-subject sums) while keeping the lifecycle transitions — its
+    # delta vs the full run isolates the reduction cost; the full
+    # suspicion-on-vs-off A/B (--suspicion) isolates transitions+all
+    sus_red = sus and "sus" not in stub
     # aligned-arc mode never reads the view stripe (the gather consumes
     # the window maxes), so it is not materialized; any stub keeps the
     # real stripe so the bisect tool's stubbed paths stay valid
@@ -1626,10 +1790,16 @@ def _rr_kernel(
         gpc_k = chunk // arc_align
 
     mx = max(chunk, r_blk)
+    # post-tick byte that identifies THIS round's MEMBER -> SUSPECT entry
+    # (the clock is the age lane, so entry happens at age == t_fail + 1
+    # exactly — ages advance by one per unrefreshed round and reset on
+    # every refresh, so the value is hit once per episode)
+    sus_new_byte = (((t_fail + 1) << 2) | (suspect or 0)) - 128
 
     def kernel(
         edges_ref, col0_ref, flags_all, vecs_ref, hb_any, as_any,
         hb_out, as_out, cnt_out, ndet_out, fobs_out, rcnt_out,
+        nsus_out, nref_out, sus_out,
         stripe, best_scratch, vbuf, vsems, dbuf, flbuf, *rest,
     ):
         # resident mode parks the TICKED lanes in VMEM during the
@@ -1647,10 +1817,13 @@ def _rr_kernel(
         else:
             rbuf, rsems, *arc_scratch = rest
         # aligned-arc window scratch, by build (rr_align_scratch_specs'
-        # layouts): ring-rotated — W first, then the fixed T ring + the
-        # wrap head; full-T fallback — whole-stripe T, then W
+        # layouts): edge-filter — one FULL int8 T (+ wrap halo rows);
+        # ring-rotated — W first, then the fixed T ring + the wrap head;
+        # full-T fallback — whole-stripe T, then W
         if arc and arc_align > 1:
-            if ring:
+            if edge_filter:
+                tbuf8 = arc_scratch[0]
+            elif ring:
                 wbuf_a = arc_scratch[0]
                 tring = arc_scratch[1] if hw_k else None
                 thead = arc_scratch[2] if hw_k else None
@@ -1777,6 +1950,7 @@ def _rr_kernel(
                     # chunks only — see _rr_tick_view_swar)
                     hbw = pltpu.bitcast(vbuf[slot, 0], jnp.int32)
                     aslw = pltpu.bitcast(vbuf[slot, 1], jnp.int32)
+                    send_h = None
                     if "noflags" in stub:
                         act_h = ref_h = jnp.int32(-1)
                     else:
@@ -1784,9 +1958,14 @@ def _rr_kernel(
                             load_flags(c * chunk, chunk), jnp.int32)
                         act_h = swar.ne(flw & swar.word(1), 0)
                         ref_h = swar.ne(flw & swar.word(2), 0)
+                        if edge_filter:
+                            # scenario mute (flag bit 3): the slow-sender
+                            # rows send nothing this round
+                            send_h = swar.eq(flw & swar.word(8), 0)
                     hbw, aslw, _fail, enc = _rr_tick_view_swar(
                         hbw, aslw, act_h, ref_h, vecw, member, failed,
-                        t_fail, t_cooldown,
+                        t_fail, t_cooldown, suspect=suspect,
+                        confirm_thr=confirm_thr, send_h=send_h,
                     )
                     if resident and "park" not in stub:
                         hb_res[pl.ds(c * chunk, chunk)] = pltpu.bitcast(
@@ -1813,7 +1992,13 @@ def _rr_kernel(
                                 nxt.append(vals[-1])
                             vals = nxt
                         gm8 = pltpu.bitcast(vals[0], jnp.int8)
-                        if ring and hw_k:
+                        if edge_filter:
+                            # scenario build: group maxes land in the
+                            # FULL int8 T — the masked gather reads them
+                            # directly (no W precompute: the per-receiver
+                            # window is filtered, so it cannot be shared)
+                            tbuf8[pl.ds(c * gpc_k, gpc_k)] = gm8
+                        elif ring and hw_k:
                             # ring build: this chunk's group maxes land at
                             # the FIXED ring position (rows [hw, hw+gpc));
                             # the W flush after the tick branches consumes
@@ -1829,17 +2014,21 @@ def _rr_kernel(
                                 tbuf_a.dtype)
 
                 def tick_view(eye):
+                    sends = None
                     if "noflags" in stub:
                         act_r = ref_r = jnp.bool_(True)
                     else:
                         flb = load_flags(c * chunk, chunk).astype(jnp.int32)
                         act_r = (flb & 1) != 0
                         ref_r = (flb & 2) != 0
+                        if edge_filter:
+                            sends = (flb & 8) == 0  # scenario mute bit
                     hb = vbuf[slot, 0].astype(jnp.int32)
                     asl = vbuf[slot, 1].astype(jnp.int32)
                     hb, asl, _fail, stm = _rr_tick_packed(
                         hb, asl, act_r, ref_r, eye, vec[V_THR_G],
                         member, failed, t_fail, t_cooldown,
+                        suspect=suspect, confirm_thr=confirm_thr,
                     )
                     if resident and "park" not in stub:
                         # park the TICKED lanes: the receiver sweep reads
@@ -1857,6 +2046,8 @@ def _rr_kernel(
                         & (hb <= vec[V_HI_N])
                         & (hb != -128)
                     )
+                    if sends is not None:
+                        goss = goss & sends
                     rel = hb - vec[V_SA_N]
                     if view_dt != jnp.int8:
                         # the int8 store wraps for free; a widened stripe
@@ -1887,7 +2078,12 @@ def _rr_kernel(
                         gm = jnp.max(
                             encw.reshape(gpc_k, arc_align, cs, LANE), axis=1
                         )
-                        if ring and hw_k:
+                        if edge_filter:
+                            # scenario build: full int8 T (see the SWAR
+                            # branch's comment)
+                            tbuf8[pl.ds(c * gpc_k, gpc_k)] = gm.astype(
+                                tbuf8.dtype)
+                        elif ring and hw_k:
                             # ring build (see the SWAR branch's comment)
                             tring[hw_k:hw_k + gpc_k] = gm.astype(
                                 tring.dtype)
@@ -1957,7 +2153,12 @@ def _rr_kernel(
                 return 0
 
             lax.fori_loop(0, nchunks, body, 0, unroll=False)
-            if arc and arc_align > 1 and ring and "wmax" not in stub:
+            if arc and arc_align > 1 and edge_filter and "wmax" not in stub:
+                # close the mod-N wrap for the masked gather: the last
+                # hw window positions read groups [nb, nb + hw)
+                for gg in range(hw_k):
+                    tbuf8[pl.ds(nb_k + gg, 1)] = tbuf8[pl.ds(gg, 1)]
+            elif arc and arc_align > 1 and ring and "wmax" not in stub:
                 if hw_k and "wring" not in stub:
                     # close the mod-N wrap: after the last chunk the ring
                     # carry rows [0, hw) hold T[nb-hw .. nb); appending
@@ -2024,7 +2225,34 @@ def _rr_kernel(
         # bf16 at the narrow tile-aligned widths); int8 widens (no narrow
         # vector max, and no ordered narrow compares either, on v5e)
         cd = jnp.int32 if view_dt == jnp.int8 else view_dt
-        if arc and arc_align > 1:
+        if arc and arc_align > 1 and edge_filter:
+            shift = arc_align.bit_length() - 1
+
+            def gather(t, _):
+                # masked nw-way max over the group maxes: bit k of the
+                # receiver's match mask keeps window group k (partition
+                # rules at group granularity — the wrapper's caller
+                # validated align-closed sides); a dropped group
+                # contributes the absent encoding, exactly what "no
+                # sender carried the entry" produces
+                for k in range(unroll):
+                    r = t * unroll + k
+                    gidx = edges_ref[r, 0] >> shift
+                    msk = edges_ref[r, 1]
+                    vals = []
+                    for w in range(nw_k):
+                        v = tbuf8[gidx + w].astype(jnp.int32)
+                        keep = (msk >> w) & 1 != 0
+                        vals.append(jnp.where(keep, v, -1))
+                    while len(vals) > 1:
+                        nxt = [jnp.maximum(vals[m], vals[m + 1])
+                               for m in range(0, len(vals) - 1, 2)]
+                        if len(vals) % 2:
+                            nxt.append(vals[-1])
+                        vals = nxt
+                    best_scratch[r] = vals[0].astype(best_scratch.dtype)
+                return 0
+        elif arc and arc_align > 1:
             shift = arc_align.bit_length() - 1
             wb = wbuf_a
 
@@ -2077,6 +2305,9 @@ def _rr_kernel(
                 cnt_out[...] = jnp.zeros_like(cnt_out)
                 ndet_out[...] = jnp.zeros_like(ndet_out)
                 fobs_out[...] = jnp.zeros_like(fobs_out)
+                nsus_out[...] = jnp.zeros_like(nsus_out)
+                nref_out[...] = jnp.zeros_like(nref_out)
+                sus_out[...] = jnp.zeros_like(sus_out)
 
             return
         if swar_mode and resident:
@@ -2091,15 +2322,31 @@ def _rr_kernel(
             flw = pltpu.bitcast(flb8, jnp.int32)
             recv_b = swar.to_bytes(swar.ne(flw & swar.word(4), 0))
             bestw = pltpu.bitcast(best_scratch[...], jnp.int32)
-            new_hbw, new_aslw = _rr_merge_swar(
+            new_hbw, new_aslw, refute_b = _rr_merge_swar(
                 hbw, aslw, bestw, recv_b, vecw, member, unknown, age_clamp,
+                suspect=suspect,
             )
             hb_out[0] = pltpu.bitcast(new_hbw, jnp.int8)
             as_out[0] = pltpu.bitcast(new_aslw, jnp.int8)
             recv = (flb8 & 4) != 0  # int8 bit-test (native per the probes)
-            st_mem = pltpu.bitcast(
-                swar.to_bytes(swar.eq(new_aslw & swar.word(3),
-                                      swar.word(member))), jnp.int8) != 0
+            if sus:
+                listed_new = pltpu.bitcast(
+                    swar.to_bytes(swar.ne(new_aslw & swar.L, 0)),
+                    jnp.int8) != 0
+                if sus_red:
+                    # 0/1-byte counter WORDS (hmask sign bit -> per-byte
+                    # one): the suspicion sums below reduce these int32
+                    # words directly — 1/4 the elements of the byte-space
+                    # bool forms, and no byte-space mask materializes
+                    sus_new = (swar.eq(aslw, swar.word(sus_new_byte))
+                               >> 7) & swar.L
+                    refute = refute_b & swar.L
+                    held_sus = (swar.eq(new_aslw & swar.word(3),
+                                        swar.word(suspect)) >> 7) & swar.L
+            else:
+                listed_new = pltpu.bitcast(
+                    swar.to_bytes(swar.eq(new_aslw & swar.word(3),
+                                          swar.word(member))), jnp.int8) != 0
             fail = pltpu.bitcast(swar.to_bytes(fail_h), jnp.int8) != 0
         else:
             flb = flb8.astype(jnp.int32)
@@ -2120,20 +2367,71 @@ def _rr_kernel(
                     raw_hb.astype(jnp.int32), raw_as.astype(jnp.int32),
                     act_r, ref_r, eye, vec[V_THR_G],
                     member, failed, t_fail, t_cooldown,
+                    suspect=suspect, confirm_thr=confirm_thr,
                 )
 
             best = best_scratch[...].astype(jnp.int32)
-            new_hb, new_asl = _rr_merge_packed(
+            new_hb, new_asl, refute = _rr_merge_packed(
                 hb, asl, best, recv, vec, member, unknown, age_clamp,
+                suspect=suspect,
             )
             hb_out[0] = new_hb.astype(hb_out.dtype)
             as_out[0] = new_asl.astype(as_out.dtype)
-            st_mem = (new_asl & 3) == member
+            st_new = new_asl & 3
+            if sus:
+                listed_new = (st_new == member) | (st_new == suspect)
+                if sus_red:
+                    # post-tick (SUSPECT, age == t_fail + 1) == entered
+                    # THIS round (see sus_new_byte above)
+                    sus_new = asl == sus_new_byte
+                    held_sus = st_new == suspect
+            else:
+                listed_new = st_new == member
 
-        # per-subject reductions, accumulated across consecutive i steps
-        cnt_part = jnp.sum((recv & st_mem).astype(jnp.int32),
+        # per-subject reductions, accumulated across consecutive i steps.
+        # The membership tallies count LISTED entries — under suspicion a
+        # SUSPECT entry is still in the list (pending refute/confirm), so
+        # both the convergence count (cnt) and the per-receiver group-size
+        # count (rc below) must keep it, exactly as the XLA _listed does.
+        cnt_part = jnp.sum((recv & listed_new).astype(jnp.int32),
                            axis=0)[None]
         ndet_part = jnp.sum(fail.astype(jnp.int32), axis=0)[None]
+        if sus_red:
+            # suspicion observables, same accumulation pattern as ndet:
+            # entered (post-tick newly-SUSPECT), refuted (merge advance on
+            # a SUSPECT lane), held (post-merge SUSPECT anywhere — feeds
+            # the first_suspect episode carry; NOT recv-gated: a dead
+            # observer's frozen SUSPECT lane holds the episode open,
+            # matching the XLA any(status == SUSPECT) reduction).
+            # SWAR branch: the masks are 0/1-byte WORDS — summing int32
+            # words over <= 128-row slices accumulates each byte lane
+            # carry-free (counts <= 128 < 256), and ONE bitcast unpacks
+            # the four byte-lane sums back to their subject positions
+            # (the same transform the lane outputs use), so the whole
+            # reduction touches 1/4 the elements and builds no byte-space
+            # mask.  Widened branch: plain bool sums with the widen fused
+            # into the reduce.  (The round-11 1.2x suspicion-overhead
+            # budget lives or dies on this epilogue.)
+            if swar_mode and resident:
+                def _wsum(w):
+                    part = None
+                    for s0 in range(0, r_blk, 128):
+                        sw = jnp.sum(w[s0:s0 + 128], axis=0)[None]
+                        p = pltpu.bitcast(sw, jnp.int8).astype(
+                            jnp.int32) & 255
+                        part = p if part is None else part + p
+                    return part
+
+                nsus_part = _wsum(sus_new)
+                nref_part = _wsum(refute)
+                sus_part = _wsum(held_sus)
+            else:
+                nsus_part = jnp.sum(sus_new, axis=0,
+                                    dtype=jnp.int32)[None]
+                nref_part = jnp.sum(refute, axis=0,
+                                    dtype=jnp.int32)[None]
+                sus_part = jnp.sum(held_sus, axis=0,
+                                   dtype=jnp.int32)[None]
         # min (row - col) over rows, column added back on the reduced
         # shape (one small iota) — avoids a full-block row iota
         dmin = jnp.min(jnp.where(fail, dbuf[pl.ds(0, r_blk)], n), axis=0)
@@ -2162,7 +2460,7 @@ def _rr_kernel(
         if "rcnt" in stub:
             rcnt_out[...] = jnp.zeros_like(rcnt_out)
         else:
-            rc = jnp.sum(st_mem.astype(jnp.int32), axis=2)
+            rc = jnp.sum(listed_new.astype(jnp.int32), axis=2)
             rc = jnp.sum(rc, axis=1, keepdims=True)
             if not rcnt_acc:
                 # int16 output: a per-stripe partial is <= cs*LANE <= 4096
@@ -2191,12 +2489,24 @@ def _rr_kernel(
             cnt_out[...] = cnt_part
             ndet_out[...] = ndet_part
             fobs_out[...] = fobs_part
+            if sus_red:
+                nsus_out[...] = nsus_part
+                nref_out[...] = nref_part
+                sus_out[...] = sus_part
+            else:
+                nsus_out[...] = jnp.zeros_like(nsus_out)
+                nref_out[...] = jnp.zeros_like(nref_out)
+                sus_out[...] = jnp.zeros_like(sus_out)
 
         @pl.when(i > 0)
         def _():
             cnt_out[...] = cnt_out[...] + cnt_part
             ndet_out[...] = ndet_out[...] + ndet_part
             fobs_out[...] = jnp.minimum(fobs_out[...], fobs_part)
+            if sus_red:
+                nsus_out[...] = nsus_out[...] + nsus_part
+                nref_out[...] = nref_out[...] + nref_part
+                sus_out[...] = sus_out[...] + sus_part
 
     return kernel
 
@@ -2207,7 +2517,7 @@ def _rr_kernel(
         "fanout", "member", "unknown", "failed", "age_clamp", "window",
         "t_fail", "t_cooldown", "block_r", "chunk", "interpret",
         "resident", "gather_unroll", "arc_align", "rcnt_acc", "elementwise",
-        "rotate", "_stub",
+        "rotate", "suspect", "t_suspect", "edge_filter", "_stub",
     ),
 )
 def resident_round_blocked(
@@ -2237,6 +2547,9 @@ def resident_round_blocked(
     rcnt_acc: bool | None = None,
     elementwise: str = "lanes",
     rotate: bool = True,
+    suspect: int | None = None,
+    t_suspect: int = 0,
+    edge_filter: bool = False,
     _stub: str = "",
 ) -> tuple[jax.Array, ...]:
     """One whole gossip round (lean crash-only fault model) in one kernel.
@@ -2300,6 +2613,38 @@ def resident_round_blocked(
         fanout = edges.shape[1]
     elif edges.ndim == 1:
         edges = edges.reshape(n, 1)
+    if suspect is not None:
+        # the fused lifecycle's bit tricks assume the core/state.py
+        # encoding (status bit 0 == listed; member -> suspect is one bit)
+        if (member, suspect, unknown, failed) != (1, 3, 0, 2):
+            raise ValueError(
+                "fused suspicion needs the (UNKNOWN, MEMBER, FAILED, "
+                "SUSPECT) == (0, 1, 2, 3) status encoding"
+            )
+        if not 1 <= t_suspect or t_fail + t_suspect >= age_clamp:
+            raise ValueError(
+                f"t_suspect must be >= 1 with t_fail + t_suspect < "
+                f"age_clamp ({age_clamp}); the age lane is the suspicion "
+                f"clock (got t_fail={t_fail}, t_suspect={t_suspect})"
+            )
+    if edge_filter:
+        if not arc or arc_align <= 1:
+            raise ValueError(
+                "edge_filter (the scenario-armed masked gather) requires "
+                "the aligned-arc topology; explicit-edge scenarios rewrite "
+                "the sampled [N, F] edges instead (scenarios/tensor.py)"
+            )
+        if fanout // arc_align > ARC_MATCH_MAX_GROUPS:
+            raise ValueError(
+                "edge_filter packs the per-receiver group-match mask into "
+                f"an int32: fanout/arc_align must be <= "
+                f"{ARC_MATCH_MAX_GROUPS} (got {fanout // arc_align})"
+            )
+        if edges.shape != (n, 2):
+            raise ValueError(
+                f"edge_filter expects [N, 2] (base, match-mask) edges, "
+                f"got {edges.shape}"
+            )
     if hb.dtype != jnp.int8:
         raise ValueError("resident round kernel requires int8 lanes")
     if elementwise not in ("lanes", "swar"):
@@ -2370,7 +2715,7 @@ def resident_round_blocked(
     # ring-rotated aligned-arc view build: on whenever rotate and the
     # chunk covers the window halo (every production shape); the full-T
     # build is the fallback — and the rotate=False A/B baseline
-    ring = (rotate and arc and arc_align > 1
+    ring = (rotate and arc and arc_align > 1 and not edge_filter
             and rr_ring_supported(fanout, arc_align, ch))
     # flags layout: LANE-compacted whenever every in-kernel slice covers
     # whole compact rows (the same gate the budget math charges by); the
@@ -2467,7 +2812,7 @@ def resident_round_blocked(
     subj_spec = pl.BlockSpec(
         (1, cs, LANE), lambda j, i: (j, 0, 0), memory_space=pltpu.VMEM
     )
-    ew = 1 if arc else fanout
+    ew = (2 if edge_filter else 1) if arc else fanout
     # window-max chunk rows scale down at wide stripes so the bf16
     # ping-pong buffers stay ~2 MB (17 MB at c_blk=4096 otherwise — they
     # crowded out the round-5 iota/flag scratches)
@@ -2485,7 +2830,8 @@ def resident_round_blocked(
         # chunk cannot cover the halo.  The chunked view build must emit
         # whole groups per chunk.
         arc_scratch = rr_align_scratch_specs(
-            n, fanout, cs * LANE, arc_align, chunk=ch, rotate=ring)
+            n, fanout, cs * LANE, arc_align, chunk=ch, rotate=ring,
+            edge_filter=edge_filter)
     elif arc:
         arc_scratch = [
             pltpu.VMEM((ext, cs, LANE), jnp.bfloat16),
@@ -2513,7 +2859,9 @@ def resident_round_blocked(
                    stub=frozenset(s for s in _stub.split(",") if s),
                    arc_rows=arc_rows, vslots=vslots, arc_align=arc_align,
                    rcnt_acc=use_acc, swar_mode=elementwise == "swar",
-                   ring=ring, flags_compact=flags_compact, nstripes=nc),
+                   ring=ring, flags_compact=flags_compact, suspect=suspect,
+                   confirm_thr=t_fail + t_suspect, edge_filter=edge_filter,
+                   nstripes=nc),
         grid=(nc, n // r_blk),
         # in-place lane update: safe because every [row-block, stripe]
         # region's reads (the i==0 view-build chunk pass and the one-step-
@@ -2551,6 +2899,9 @@ def resident_round_blocked(
                 (r_blk, LANE), lambda j, i: (i, j),
                 memory_space=pltpu.VMEM,
             ),
+            # suspicion reductions (round 11): suspects entered, refuted,
+            # and held-SUSPECT per subject — zeros when suspicion is off
+            subj_spec, subj_spec, subj_spec,
         ],
         out_shape=[
             jax.ShapeDtypeStruct((nc, n, cs, LANE), jnp.int8),
@@ -2560,6 +2911,9 @@ def resident_round_blocked(
             jax.ShapeDtypeStruct((nc, cs, LANE), jnp.int32),
             jax.ShapeDtypeStruct(
                 (n // LANE, LANE) if use_acc else (n, nc * LANE), cnt_dt),
+            jax.ShapeDtypeStruct((nc, cs, LANE), jnp.int32),
+            jax.ShapeDtypeStruct((nc, cs, LANE), jnp.int32),
+            jax.ShapeDtypeStruct((nc, cs, LANE), jnp.int32),
         ],
         scratch_shapes=[
             # aligned-arc mode never reads the stripe (write-only): a
@@ -2628,3 +2982,40 @@ def arc_window_max_xla(view: jax.Array, bases: jax.Array, fanout: int) -> jax.Ar
         # idempotent): W[r] = max(D_p[r], D_p[r + F - p])
         w = jnp.maximum(ext[:n], ext[fanout - p:fanout - p + n])
     return w[bases]
+
+
+def arc_group_window_max_xla(
+    view: jax.Array, edges2: jax.Array, fanout: int, align: int
+) -> jax.Array:
+    """Scenario-filtered aligned-arc merge, XLA formulation (round 11).
+
+    The per-edge drop form the scenario engine needs does not exist for
+    arcs (the senders are F consecutive rows merged through a window
+    max), but ALIGNED arcs decompose into ``F/align`` whole groups — so a
+    partition whose sides are align-group-closed drops senders at GROUP
+    granularity, which is exactly per-edge granularity (every edge of a
+    group shares the drop verdict).  ``edges2`` int32 [N, 2] carries
+    (arc base, match bitmask): bit k keeps window group k
+    (scenarios.tensor.arc_match_edges builds it).  A dropped group
+    contributes the absent encoding (-1) — the same value "no sender
+    carried the entry" produces, so the merge epilogue is unchanged.
+
+    This is the oracle the rr kernel's ``edge_filter`` mode is pinned
+    against; per-edge equivalence (group-closed sides) is pinned by the
+    explicit-edge cross-check in tests/test_scenarios.py.
+    """
+    n = view.shape[0]
+    nb, nw = n // align, fanout // align
+    rest = view.shape[1:]
+    gm = jnp.max(view.reshape((nb, align) + rest), axis=1)
+    ext = jnp.concatenate([gm, gm[:max(nw - 1, 1)]], axis=0)  # wrap halo
+    bases, mask = edges2[:, 0], edges2[:, 1]
+    g = bases // align
+    absent = jnp.asarray(-1, view.dtype)
+    best = None
+    for k in range(nw):
+        v = ext[g + k]
+        keep = (((mask >> k) & 1) != 0).reshape((n,) + (1,) * len(rest))
+        v = jnp.where(keep, v, absent)
+        best = v if best is None else jnp.maximum(best, v)
+    return best

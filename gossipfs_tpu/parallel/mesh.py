@@ -111,7 +111,7 @@ def shard_state(state: SimState, mesh: Mesh) -> SimState:
 
 @functools.lru_cache(maxsize=32)
 def _sharded_runner(mesh, config, crash_rate, rejoin_rate, has_churn_ok,
-                    donate=False, matrix_events=True):
+                    donate=False, matrix_events=True, has_scenario=False):
     from jax import lax
     from jax.sharding import PartitionSpec as P
 
@@ -125,13 +125,14 @@ def _sharded_runner(mesh, config, crash_rate, rejoin_rate, has_churn_ok,
     rep = P()
 
     def local_run(hb, age, status, alive, rnd, hb_base, ev_crash, ev_leave,
-                  ev_join, key, churn_ok):
+                  ev_join, key, churn_ok, scenario):
         ctx = rounds.ShardCtx(axis=AXIS, offset=lax.axis_index(AXIS) * nloc)
         st = SS(hb=hb, age=age, status=status, alive=alive, round=rnd,
                 hb_base=hb_base)
+        scn = scenario if has_scenario else None
         blocked = rounds._use_blocked(config, config.fanout, n, nloc)
         if not blocked and rounds._rr_scan_eligible(
-            config, n, nloc, matrix_events, ctx
+            config, n, nloc, matrix_events, ctx, scenario=scn
         ):
             # the rr scan accepts narrower per-shard stripe widths than
             # the stripe kernels _use_blocked models; it consumes the
@@ -144,16 +145,23 @@ def _sharded_runner(mesh, config, crash_rate, rejoin_rate, has_churn_ok,
         st, mc, pr = rounds._scan_rounds(
             st, config, key, ev, crash_rate, rejoin_rate,
             churn_ok if has_churn_ok else None, ctx,
-            matrix_events=matrix_events,
+            matrix_events=matrix_events, scenario=scn,
         )
         if blocked:
             st = rounds._from_blocked(st)
         return st.hb, st.age, st.status, st.alive, st.round, st.hb_base, mc, pr
 
+    # the scenario rule table is a small pytree of replicated rule arrays
+    # (every shard filters identically); a 0-leaf placeholder rides the
+    # same slot when no scenario is armed
+    from gossipfs_tpu.scenarios.tensor import TensorScenario
+
+    scn_spec = TensorScenario(*([rep] * len(TensorScenario._fields)))
     fn = _shard_map(
         local_run,
         mesh=mesh,
-        in_specs=(mat, mat, mat, rep, rep, P(AXIS), rep, rep, rep, rep, rep),
+        in_specs=(mat, mat, mat, rep, rep, P(AXIS), rep, rep, rep, rep, rep,
+                  scn_spec),
         out_specs=(mat, mat, mat, rep, rep, P(AXIS),
                    rounds.MetricsCarry(P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
                    rounds.RoundMetrics(rep, rep, rep, rep, rep, rep)),
@@ -164,6 +172,18 @@ def _sharded_runner(mesh, config, crash_rate, rejoin_rate, has_churn_ok,
         # double-buffered state (the caller's state is consumed)
         return jax.jit(fn, donate_argnums=(0, 1, 2))
     return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=8)
+def _placeholder_scenario(n: int):
+    """Zero-rule TensorScenario riding the scenario slot on
+    scenario-free calls (the runner is lru-cached per has_scenario, so
+    its leaves are never read) — cached so repeated sharded launches
+    don't pay the ~12 host builds + transfers per call."""
+    from gossipfs_tpu.scenarios.schedule import FaultScenario
+    from gossipfs_tpu.scenarios.tensor import compile_tensor
+
+    return compile_tensor(FaultScenario(name="none", n=n))
 
 
 def run_rounds_sharded(
@@ -178,6 +198,7 @@ def run_rounds_sharded(
     churn_ok: jax.Array | None = None,
     donate: bool = False,
     crash_only_events: bool = False,
+    scenario=None,
 ):
     """``core.rounds.run_rounds`` over an explicit subject-axis shard_map.
 
@@ -222,14 +243,25 @@ def run_rounds_sharded(
         churn_ok_arr = jnp.ones((n,), dtype=bool)  # placeholder, unused
     else:
         churn_ok_arr = churn_ok
+    from gossipfs_tpu.scenarios.tensor import TensorScenario
+
+    if scenario is not None:
+        from gossipfs_tpu.scenarios.tensor import require_scenario_config
+
+        require_scenario_config(config, scenario)
+        scn_arg = scenario
+    else:
+        scn_arg = _placeholder_scenario(n)
+    assert isinstance(scn_arg, TensorScenario)
 
     fn = _sharded_runner(mesh, config, crash_rate, rejoin_rate,
                          churn_ok is not None, donate=donate,
-                         matrix_events=matrix_events)
+                         matrix_events=matrix_events,
+                         has_scenario=scenario is not None)
     hb, age, status, alive, rnd, hb_base, mc, pr = fn(
         state.hb, state.age, state.status, state.alive, state.round,
         state.hb_base, events.crash, events.leave, events.join, key,
-        churn_ok_arr,
+        churn_ok_arr, scn_arg,
     )
     return (
         SimState(hb=hb, age=age, status=status, alive=alive, round=rnd,

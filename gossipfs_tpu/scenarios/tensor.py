@@ -11,20 +11,29 @@ aligned arcs already rely on, core/topology.random_arc_bases_aligned).
 Nothing else about the round changes: nodes keep ticking, bumping and
 detecting; only which rows reach which receivers does.
 
-Engine coverage / gating (see also config.py's merge_kernel notes):
+Engine coverage / capability matrix (round 11 — the fast-path
+unification retired the forced-XLA fork; see also config.py's
+merge_kernel notes):
 
-  * the XLA merge paths (2-D state) take filtered edges natively —
-    scenario runs therefore FORCE ``merge_kernel="xla"`` via
-    :func:`xla_fallback_config` (the rr/pallas fast paths run the round
-    in-kernel over unfiltered gathers and stay reserved for
-    fault-free transport);
+  * every merge path consumes filtered edges: the XLA/stripe paths take
+    the rewritten [N, F] edges natively, and the resident-round scan
+    applies the SAME rewrite to the edges it samples per round
+    (core/rounds.py ``_scan_rounds_rr_packed``) before the in-kernel
+    gather — a self-edge gathers the receiver's own view row, which the
+    strict advance compare rejects, so the fast kernels needed no new
+    merge semantics;
+  * ``random_arc`` with ``arc_align > 1``: partitions and slow senders
+    compose at GROUP granularity (an aligned arc is F/align whole
+    groups, so align-group-closed partition sides give exactly per-edge
+    semantics — :func:`arc_match_edges` builds the per-receiver group
+    match masks, :func:`sends_mask` the slow-sender mute).  Bernoulli
+    loss draws are irreducibly per-edge and stay a ``random``-topology
+    (or ring) capability — :func:`require_scenario_config` enforces the
+    matrix per scenario;
   * ``remove_broadcast`` must be off: the broadcast is modeled as an
     instantaneous tensor column-OR, not as transport messages, so a
     partition could not filter it — gossip-only dissemination is the
-    transport-faithful mode (it also needs ``fresh_cooldown``, as ever);
-  * ``random_arc`` has no per-edge form (arc bases gather through a
-    windowed row-max) — use ``random``, whose detection behavior the
-    arc mode matches by construction (bench/curves.py parity rows).
+    transport-faithful mode (it also needs ``fresh_cooldown``, as ever).
 
 Scenario round numbers are relative to ARMING: :class:`TensorScenario`
 carries ``round0`` (the absolute sim round at arming) and the filter
@@ -33,7 +42,6 @@ subtracts it, so a scenario loaded mid-run keeps its schedule.
 
 from __future__ import annotations
 
-import dataclasses
 from typing import NamedTuple
 
 import jax
@@ -146,15 +154,77 @@ def filter_edges(
     return jnp.where(drop, recv, edges)
 
 
-def require_scenario_config(config: SimConfig) -> None:
-    """Reject protocol modes the transport-level fault model cannot honor.
+def sends_mask(tsc: TensorScenario, n: int, rnd: jax.Array) -> jax.Array:
+    """bool [N]: which nodes get their datagrams out this round.
+
+    The SENDER-side rules (slow nodes off their stride) as a node mask —
+    for merge forms with no per-edge rewrite (aligned arcs): a muted
+    node's gossip-view row encodes absent everywhere, which drops every
+    out-edge at once while its own tick (bump/detect) runs untouched —
+    exactly the per-edge rewrite's effect for sender-global rules.
+    """
+    rel = rnd - tsc.round0
+    send = jnp.ones((n,), bool)
+    for s in range(tsc.slow_start.shape[0]):
+        active = (
+            (rel >= tsc.slow_start[s]) & (rel < tsc.slow_end[s])
+            & (rel % tsc.slow_stride[s] != 0)
+        )
+        send &= ~(active & tsc.slow_nodes[s])
+    return send
+
+
+def arc_match_edges(
+    tsc: TensorScenario, bases: jax.Array, rnd: jax.Array,
+    fanout: int, align: int,
+) -> jax.Array:
+    """Aligned-arc partition filter as (base, group-match bitmask) pairs.
+
+    int32 [N, 2]: row i carries its arc base and a bitmask whose bit k
+    keeps window group k (the ``align`` senders at rows
+    ``(base + k*align) .. + align``) — kept iff NO active partition rule
+    separates the group from receiver i.  Valid when every partition
+    side is align-group-closed (``require_scenario_config`` checks), so
+    one representative node decides for the whole group and group
+    granularity IS per-edge granularity.  Consumed by the rr kernel's
+    ``edge_filter`` masked gather and by
+    ``ops.merge_pallas.arc_group_window_max_xla`` (the XLA oracle).
+    """
+    n = bases.shape[0]
+    nb, nw = n // align, fanout // align
+    rel = rnd - tsc.round0
+    g = bases // align
+    recv = jnp.arange(n, dtype=jnp.int32)
+    mask = jnp.zeros((n,), jnp.int32)
+    for k in range(nw):
+        rep = ((g + k) % nb) * align  # group representative node
+        ok = jnp.ones((n,), bool)
+        for p in range(tsc.part_start.shape[0]):
+            active = (rel >= tsc.part_start[p]) & (rel < tsc.part_end[p])
+            pid = tsc.part_pid[p]
+            ok &= ~active | (pid[rep] == pid[recv])
+        mask |= jnp.where(ok, jnp.int32(1 << k), 0)
+    return jnp.stack([bases.astype(jnp.int32), mask], axis=1)
+
+
+def require_scenario_config(config: SimConfig, scenario=None) -> None:
+    """Reject protocol/scenario combinations no transport form can honor.
 
     * ``remove_broadcast`` is an instantaneous column-OR over the whole
       matrix, not a set of messages — a partition could not filter it
       (the UDP/deploy engines DO filter their real REMOVE datagrams);
       gossip-only dissemination is the transport-faithful mode.
-    * ``random_arc`` gathers through a windowed row-max over arc bases
-      and has no per-edge rewrite; use ``random``.
+    * ``random_arc``: aligned arcs (arc_align > 1) take partitions with
+      align-group-closed sides plus slow-sender rules at group
+      granularity (== per-edge granularity for group-closed sides — see
+      :func:`arc_match_edges`); Bernoulli loss draws are irreducibly
+      per-edge and need ``random`` (or ring).  Unaligned arcs
+      (arc_align == 1) have no group form at all — use ``random``.
+
+    ``scenario``: the concrete :class:`TensorScenario` (or the
+    declarative ``FaultScenario``) when available — arc-capability
+    checks need the rule tables; with ``None`` only the config-level
+    requirements are checked.
     """
     if config.remove_broadcast:
         raise ValueError(
@@ -173,24 +243,64 @@ def require_scenario_config(config: SimConfig) -> None:
             "protocol pathology to the injected fault"
         )
     if config.topology == "random_arc":
-        raise ValueError(
-            "scenario runs support topology 'ring' or 'random': random_arc "
-            "merges through a windowed row-max over arc bases, which has no "
-            "per-edge drop form"
+        if config.arc_align <= 1:
+            raise ValueError(
+                "scenario runs on random_arc need arc_align > 1 (whole "
+                "sender groups are the drop unit); unaligned arcs have no "
+                "per-edge form — use topology='random'"
+            )
+        if scenario is not None:
+            _require_arc_scenario(scenario, config)
+
+
+def _require_arc_scenario(scenario, config: SimConfig) -> None:
+    """Concrete aligned-arc capability checks (rule tables in hand)."""
+    align = config.arc_align
+    if isinstance(scenario, TensorScenario):
+        n_loss = int(scenario.loss_start.shape[0])
+        pids = np.asarray(scenario.part_pid)
+    else:  # declarative FaultScenario
+        n_loss = len(scenario.link_faults)
+        pids = (
+            np.stack([p.pid(config.n) for p in scenario.partitions])
+            if scenario.partitions else np.zeros((0, config.n), np.int32)
         )
+    if n_loss:
+        raise ValueError(
+            "Bernoulli loss rules draw per (sender, receiver) edge and "
+            "have no group form: run loss scenarios on topology='random' "
+            "(or ring); aligned arcs take partitions + slow senders"
+        )
+    from gossipfs_tpu.ops.merge_pallas import ARC_MATCH_MAX_GROUPS
+
+    if config.fanout // align > ARC_MATCH_MAX_GROUPS:
+        raise ValueError(
+            "aligned-arc scenarios pack the group-match mask into an "
+            f"int32: fanout/arc_align must be <= {ARC_MATCH_MAX_GROUPS} "
+            f"(got {config.fanout // align})"
+        )
+    if pids.size:
+        grouped = pids.reshape(pids.shape[0], -1, align)
+        if (grouped != grouped[:, :, :1]).any():
+            raise ValueError(
+                "aligned-arc scenarios need align-group-closed partition "
+                f"sides: every group of {align} consecutive nodes must "
+                "sit on one side (then group-granular filtering IS "
+                "per-edge filtering); regroup the partition or use "
+                "topology='random'"
+            )
 
 
 def xla_fallback_config(config: SimConfig) -> SimConfig:
-    """The config a scenario run actually executes: same protocol, XLA merge.
+    """Deprecated alias: the XLA-oracle form of ``config``.
 
-    The pallas/rr kernels fuse the gather, epilogue and per-round
-    reductions in-kernel over unfiltered edge semantics; under active
-    link faults the run falls back to the XLA merge path (documented in
-    config.py's ``merge_kernel`` notes), which consumes the filtered
-    edges natively.  Everything protocol-level (dtypes, thresholds,
-    dissemination mode, elementwise formulation) is preserved.
+    Round 11 retired the forced substitution — every merge path consumes
+    filtered edges now, so scenario runs keep their configured kernel.
+    This name survives for callers that explicitly want the oracle path
+    (parity tests, A/B bisection); the substitution semantics have ONE
+    owner, ``config.fallback_config``.
     """
+    from gossipfs_tpu.config import fallback_config
+
     require_scenario_config(config)
-    if config.merge_kernel == "xla":
-        return config
-    return dataclasses.replace(config, merge_kernel="xla")
+    return fallback_config(config)

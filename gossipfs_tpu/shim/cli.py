@@ -103,7 +103,9 @@ def make_parser() -> argparse.ArgumentParser:
         help="arm the SWIM suspicion lifecycle (suspicion/): silent "
              "members pass through a refutable SUSPECT state for this "
              "many rounds before FAILED.  0 = off; needs --gossip-only "
-             "(the REMOVE broadcast would bypass the suspect window)",
+             "(the REMOVE broadcast would bypass the suspect window; "
+             "--packed is gossip-only already and runs the lifecycle "
+             "in-kernel since round 11)",
     )
     p.add_argument(
         "--arc-align", type=int, default=1,
@@ -300,16 +302,16 @@ def main(argv=None) -> None:
             cfg = SimConfig(n=args.n, topology=args.topology,
                             fanout=args.fanout, **extra)
         if args.t_suspect > 0:
-            if args.packed:
-                parser.error("--t-suspect is unsupported in --packed mode "
-                             "(the rr kernel is the suspicion-free fast "
-                             "path; suspicion/tensor.py)")
-            from gossipfs_tpu.suspicion import (
-                SuspicionParams,
-                with_suspicion,
-            )
+            # Round 11: the SWIM lifecycle runs natively on every merge
+            # path (--packed's rr kernel included), so arming it is a
+            # plain field set — __post_init__ owns the protocol-mode
+            # check (gossip-only; suspicion/tensor.py).
+            import dataclasses
 
-            cfg = with_suspicion(cfg, SuspicionParams(t_suspect=args.t_suspect))
+            from gossipfs_tpu.suspicion import SuspicionParams
+
+            cfg = dataclasses.replace(
+                cfg, suspicion=SuspicionParams(t_suspect=args.t_suspect))
     except ValueError as e:
         parser.error(str(e))
     detector = None

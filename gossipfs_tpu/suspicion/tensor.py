@@ -1,37 +1,34 @@
-"""Tensor-sim gating for the suspicion subsystem.
+"""Tensor-sim requirements for the suspicion subsystem.
 
 Suspicion rides the config, not a side table: ``SimConfig.suspicion``
 holds a :class:`~gossipfs_tpu.suspicion.params.SuspicionParams` and the
-round kernel (core/rounds.py) branches on it at trace time.  What this
-module owns is the ENGINE GATING — the same rules the scenario engine
-established (scenarios/tensor.py), because the fast kernels fuse the
-protocol over semantics suspicion changes:
+round kernels branch on it at trace time.  Round 11 (fast-path
+unification) FUSED the lifecycle into every merge path — the XLA
+tick/epilogues (lanes AND the SWAR packed-word forms), the stripe/arc
+pallas kernels' in-kernel epilogue, and the resident-round kernel's
+packed tick/merge stages (ops/merge_pallas.py) — so the old
+``merge_kernel="xla"`` / ``elementwise="lanes"`` construction gates are
+GONE: a capacity-ladder rr/SWAR config with suspicion constructs and
+runs, bit-equal to the XLA oracle (pinned by the oracle grid, the golden
+fuzz suite, and ``verify_claims.py fastpath_parity``).
 
-  * the rr/pallas merge kernels run the MEMBER-only tick/epilogue
-    in-kernel — they know nothing of the SUSPECT lane value, the
-    widened view eligibility, or the refute-on-advance status write.
-    Suspicion runs therefore execute the XLA merge path
-    (``merge_kernel="xla"``); rr/pallas stays the suspicion-free fast
-    path (documented in config.py's ``merge_kernel`` notes);
-  * the SWAR packed-word elementwise formulation (ops/swar.py) encodes
-    the 3-state status machine in its word constants — suspicion runs
-    use ``elementwise="lanes"``;
-  * ``remove_broadcast`` must be off: an instantaneous cluster-wide
-    REMOVE would bypass the per-observer SUSPECT window entirely
-    (gossip-only dissemination is the mode the lifecycle is defined
-    for, and it needs ``fresh_cooldown`` as ever).
+What this module still owns is the PROTOCOL-MODE requirement
+(:func:`require_suspicion_config`): gossip-only dissemination.  One
+capability note survives as graceful degradation rather than a gate: the
+Lifeguard local-health stretch (``lh_multiplier > 0``) derives a
+per-receiver confirmation threshold from per-receiver SUSPECT counts,
+which the resident-round kernel does not carry — such configs
+automatically take the stripe/XLA merge for the round
+(core/rounds.py ``_use_rr``), same bits, slower path.
 
-``SimConfig.__post_init__`` enforces all of this at construction, so a
-fast-kernel config with suspicion is unconstructible; :func:`with_suspicion`
-is the convenience that maps any gossip-only config onto its suspicion-run
-form — the ``xla_fallback_config`` analog for this subsystem.
+:func:`with_suspicion` survives as a deprecated alias of
+``config.fallback_config`` — the one owner of oracle-path substitution —
+for callers that explicitly want the XLA+lanes oracle form.
 """
 
 from __future__ import annotations
 
-import dataclasses
-
-from gossipfs_tpu.config import SimConfig
+from gossipfs_tpu.config import SimConfig, fallback_config
 from gossipfs_tpu.suspicion.params import SuspicionParams
 
 
@@ -56,14 +53,13 @@ def require_suspicion_config(config: SimConfig) -> None:
 
 
 def with_suspicion(config: SimConfig, params: SuspicionParams) -> SimConfig:
-    """The config a suspicion run actually executes: same protocol
-    thresholds/dtypes/topology, suspicion armed, XLA merge + lanes
-    elementwise substituted (the scenario engine's fallback pattern —
-    fault-free transport stays on the fast kernels)."""
-    require_suspicion_config(config)
-    rep: dict = {"suspicion": params}
-    if config.merge_kernel != "xla":
-        rep["merge_kernel"] = "xla"
-    if config.elementwise != "lanes":
-        rep["elementwise"] = "lanes"
-    return dataclasses.replace(config, **rep)
+    """Deprecated alias: arm suspicion on the XLA-ORACLE form of config.
+
+    Round 11 fused the lifecycle into the fast kernels, so arming
+    suspicion no longer requires any substitution —
+    ``dataclasses.replace(cfg, suspicion=params)`` keeps the configured
+    kernel.  This name survives for callers that explicitly want the
+    oracle path (parity baselines, the curves A/B's reference rows); the
+    substitution semantics have ONE owner, ``config.fallback_config``.
+    """
+    return fallback_config(config, suspicion=params)

@@ -195,6 +195,80 @@ def test_fuzz_rr_rotated_scan_matches_oracle():
         compare(state, naive, where=f"rr-rotated round {r0 + seg}")
 
 
+@pytest.mark.parametrize("with_scenario", [False, True],
+                         ids=["suspicion", "partition+suspicion"])
+def test_fuzz_rr_suspicion_partition_matches_oracle(with_scenario):
+    """Round-11 golden fuzz: the fused fast path — SWIM suspicion (fused
+    SUSPECT/confirm in the packed tick, refute-on-advance in the merge)
+    on the ring-rotated + LANE-compacted + SWAR resident-round kernel —
+    driven by a seeded crash storm against the per-node oracle, with and
+    without a timed half/half partition + slow-sender scenario armed.
+
+    The scenario variant runs the kernel's ``edge_filter`` masked-gather
+    build (group-match masks over align-closed partition sides, sender
+    mute riding the flags); the scenario-free variant keeps the
+    ring-rotated build, so BOTH round-11 kernel forms meet the oracle.
+    Oracle edges mirror the rr scan's per-round sampling, expanded to
+    explicit [N, F] form and put through the SAME rule table via
+    ``scenarios.tensor.filter_edges`` (per-edge == group-granular for
+    align-group-closed sides — the equivalence scenarios/tensor.py
+    argues; no Bernoulli rules, so the filter key is inert)."""
+    from gossipfs_tpu.scenarios import FaultScenario, Partition, SlowNode
+    from gossipfs_tpu.scenarios.tensor import compile_tensor, filter_edges
+
+    cfg = SimConfig(n=512, topology="random_arc", fanout=16, arc_align=8,
+                    remove_broadcast=False, fresh_cooldown=True,
+                    t_fail=3, t_cooldown=12, view_dtype="int8",
+                    hb_dtype="int8", merge_kernel="pallas_rr_interpret",
+                    merge_block_c=512, merge_block_r=128, rr_resident="on",
+                    elementwise="swar",
+                    suspicion=SuspicionParams(t_suspect=2))
+    n, rounds, seg = cfg.n, 40, 5
+    tsc = None
+    if with_scenario:
+        sc = FaultScenario(
+            name="fuzz-split", n=n,
+            # halves are align-group-closed (512 % 8 == 0); the split
+            # spans enough rounds for cross-side entries to walk the full
+            # MEMBER -> SUSPECT -> FAILED -> cooldown -> re-add lifecycle
+            partitions=(Partition(start=6, end=24,
+                                  groups=(tuple(range(n // 2)),)),),
+            slow_nodes=(SlowNode(start=2, end=32, stride=3,
+                                 nodes=tuple(range(32))),),
+        )
+        tsc = compile_tensor(sc)
+    rng = pyrandom.Random(909)
+    schedule: dict[int, list[int]] = {}
+    for r in range(2, rounds):
+        if rng.random() < 0.12:
+            schedule[r] = rng.sample(range(1, n), k=rng.randint(1, 3))
+    state = init_state(cfg)
+    naive = NaiveSim(cfg)
+    key = jax.random.PRNGKey(11)
+    for r0 in range(0, rounds, seg):
+        crash = np.zeros((seg, n), dtype=bool)
+        for r in range(r0, r0 + seg):
+            for idx in schedule.get(r, []):
+                crash[r - r0, idx] = True
+        z = jnp.zeros((seg, n), dtype=bool)
+        ev = RoundEvents(crash=jnp.asarray(crash), leave=z, join=z)
+        state, _, _ = gossip_run_rounds(state, cfg, seg, key, events=ev,
+                                        crash_only_events=True,
+                                        scenario=tsc)
+        for r in range(r0, r0 + seg):
+            k = jax.random.fold_in(key, r)
+            k_edge, _ = jax.random.split(k)
+            bases = topology.in_edges(cfg, k_edge, None)
+            edges = topology.arc_edges(bases, cfg.fanout)
+            if tsc is not None:
+                edges = filter_edges(tsc, edges.astype(jnp.int32),
+                                     jnp.int32(r), k)
+            naive.step(np.array(edges), crash=schedule.get(r, []))
+        compare(state, naive,
+                where=f"rr-sus{'-scn' if with_scenario else ''} "
+                      f"round {r0 + seg}")
+
+
 @pytest.mark.parametrize("name,kwargs,introkill", CONFIGS,
                          ids=[c[0] for c in CONFIGS])
 @pytest.mark.parametrize("seed", [1, 2])

@@ -293,6 +293,48 @@ def test_full_round_equivalence_xla_vs_rr(block_c, rr_resident, topology,
     assert jnp.array_equal(px.false_positives, pp.false_positives)
 
 
+@pytest.mark.parametrize("topology,arc_align,fanout,elementwise", [
+    # the round-11 fused SWIM lifecycle on the explicit-edge rr form
+    ("random", 1, 6, "lanes"),
+    # ... and on the production profile: aligned arcs + SWAR (the
+    # capacity-ladder kernel config, ring-rotated build active)
+    ("random_arc", 8, 16, "swar"),
+])
+def test_full_round_equivalence_xla_vs_rr_suspicion(topology, arc_align,
+                                                    fanout, elementwise):
+    """Round 11: suspicion armed on the resident-round kernel — SUSPECT
+    entry/confirm fused into the packed tick, refute-on-advance fused
+    into the merge epilogue, and the three suspicion reductions riding
+    the kernel's per-subject outputs — must reproduce the XLA scan
+    bit-for-bit: states, the full carry (first_suspect included) and the
+    per-round metrics (suspects_entered / refutations / fp_suppressed)."""
+    from gossipfs_tpu.suspicion import SuspicionParams
+
+    base = SimConfig(
+        n=2048, topology=topology, fanout=fanout, arc_align=arc_align,
+        remove_broadcast=False, fresh_cooldown=True, t_cooldown=12,
+        view_dtype="int8", hb_dtype="int8", merge_block_c=1024,
+        rr_resident="on" if arc_align > 1 else "off",
+        elementwise=elementwise, t_fail=3,
+        suspicion=SuspicionParams(t_suspect=2),
+    )
+    key = jax.random.PRNGKey(17)
+    out = {}
+    for kernel in ("xla", "pallas_rr_interpret"):
+        cfg = dataclasses.replace(base, merge_kernel=kernel)
+        out[kernel] = run_rounds(
+            init_state(cfg), cfg, 8, key, crash_rate=0.02
+        )
+    import numpy as np
+
+    for a, b in zip(jax.tree.leaves(out["xla"]),
+                    jax.tree.leaves(out["pallas_rr_interpret"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    per = out["xla"][2]
+    assert int(jnp.sum(per.suspects_entered)) > 0  # lifecycle exercised
+    assert int(jnp.sum(per.refutations)) > 0
+
+
 @pytest.mark.slow  # interpreter-mode kernel rounds
 @pytest.mark.parametrize("topology,rr_resident,arc_align,elementwise", [
     ("random", "off", 1, "lanes"),  # widened (int32) view stripe, c_blk=1024
@@ -350,6 +392,50 @@ def test_rr_deep_shift_regime_parity(topology, rr_resident, arc_align,
     assert jnp.array_equal(fx.status, fp.status)
     assert jnp.array_equal(px.true_detections, pp.true_detections)
     assert jnp.array_equal(px.false_positives, pp.false_positives)
+
+
+@pytest.mark.slow  # interpreter-mode kernel rounds
+@pytest.mark.parametrize("elementwise", ["lanes", "swar"])
+def test_rr_deep_shift_suspicion_parity(elementwise):
+    """Round 11: the fused SUSPECT transitions in the shift_a < -128
+    wrap regime.  The suspicion clock rides the age lane while the hb
+    lane wraps mod 256 — the SUSPECT entry/confirm compares and the
+    refute-on-advance must keep judging the WRAPPED int8 semantics the
+    XLA narrow path computes (the deep-shift synthetic state from
+    test_rr_deep_shift_regime_parity, with the lifecycle armed)."""
+    from gossipfs_tpu.suspicion import SuspicionParams
+
+    cfg = SimConfig(
+        n=4096, topology="random_arc", fanout=16, arc_align=8,
+        remove_broadcast=False, fresh_cooldown=True, t_cooldown=12,
+        view_dtype="int8", hb_dtype="int8", merge_block_c=4096,
+        rr_resident="on", elementwise=elementwise, t_fail=3,
+        suspicion=SuspicionParams(t_suspect=2),
+    )
+    st = init_state(cfg)
+    n = cfg.n
+    hb = jnp.full((n, n), -125, jnp.int8).at[
+        jnp.arange(n), jnp.arange(n)].set(-120)
+    # same synthetic regime as the suspicion-free deep-shift case:
+    # basec=400 with stored diag -120 drives shift_a ~ -245, every rel
+    # wraps mod 256.  The -125 off-diagonal rows sit age-stale too, so
+    # the first ticks push waves of entries through SUSPECT while the
+    # wrapped advances refute them — both transitions exercised exactly
+    # where the wrap semantics bind
+    st = st._replace(hb=hb, hb_base=jnp.full((n,), 400, jnp.int32),
+                     age=jnp.full((n, n), 3, jnp.int8))
+    key = jax.random.PRNGKey(5)
+    out = {}
+    for kernel in ("xla", "pallas_rr_interpret"):
+        c = dataclasses.replace(cfg, merge_kernel=kernel)
+        out[kernel] = run_rounds(st, c, 4, key, crash_rate=0.01)
+    import numpy as np
+
+    for a, b in zip(jax.tree.leaves(out["xla"]),
+                    jax.tree.leaves(out["pallas_rr_interpret"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    per = out["xla"][2]
+    assert int(jnp.sum(per.suspects_entered)) > 0
 
 
 def test_rr_rcnt_accumulated_form_matches_per_stripe():

@@ -172,24 +172,32 @@ class TestTensorEngine:
         )
         assert (np.asarray(final2.status) == 1).all()
 
-    def test_scenario_forces_xla_fallback(self):
-        from gossipfs_tpu.core.rounds import _run_rounds_impl, run_rounds
+    def test_scenario_keeps_fast_kernel_bit_equal_to_oracle(self):
+        """Round 11 (fast-path unification): scenario runs keep the
+        CONFIGURED merge kernel — the rr scan rewrites its sampled edges
+        before the in-kernel gather — and the result is bit-equal to the
+        explicitly-requested XLA oracle path (config.fallback_config).
+        The old forced-substitution ValueError is gone."""
+        from gossipfs_tpu.config import fallback_config
+        from gossipfs_tpu.core.rounds import run_rounds
 
         cfg = SimConfig.packed_rr(2048, 1024, interpret=True)
         sc = split_halves(2048, start=1, end=6)
         tsc = compile_tensor(sc)
-        # the wrapper substitutes the XLA fallback config and runs
-        final, _, _ = run_rounds(
-            init_state(cfg), cfg, 3, jax.random.PRNGKey(0), scenario=tsc
-        )
-        assert int(final.round) == 3
-        # the impl refuses a pallas config + scenario outright (the rr
-        # scan samples its own edges and would ignore the filter)
-        with pytest.raises(ValueError, match="merge_kernel='xla'"):
-            _run_rounds_impl(
-                init_state(cfg), cfg, 3, jax.random.PRNGKey(0),
-                scenario=tsc,
+        key = jax.random.PRNGKey(0)
+        out = {}
+        for c in (cfg, fallback_config(cfg)):
+            final, carry, per = run_rounds(
+                init_state(c), c, 8, key, scenario=tsc, crash_rate=0.02,
+                crash_only_events=True,
             )
+            out[c.merge_kernel] = (final, carry, per)
+        fr, cr, pr = out["pallas_rr_interpret"]
+        fx, cx, px = out["xla"]
+        assert int(fr.round) == 8
+        for a, b in zip(jax.tree.leaves((fr, cr, pr)),
+                        jax.tree.leaves((fx, cx, px))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
     def test_lossy_links_slow_detection_but_not_correctness(self):
         from gossipfs_tpu.bench.run import tracked_crash_events
@@ -246,6 +254,127 @@ class TestTensorEngine:
         # class the crash-stop model could never produce
         fd_slow, fps = run_with(stride=12, t_fail=5)
         assert fd_slow >= 0 and fps > 0
+
+
+# ---------------------------------------------------------------------------
+# round 11 — fast-path unification: suspicion + scenarios on the rr/SWAR
+# kernel, bit-equal to the XLA oracle
+# ---------------------------------------------------------------------------
+
+
+class TestFastPathUnification:
+    """The round-11 acceptance surface: a partition + suspicion scenario
+    runs on the CONFIGURED fast kernel (resident-round + SWAR) and is
+    bit-equal to the explicitly-requested XLA oracle — states, carries
+    (incl. first_suspect) and per-round metrics (incl. the suspicion
+    counters)."""
+
+    def test_load_scenario_runs_arc_capability_checks(self):
+        """The interactive lane must reject at LOAD time what run_rounds
+        rejects at call time: Bernoulli loss has no align-group form, so
+        arming it on an aligned-arc detector is an error, not a silent
+        no-op (the arc scenario branch only applies group-closed
+        partitions + sends_mask)."""
+        from gossipfs_tpu.detector.sim import SimDetector
+        from gossipfs_tpu.scenarios import LinkFault
+
+        cfg = SimConfig(n=256, topology="random_arc", fanout=8,
+                        arc_align=8, remove_broadcast=False,
+                        fresh_cooldown=True)
+        det = SimDetector(cfg, seed=0)
+        sc = FaultScenario(
+            name="loss", n=256,
+            link_faults=(LinkFault(start=0, end=10, rate=0.5,
+                                   src=tuple(range(8)),
+                                   dst=tuple(range(256))),))
+        with pytest.raises(ValueError, match="no group form"):
+            det.load_scenario(sc)
+        assert det.scenario_status() is None  # nothing half-armed
+
+    @pytest.mark.parametrize("topology,arc_align,fanout,elementwise", [
+        # explicit-edge form: the rr scan rewrites its sampled [N, F]
+        # edges before the in-kernel gather
+        ("random", 1, 11, "swar"),
+        # aligned-arc form: the kernel's edge_filter masked gather over
+        # (base, group-match bitmask) pairs, SWAR and lanes stages
+        ("random_arc", 8, 16, "swar"),
+        ("random_arc", 8, 16, "lanes"),
+    ])
+    def test_partition_suspicion_fast_path_bit_equal_oracle(
+            self, topology, arc_align, fanout, elementwise):
+        import dataclasses
+
+        from gossipfs_tpu.core.rounds import run_rounds
+        from gossipfs_tpu.scenarios import Partition, SlowNode
+        from gossipfs_tpu.suspicion import SuspicionParams
+
+        n = 2048
+        base = SimConfig(
+            n=n, topology=topology, fanout=fanout, arc_align=arc_align,
+            remove_broadcast=False, fresh_cooldown=True, t_cooldown=12,
+            view_dtype="int8", hb_dtype="int8", merge_block_c=1024,
+            elementwise=elementwise, t_fail=3,
+            suspicion=SuspicionParams(t_suspect=2),
+        )
+        # a timed half/half split (sides are align-group-closed: n/2 is a
+        # multiple of arc_align) riding alongside lagging senders — the
+        # partition manufactures the staleness storm the SUSPECT window
+        # must absorb, the slow rule drives the sender-mute path
+        sc = FaultScenario(
+            name="split+slow", n=n,
+            partitions=(Partition(start=2, end=9,
+                                  groups=(tuple(range(n // 2)),)),),
+            slow_nodes=(SlowNode(start=0, end=12, stride=3,
+                                 nodes=tuple(range(64))),),
+        )
+        tsc = compile_tensor(sc)
+        key = jax.random.PRNGKey(3)
+        out = {}
+        for kernel in ("xla", "pallas_rr_interpret"):
+            cfg = dataclasses.replace(base, merge_kernel=kernel)
+            out[kernel] = run_rounds(
+                init_state(cfg), cfg, 12, key, crash_rate=0.02,
+                scenario=tsc, crash_only_events=True,
+            )
+        for a, b in zip(jax.tree.leaves(out["xla"]),
+                        jax.tree.leaves(out["pallas_rr_interpret"])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # the run exercised the lifecycle, not a degenerate quiet horizon
+        per = out["xla"][2]
+        assert int(np.asarray(per.suspects_entered).sum()) > 0
+        assert int(np.asarray(per.refutations).sum()) > 0
+
+    def test_capacity_ladder_shape_constructs_and_is_eligible(self):
+        """The acceptance shape: N=262,144 (ANCHORS_r09 ladder) with
+        suspicion armed AND a partition scenario loaded constructs on
+        merge_kernel='pallas_rr' / elementwise='swar' — no gating
+        ValueError — is row-budget admissible per rr_shard_admissible,
+        and passes the rr scan's eligibility gate (interpret stands in
+        for the TPU backend check; no run here — the on-chip anchor is
+        gated behind bench.py probe_rr_suspicion)."""
+        import dataclasses
+
+        from gossipfs_tpu.core.rounds import LOCAL_CTX, _rr_scan_eligible
+        from gossipfs_tpu.parallel.mesh import rr_shard_admissible
+        from gossipfs_tpu.suspicion import SuspicionParams
+
+        n = 262_144
+        cfg = SimConfig(
+            n=n, topology="random_arc", fanout=24, arc_align=8,
+            remove_broadcast=False, fresh_cooldown=True, t_cooldown=12,
+            merge_kernel="pallas_rr", merge_block_c=2048, merge_block_r=512,
+            view_dtype="int8", hb_dtype="int8", elementwise="swar",
+            t_fail=3, suspicion=SuspicionParams(t_suspect=2),
+        )
+        assert cfg.merge_kernel == "pallas_rr"
+        sc = split_halves(n, start=5, end=30)
+        require_scenario_config(cfg, sc)
+        for shards in (1, 8):
+            assert rr_shard_admissible(n, shards, cfg.merge_block_c,
+                                       cfg.fanout)["admissible"]
+        icfg = dataclasses.replace(cfg, merge_kernel="pallas_rr_interpret")
+        assert _rr_scan_eligible(icfg, n, n // 8, False, LOCAL_CTX,
+                                 scenario=compile_tensor(sc))
 
 
 # ---------------------------------------------------------------------------

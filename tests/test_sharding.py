@@ -102,6 +102,8 @@ class TestShardMapRunner:
         """The whole point: the row gather must be shard-local, with only
         [N]-vector reductions crossing shards."""
         from gossipfs_tpu.parallel import mesh as pm
+        from gossipfs_tpu.scenarios.schedule import FaultScenario
+        from gossipfs_tpu.scenarios.tensor import compile_tensor
 
         cfg = SimConfig(n=1024, topology="random", fanout=8,
                         merge_kernel="pallas_interpret")
@@ -111,10 +113,12 @@ class TestShardMapRunner:
         from gossipfs_tpu.core.state import RoundEvents
 
         ev = RoundEvents(crash=z, leave=z, join=z)
+        scn = compile_tensor(FaultScenario(name="none", n=cfg.n))
         fn = pm._sharded_runner(m, cfg, 0.0, 0.0, False)
         hlo = fn.lower(
             st.hb, st.age, st.status, st.alive, st.round, st.hb_base,
             ev.crash, ev.leave, ev.join, KEY, jnp.ones((cfg.n,), bool),
+            scn,
         ).compile().as_text()
         assert "all-gather" not in hlo
 
@@ -180,6 +184,8 @@ class TestShardMapRunner:
         assertion the projection paragraph cites, now on the rr form)."""
         from gossipfs_tpu.core.state import RoundEvents
         from gossipfs_tpu.parallel import mesh as pm
+        from gossipfs_tpu.scenarios.schedule import FaultScenario
+        from gossipfs_tpu.scenarios.tensor import compile_tensor
 
         cfg = SimConfig(
             n=2048, topology="random_arc", fanout=6, remove_broadcast=False,
@@ -191,11 +197,13 @@ class TestShardMapRunner:
         st = shard_state(init_state(cfg), m)
         z = jnp.zeros((3, cfg.n), dtype=bool)
         ev = RoundEvents(crash=z, leave=z, join=z)
+        scn = compile_tensor(FaultScenario(name="none", n=cfg.n))
         fn = pm._sharded_runner(m, cfg, 0.02, 0.0, False,
                                 matrix_events=False)
         hlo = fn.lower(
             st.hb, st.age, st.status, st.alive, st.round, st.hb_base,
             ev.crash, ev.leave, ev.join, KEY, jnp.ones((cfg.n,), bool),
+            scn,
         ).compile().as_text()
         assert "all-gather" not in hlo
 

@@ -73,17 +73,23 @@ class TestParams:
             require_suspicion_config(SimConfig(n=16))
         with pytest.raises(ValueError, match="gossip-only"):
             SimConfig(n=16, suspicion=SuspicionParams())
-        # fast kernels are the suspicion-free path: unconstructible
-        with pytest.raises(ValueError, match="merge_kernel"):
-            SimConfig(n=2048, topology="random", fanout=11,
-                      remove_broadcast=False, fresh_cooldown=True,
-                      merge_kernel="pallas", view_dtype="int8",
-                      hb_dtype="int16", suspicion=SuspicionParams())
-        with pytest.raises(ValueError, match="elementwise"):
-            SimConfig(n=1024, topology="random", fanout=10,
-                      remove_broadcast=False, fresh_cooldown=True,
-                      hb_dtype="int8", view_dtype="int8",
-                      elementwise="swar", suspicion=SuspicionParams())
+        # round 11: the old merge_kernel="xla" / elementwise="lanes"
+        # construction gates are GONE — the lifecycle is fused into every
+        # merge path, so fast-kernel + suspicion configs construct
+        fast = SimConfig(n=2048, topology="random", fanout=11,
+                         remove_broadcast=False, fresh_cooldown=True,
+                         merge_kernel="pallas", view_dtype="int8",
+                         hb_dtype="int16", suspicion=SuspicionParams())
+        assert fast.merge_kernel == "pallas"
+        swar = SimConfig(n=1024, topology="random", fanout=10,
+                         remove_broadcast=False, fresh_cooldown=True,
+                         hb_dtype="int8", view_dtype="int8",
+                         elementwise="swar", suspicion=SuspicionParams())
+        assert swar.elementwise == "swar"
+        # the production fast-path profile: rr/SWAR at a capacity shape
+        rr = SimConfig.suspicion_rr(65_536)
+        assert rr.merge_kernel == "pallas_rr"
+        assert rr.suspicion is not None
         # the age lane carries the suspicion clock: it must not saturate
         with pytest.raises(ValueError, match="AGE_CLAMP"):
             SimConfig(n=64, topology="random", fanout=6,
@@ -460,6 +466,24 @@ class TestCliVerbs:
         args = cli.make_parser().parse_args(
             ["--n", "8", "--gossip-only", "--t-suspect", "4"])
         assert args.t_suspect == 4
+
+    def test_packed_t_suspect_composes(self):
+        """Round 11 lifted the CLI's --packed/--t-suspect guard: the rr
+        kernel runs the lifecycle natively, so arming suspicion on the
+        packed profile is a plain field set that keeps the fast kernel
+        (no oracle substitution) and passes __post_init__'s
+        protocol-mode check (packed_rr is gossip-only already)."""
+        import dataclasses
+
+        from gossipfs_tpu.shim import cli
+
+        args = cli.make_parser().parse_args(
+            ["--n", "2048", "--packed", "--t-suspect", "2"])
+        cfg = dataclasses.replace(
+            SimConfig.packed_rr(args.n),
+            suspicion=SuspicionParams(t_suspect=args.t_suspect))
+        assert cfg.merge_kernel == "pallas_rr"
+        assert cfg.suspicion is not None and cfg.suspicion.t_suspect == 2
 
 
 # ---------------------------------------------------------------------------

@@ -7,10 +7,19 @@ every state lane and metric — hardware-level evidence the interpret
 tests cannot give.
 
     JAX_PLATFORMS=axon python tools/parity_soak.py --rounds 300
+    JAX_PLATFORMS=axon python tools/parity_soak.py --suspicion --scenario
+    JAX_PLATFORMS=cpu  python tools/parity_soak.py --interpret --n 2048 \
+        --block-c 1024 --rounds 16 --elementwise swar --suspicion --scenario
 
 Round-5 artifact (2026-07-31): 300 rounds, N=16,384, aligned-arc
 headline config, 0.5% churn -> all lanes + metrics bit-equal, 118.6M
 detection events exercised.
+
+Round 11: ``--suspicion`` / ``--scenario`` soak the fused fast path
+(SWIM lifecycle in the packed tick/merge, partition + slow-sender
+filtering via the edge_filter masked gather) against the XLA oracle;
+``--interpret`` is the CPU form — what ``verify_claims.py
+fastpath_parity`` re-runs.
 """
 
 from __future__ import annotations
@@ -43,6 +52,20 @@ def main(argv=None):
                         "packed 4-subject words, ops/swar.py) — run once "
                         "per value to certify the compiled SWAR kernel "
                         "on-chip before bench.py's probe trusts it")
+    p.add_argument("--suspicion", action="store_true",
+                   help="arm the SWIM lifecycle at the fast knob "
+                        "(t_fail=3, t_suspect=2) on BOTH paths — the "
+                        "round-11 fused suspect/confirm/refute stages vs "
+                        "the XLA lifecycle, bit-equality incl. the "
+                        "suspicion counters")
+    p.add_argument("--scenario", action="store_true",
+                   help="arm a timed half/half partition + slow-sender "
+                        "scenario on BOTH paths — the round-11 "
+                        "edge_filter masked gather vs the XLA group form")
+    p.add_argument("--interpret", action="store_true",
+                   help="interpreter-mode rr kernel: the CPU form of this "
+                        "soak (verify_claims.py fastpath_parity); without "
+                        "it the compiled Mosaic kernel runs on-chip")
     args = p.parse_args(argv)
 
     import jax
@@ -51,25 +74,47 @@ def main(argv=None):
     from gossipfs_tpu.core.rounds import run_rounds
     from gossipfs_tpu.core.state import init_state
 
+    kw = {}
+    if args.suspicion:
+        from gossipfs_tpu.suspicion.params import SuspicionParams
+
+        kw.update(t_fail=3, suspicion=SuspicionParams(t_suspect=2))
     base = SimConfig(
         n=args.n, topology="random_arc", fanout=args.fanout,
         arc_align=args.arc_align,
         remove_broadcast=False, fresh_cooldown=True, t_cooldown=12,
-        merge_kernel="pallas_rr", merge_block_r=args.block_r,
+        merge_kernel="pallas_rr_interpret" if args.interpret
+        else "pallas_rr",
+        merge_block_r=args.block_r,
         view_dtype="int8", merge_block_c=args.block_c, rr_resident="auto",
-        hb_dtype="int8", elementwise=args.elementwise,
+        hb_dtype="int8", elementwise=args.elementwise, **kw,
     )
+    run_kw = {}
+    if args.scenario:
+        from gossipfs_tpu.scenarios import FaultScenario, Partition, SlowNode
+        from gossipfs_tpu.scenarios.tensor import compile_tensor
+
+        n = args.n
+        run_kw["scenario"] = compile_tensor(FaultScenario(
+            name="soak-split", n=n,
+            partitions=(Partition(start=3, end=max(args.rounds // 2, 8),
+                                  groups=(tuple(range(n // 2)),)),),
+            slow_nodes=(SlowNode(start=0, end=args.rounds, stride=3,
+                                 nodes=tuple(range(min(n // 16, 256)))),),
+        ))
+        run_kw["crash_only_events"] = True
     key = jax.random.PRNGKey(args.seed)
     out = {}
-    for kernel in ("pallas_rr", "xla"):
+    rr_kernel = base.merge_kernel
+    for kernel in (rr_kernel, "xla"):
         cfg = dataclasses.replace(base, merge_kernel=kernel)
         st, mc, pr = run_rounds(
             init_state(cfg), cfg, args.rounds, key,
-            crash_rate=args.crash_rate,
+            crash_rate=args.crash_rate, **run_kw,
         )
         out[kernel] = (jax.device_get(st), jax.device_get(mc),
                        jax.device_get(pr))
-    (sr, mr, prr) = out["pallas_rr"]
+    (sr, mr, prr) = out[rr_kernel]
     (sx, mx, prx) = out["xla"]
     checks = {
         "hb": np.array_equal(sr.hb, sx.hb),
@@ -84,13 +129,28 @@ def main(argv=None):
         "false_positives": np.array_equal(
             prr.false_positives, prx.false_positives),
     }
+    if args.suspicion:
+        checks.update({
+            "first_suspect": np.array_equal(
+                mr.first_suspect, mx.first_suspect),
+            "suspects_entered": np.array_equal(
+                prr.suspects_entered, prx.suspects_entered),
+            "refutations": np.array_equal(
+                prr.refutations, prx.refutations),
+            "fp_suppressed": np.array_equal(
+                prr.fp_suppressed, prx.fp_suppressed),
+        })
     doc = {
         "n": args.n, "rounds": args.rounds, "arc_align": args.arc_align,
-        "elementwise": args.elementwise,
+        "elementwise": args.elementwise, "kernel": rr_kernel,
+        "suspicion": bool(args.suspicion), "scenario": bool(args.scenario),
         **checks,
         "all_equal": all(checks.values()),
         "total_detections": int(prr.true_detections.sum()),
     }
+    if args.suspicion:
+        doc["total_suspects"] = int(prr.suspects_entered.sum())
+        doc["total_refutations"] = int(prr.refutations.sum())
     print(json.dumps(doc))
     return 0 if doc["all_equal"] else 1
 

@@ -14,17 +14,33 @@ out, isolating each stage's cost in the CURRENT build:
     gather - the per-receiver row gather skipped
     epi    - merge epilogue + every reduction replaced by a passthrough
     rcnt   - the per-receiver member-count side output zeroed
+    sus    - suspicion runs only (--suspicion): the suspicion OBSERVABLE
+             reductions (entered/refuted/held masks + the packed-field
+             sum) skipped while the fused lifecycle transitions keep
+             running — the (full)-minus-sus delta is the reduction cost,
+             and the --suspicion-vs-not (full) delta is the whole fused
+             lifecycle
 
     JAX_PLATFORMS=axon python tools/stub_bisect.py
     JAX_PLATFORMS=axon python tools/stub_bisect.py --arc-align 8
     JAX_PLATFORMS=axon python tools/stub_bisect.py --elementwise swar
+    JAX_PLATFORMS=axon python tools/stub_bisect.py --arc-align 8 \
+        --elementwise swar --suspicion            # round-11 fused path
+    JAX_PLATFORMS=axon python tools/stub_bisect.py --arc-align 8 \
+        --elementwise swar --suspicion --scenario # + edge_filter build
     JAX_PLATFORMS=cpu  python tools/stub_bisect.py --interpret --n 1024 \
         --block-c 512 --block-r 128 --rounds 2 --reps 1
 
 ``--elementwise swar`` times the packed-word SWAR stages
 (config.elementwise, ops/swar.py) against the widened default — the
 "(full)" row's delta between the two runs is the recovered elementwise
-time.  ``--interpret`` runs the interpreter-mode kernel so the tool works
+time.  ``--suspicion``/``--scenario`` (round 11) A/B the fused fast
+path: suspicion arms the in-kernel SWIM lifecycle (t_fail=3,
+t_suspect=2 — the SUSPECT_r08 fast knob), scenario switches the
+aligned-arc build to the edge_filter masked gather over (base,
+group-match-bitmask) pairs with a mid-partition mask (half the window
+groups dropped) and the sender-mute flag bit armed on 1/16 of rows.
+``--interpret`` runs the interpreter-mode kernel so the tool works
 end-to-end off-TPU (stage attribution is then about interpreter op
 counts, not VPU time — use it to validate the tool and the stub paths,
 not to quote performance).
@@ -48,22 +64,28 @@ from jax import lax
 
 from gossipfs_tpu.ops import merge_pallas
 from gossipfs_tpu.config import AGE_CLAMP
-from gossipfs_tpu.core.state import FAILED, MEMBER, UNKNOWN
+from gossipfs_tpu.core.state import FAILED, MEMBER, SUSPECT, UNKNOWN
 
 LANE = merge_pallas.LANE
 
 
-def build_inputs(n, c_blk, fanout, key, arc_align=1):
+def build_inputs(n, c_blk, fanout, key, arc_align=1, scenario=False):
     nc, cs = n // c_blk, c_blk // LANE
-    ks = jax.random.split(key, 4)
+    ks = jax.random.split(key, 5)
     hb = jax.random.randint(ks[0], (nc, n, cs, LANE), -128, 127, jnp.int8)
     age = jax.random.randint(ks[1], (nc, n, cs, LANE), 0, 40, jnp.int32)
     st = jax.random.randint(ks[2], (nc, n, cs, LANE), 0, 3, jnp.int32)
     asl = merge_pallas.pack_age_status(age, st)
     # active + alive, LANE-compacted (the round-9 production layout; the
-    # wrapper expands it for blockings that need the replicated form)
+    # wrapper expands it for blockings that need the replicated form).
+    # Scenario runs arm the sender-mute bit (8) on 1/16 of the rows — a
+    # representative slow-sender round
     flags = jnp.broadcast_to(
         jnp.int8(1 + 4), (n // LANE, LANE)).astype(jnp.int8)
+    if scenario:
+        muted = (jax.random.uniform(ks[4], (n // LANE, LANE))
+                 < 1.0 / 16.0)
+        flags = (flags + jnp.where(muted, 8, 0)).astype(jnp.int8)
     sa = jnp.zeros((nc, cs, LANE), jnp.int32)
     sb = jnp.zeros((nc, cs, LANE), jnp.int32)
     g = jnp.full((nc, cs, LANE), -120, jnp.int32)
@@ -75,22 +97,36 @@ def build_inputs(n, c_blk, fanout, key, arc_align=1):
             ks[3], (n, 1), 0, n // arc_align, jnp.int32) * arc_align
     else:
         bases = jax.random.randint(ks[3], (n, 1), 0, n, jnp.int32)
+    if scenario:
+        # edge_filter form: (base, group-match bitmask) pairs — a
+        # mid-partition round where ~half of each receiver's window
+        # groups sit across the split (scenarios.tensor.arc_match_edges
+        # builds the real masks from a rule table)
+        nw = fanout // arc_align
+        mask = jax.random.randint(ks[4], (n, 1), 0, 1 << nw, jnp.int32)
+        return hb, asl, flags, sa, sb, g, jnp.concatenate(
+            [bases, mask], axis=1)
     return hb, asl, flags, sa, sb, g, bases
 
 
 def time_stub(n, c_blk, block_r, fanout, stub, rounds, reps,
               arc_align=1, elementwise="lanes", interpret=False,
-              rotate=True):
+              rotate=True, suspicion=False, scenario=False):
     hb, asl, flags, sa, sb, g, bases = build_inputs(
-        n, c_blk, fanout, jax.random.PRNGKey(0), arc_align=arc_align)
+        n, c_blk, fanout, jax.random.PRNGKey(0), arc_align=arc_align,
+        scenario=scenario)
 
     kern = functools.partial(
         merge_pallas.resident_round_blocked,
         fanout=fanout, member=int(MEMBER), unknown=int(UNKNOWN),
         failed=int(FAILED), age_clamp=AGE_CLAMP, window=126,
-        t_fail=5, t_cooldown=12, block_r=block_r, resident=True,
+        t_fail=3 if suspicion else 5, t_cooldown=12, block_r=block_r,
+        resident=True,
         arc_align=arc_align, elementwise=elementwise, interpret=interpret,
         rotate=rotate, _stub=stub,
+        suspect=int(SUSPECT) if suspicion else None,
+        t_suspect=2 if suspicion else 0,
+        edge_filter=scenario,
     )
 
     @jax.jit
@@ -130,18 +166,39 @@ def main():
                    help="A/B the round-9 ring-rotated build + compacted "
                         "flags (auto) against the round-5 full-T/"
                         "replicated layouts (off) — same bits")
+    p.add_argument("--suspicion", action="store_true",
+                   help="arm the fused SWIM lifecycle (round 11) — run "
+                        "with and without to isolate the whole fused "
+                        "suspicion cost; adds the 'sus' reduction stub")
+    p.add_argument("--scenario", action="store_true",
+                   help="run the edge_filter (scenario-armed aligned-arc)"
+                        " build: masked gather over (base, match-mask) "
+                        "pairs + sender-mute flags (requires --arc-align "
+                        "> 1); A/B vs a run without it isolates the "
+                        "filtered build's cost")
     p.add_argument("--stubs", nargs="*", default=None)
     args = p.parse_args()
+    if args.scenario and args.arc_align <= 1:
+        p.error("--scenario (the edge_filter build) requires --arc-align "
+                "> 1; explicit-edge scenarios rewrite edges outside the "
+                "kernel and cost nothing in it")
     if args.stubs is None:
         args.stubs = [
             "", "rcnt", "gather", "wmax,gather", "epi", "epi,rcnt",
             "vtick", "vtick,wmax,gather,epi,rcnt",
         ]
-        if args.arc_align > 1 and args.rr_rotate != "off":
+        if (args.arc_align > 1 and args.rr_rotate != "off"
+                and not args.scenario):
             # the rotated-build stage stub only exists on aligned arcs
-            # running the ring build — under --rr-rotate off it would be
-            # a no-op row mislabelled as a stage cost
+            # running the ring build — under --rr-rotate off (or the
+            # edge_filter build, which replaces the ring with a full-T
+            # masked-gather layout) it would be a no-op row mislabelled
+            # as a stage cost
             args.stubs.insert(3, "wring")
+        if args.suspicion:
+            # isolate the suspicion observable reductions from the fused
+            # lifecycle transitions (see the 'sus' stub doc above)
+            args.stubs.insert(1, "sus")
     # self-describing header row (obs.schema.ROUNDPROF_SCHEMA) — same
     # convention as bench/roundprof.py, so stub-bisect JSONL artifacts
     # carry their schema/shape/knobs and the analyzer can ingest them
@@ -151,7 +208,8 @@ def main():
         "schema": obs_schema.ROUNDPROF_SCHEMA, "tool": "stub_bisect",
         "n": args.n, "block_c": args.block_c, "block_r": args.block_r,
         "arc_align": args.arc_align, "elementwise": args.elementwise,
-        "rr_rotate": args.rr_rotate,
+        "rr_rotate": args.rr_rotate, "suspicion": args.suspicion,
+        "scenario": args.scenario,
         "backend": ("interpret/" if args.interpret else "")
         + jax.default_backend(),
     }), flush=True)
@@ -168,12 +226,15 @@ def main():
                        arc_align=args.arc_align,
                        elementwise=args.elementwise,
                        interpret=args.interpret,
-                       rotate=args.rr_rotate != "off")
+                       rotate=args.rr_rotate != "off",
+                       suspicion=args.suspicion,
+                       scenario=args.scenario)
         print(json.dumps({
             "stub": stub or "(full)",
             "ms_per_round": round(el / args.rounds * 1e3, 3),
             "elementwise": args.elementwise,
             "rr_rotate": args.rr_rotate,
+            "suspicion": args.suspicion, "scenario": args.scenario,
             "backend": ("interpret/" if args.interpret else "")
             + jax.default_backend(),
         }), flush=True)

@@ -142,6 +142,22 @@ CLAIMS = {
                     for r in d["ladder"])
         ) else 0.0,
         1.0, 0.0),
+    # round-11 fast-path unification: a partition + slow-sender scenario
+    # WITH the SWIM lifecycle armed runs on the rr/SWAR kernel config
+    # (no construction gate, no substitution) bit-equal to the XLA
+    # oracle — every state lane, the carry (first_suspect included) and
+    # the per-round suspicion counters.  CPU-pinned (interpret kernel);
+    # the on-chip form is the same command without --interpret, gated
+    # behind bench.py probe_rr_suspicion.
+    "fastpath_parity": (
+        ["env", "JAX_PLATFORMS=cpu", sys.executable,
+         "tools/parity_soak.py", "--interpret", "--n", "2048",
+         "--block-c", "1024", "--block-r", "128", "--rounds", "16",
+         "--crash-rate", "0.02", "--elementwise", "swar",
+         "--suspicion", "--scenario"],
+        lambda d: 1.0 if (d["all_equal"] and d["total_suspects"] > 0
+                          and d["total_refutations"] > 0) else 0.0,
+        1.0, 0.0),
     # observability (obs/): the flight-recorder <-> summarize oracle.
     # timeline.py --selfcheck records a fresh N=1024 churn run at the
     # fast suspicion knob, decodes the scan into a trace, re-derives
