@@ -17,10 +17,17 @@ claims mechanically:
 
   python -m gossipfs_tpu.bench.sdfs_ops
   python -m gossipfs_tpu.bench.sdfs_ops --sizes 65536 1048576 4194304
+  python -m gossipfs_tpu.bench.sdfs_ops --trace /tmp/sdfs_ops.jsonl
 
 The workload mirrors the reference repo's checked-in Wikipedia-dump shards
 (file1..10.txt, ~3-4 MB each) with deterministic pseudo-random payloads of
 the same magnitudes.
+
+``--trace PATH`` streams every measured operation through the flight
+recorder (``obs/``) as ``client_op`` rows under the self-describing
+``gossipfs-obs/v1`` header — the round-10 convention every other bench
+follows — so ``tools/timeline.py`` ingests the artifact directly (it
+attaches the client-op latency rollup to the analysis).
 """
 
 from __future__ import annotations
@@ -53,11 +60,20 @@ def _time(fn) -> float:
     return dt
 
 
-def run(sizes=DEFAULT_SIZES, clusters=CLUSTERS, reps=REPS) -> dict:
+def run(sizes=DEFAULT_SIZES, clusters=CLUSTERS, reps=REPS,
+        trace: str | None = None) -> dict:
     # Reps interleave across cluster sizes (and rep 0 is a discarded
     # warmup) so host-load drift perturbs the 4- and 8-node measurements
     # equally; best-of-reps is the noise-robust latency estimator.  The
     # sequential-medians version was flaky under concurrent load.
+    recorder = None
+    if trace is not None:
+        from gossipfs_tpu.obs.recorder import FlightRecorder
+
+        recorder = FlightRecorder(
+            trace, source="sdfs_ops", sizes=list(sizes),
+            clusters=list(clusters), reps=reps,
+        )
     built = {n_nodes: SDFSCluster(n_nodes, seed=7) for n_nodes in clusters}
     samples: dict[tuple[int, int], dict[str, list[float]]] = {
         (n_nodes, size): {"insert": [], "update": [], "read": []}
@@ -75,11 +91,26 @@ def run(sizes=DEFAULT_SIZES, clusters=CLUSTERS, reps=REPS) -> dict:
                     lambda: cluster.put(name, data, now=now + 1, confirm=lambda: True)
                 )
                 rd = _time(lambda: cluster.get(name))
+                if recorder is not None:
+                    from gossipfs_tpu.obs.schema import Event
+
+                    for op, dt in (("insert", ins), ("update", upd),
+                                   ("read", rd)):
+                        recorder.emit(Event(
+                            round=r, observer=-1, subject=-1,
+                            kind="client_op",
+                            detail={"op": op, "file": name, "bytes": size,
+                                    "ms": round(dt * 1e3, 4), "ok": True,
+                                    "nodes": n_nodes,
+                                    "warmup": r == 0},
+                        ))
                 if r > 0:
                     cell = samples[(n_nodes, size)]
                     cell["insert"].append(ins)
                     cell["update"].append(upd)
                     cell["read"].append(rd)
+    if recorder is not None:
+        recorder.close()
     rows = [
         {
             "nodes": n_nodes,
@@ -132,8 +163,12 @@ def main(argv=None) -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--sizes", type=int, nargs="+", default=list(DEFAULT_SIZES))
     p.add_argument("--reps", type=int, default=REPS)
+    p.add_argument("--trace", type=str, default=None, metavar="PATH",
+                   help="flight-recorder client_op stream (self-describing "
+                        "gossipfs-obs/v1 header; timeline.py-ingestable)")
     args = p.parse_args(argv)
-    print(json.dumps(run(sizes=tuple(args.sizes), reps=args.reps)))
+    print(json.dumps(run(sizes=tuple(args.sizes), reps=args.reps,
+                         trace=args.trace)))
 
 
 if __name__ == "__main__":

@@ -53,6 +53,7 @@ class CoSim:
         log: EventLog | None = None,
         election: str = "local",
         detector=None,
+        repair_budget: int | None = None,
     ):
         """``election``: "local" computes election outcomes centrally inside
         ``update_membership`` (the in-process fast path); "rpc" defers them —
@@ -63,7 +64,14 @@ class CoSim:
 
         ``detector``: any FailureDetector (default: a fresh SimDetector).
         The capacity-frontier interactive CLI passes a
-        ``detector.sim.PackedDetector`` — same seam, rr-kernel state."""
+        ``detector.sim.PackedDetector`` — same seam, rr-kernel state.
+
+        ``repair_budget``: per-pass cap on executed re-replications (the
+        traffic plane's repair-storm scheduler — ``SDFSCluster.
+        fail_recover(budget=...)``); a pass that defers work schedules
+        another pass NEXT round, so a mass failure drains at budget/round
+        instead of serializing one giant pass.  None = unbounded (the
+        reference's behavior)."""
         if election not in ("local", "rpc"):
             raise ValueError(f"unknown election mode: {election!r}")
         self.config = config
@@ -73,6 +81,20 @@ class CoSim:
         self.log = log or EventLog()
         self._recover_at: list[int] = []  # rounds at which to run fail_recover
         self.events: list[DetectionEvent] = []
+        if repair_budget is not None and repair_budget <= 0:
+            raise ValueError(
+                "repair_budget must be positive (None = unbounded)")
+        self.repair_budget = repair_budget
+        # traffic-plane vitals (obs.schema.VITALS_FIELDS tail): client ops
+        # issued/acked through this co-sim plus the repair scheduler's
+        # cumulative/backlog counters — the CLI `traffic status` verb and
+        # the shim Vitals RPC render these
+        self.ops_issued = 0
+        self.ops_acked = 0
+        self.repairs_done = 0
+        # files currently reported lost (no replica in the view) — a heal
+        # that brings replicas back clears the entry so a re-loss re-emits
+        self._lost_reported: set[str] = set()
         # armed fault scenario (scenarios/): the detector gets the gossip
         # transport rules; the control plane additionally confines
         # RPC/scp-level reachability to the master's side of any active
@@ -123,6 +145,7 @@ class CoSim:
             doc.update({k: sus[k] for k in (
                 "suspects_now", "suspects_entered", "refutations",
                 "confirms", "fp_suppressed") if k in sus})
+        doc.update(self.traffic_status())
         return doc
 
     def load_scenario(self, scenario) -> None:
@@ -238,7 +261,8 @@ class CoSim:
             due = [r for r in self._recover_at if r <= now]
             if due:
                 self._recover_at = [r for r in self._recover_at if r > now]
-                plans = self.cluster.fail_recover()
+                plans = self.cluster.fail_recover(budget=self.repair_budget)
+                self.repairs_done += len(plans)
                 for plan in plans:
                     # logged by the SOURCE machine doing the Re_put
                     # (slave.go:1174)
@@ -252,9 +276,37 @@ class CoSim:
                     self._rec("replica_repair", observer=plan.source,
                               file=plan.file, version=plan.version,
                               targets=list(plan.new_nodes))
+                if self.cluster.last_repair_pending:
+                    # budget deferred planned repairs: drain next round
+                    # (the repair-storm scheduler's retry cadence)
+                    self._recover_at.append(now + 1)
+                # files with no replica left in the view: observable loss
+                # evidence (recovers — and re-arms — across heals)
+                lost_now = set(self.cluster.lost_files())
+                for name in sorted(lost_now - self._lost_reported):
+                    self.log.write(
+                        f"All replicas of {name} lost from the view",
+                        round=now, kind="lost",
+                        node=self.cluster.master_node,
+                    )
+                    self._rec("replica_lost",
+                              observer=self.cluster.master_node, file=name)
+                self._lost_reported = lost_now
 
     # -- client verbs delegated with sim time ------------------------------
+    def _put_event(self, name: str) -> None:
+        """One acked put's schema event: the committed version plus the
+        replica nodes that actually acked (reachable at commit time) —
+        what the durability audit (traffic/audit.py) replays."""
+        info = self.cluster.master.files.get(name)
+        if info is None:
+            return
+        acked = [nd for nd in info.node_list if nd in self.cluster.reachable]
+        self._rec("replica_put", observer=self.cluster.master_node,
+                  file=name, version=info.version, replicas=acked)
+
     def put(self, name: str, data: bytes, confirm=None) -> bool:
+        self.ops_issued += 1
         ok = self.cluster.put(name, data, now=self.round, confirm=confirm)
         # logged at the master handling Get_put_info (server.go:74-121)
         self.log.write(
@@ -264,12 +316,63 @@ class CoSim:
             node=self.cluster.master_node,
         )
         if ok:
-            self._rec("replica_put", observer=self.cluster.master_node,
-                      file=name)
+            self.ops_acked += 1
+            self._put_event(name)
         return ok
 
+    def put_batch(self, items, confirm=None) -> dict[str, bool]:
+        """Batched write verb for the open-loop traffic plane: one
+        vectorized placement draw for the round's new files
+        (``SDFSCluster.put_batch``), per-file acks/events as usual."""
+        self.ops_issued += len(items)
+        results = self.cluster.put_batch(items, now=self.round,
+                                         confirm=confirm)
+        for name, ok in results.items():
+            self.log.write(
+                f"put {name} -> {'ok' if ok else 'rejected'}",
+                round=self.round,
+                kind="put",
+                node=self.cluster.master_node,
+            )
+            if ok:
+                self.ops_acked += 1
+                self._put_event(name)
+        return results
+
     def get(self, name: str) -> bytes | None:
-        return self.cluster.get(name)
+        self.ops_issued += 1
+        blob = self.cluster.get(name)
+        if blob is not None:
+            self.ops_acked += 1
+        return blob
 
     def delete(self, name: str) -> bool:
-        return self.cluster.delete(name)
+        self.ops_issued += 1
+        ok = self.cluster.delete(name)
+        if ok:
+            self.ops_acked += 1
+            self.log.write(
+                f"delete {name}", round=self.round, kind="delete",
+                node=self.cluster.master_node,
+            )
+            self._rec("replica_delete", observer=self.cluster.master_node,
+                      file=name)
+            self._lost_reported.discard(name)
+        return ok
+
+    # -- traffic vitals (obs/schema.py VITALS_FIELDS tail) ------------------
+    def traffic_status(self) -> dict:
+        """The traffic-plane counter document: ops issued/acked through
+        this co-sim, repairs executed, and the CURRENT repair backlog
+        (budget-deferred plans from the last recovery pass plus files
+        still under-replicated right now — computed on demand; cheap at
+        interactive scale)."""
+        pending = len(self.cluster.master.plan_repairs(
+            self.cluster.live, reachable=self.cluster.reachable
+        ))
+        return {
+            "ops_issued": self.ops_issued,
+            "ops_acked": self.ops_acked,
+            "repairs_pending": pending,
+            "repairs_done": self.repairs_done,
+        }

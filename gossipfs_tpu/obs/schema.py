@@ -77,10 +77,18 @@ EVENT_KINDS: dict[str, str] = {
     "suspicion_clear": "suspicion disarmed",
     # -- SDFS control plane
     "election": "a master election resolved (subject = the new master)",
-    "replica_put": "a file version committed (detail.file / version)",
+    "replica_put": "a file version committed (detail.file / version / "
+                   "replicas — the nodes that acked; the durability "
+                   "audit's write record)",
     "replica_repair": "a replica re-replicated after loss "
                       "(detail.file / source / target)",
+    "replica_delete": "a file's metadata + replicas dropped by a client "
+                      "delete (detail.file)",
     "replica_lost": "no live replica of a file remains",
+    # -- traffic plane (traffic/)
+    "client_op": "one SDFS client operation completed (detail.op / file / "
+                 "bytes / ms / ok) — the open-loop load generator's and "
+                 "bench/sdfs_ops.py's per-op latency row",
     # -- operational
     "node_start": "a deploy node process came up",
 }
@@ -162,6 +170,13 @@ VITALS_FIELDS = (
     "refutations",
     "confirms",
     "fp_suppressed",    # sim-only: refutations of actually-alive subjects
+    # -- traffic plane (traffic/; the CLI `traffic status` verb's set) —
+    # engines without an SDFS data plane (udp, deploy today) simply omit
+    # them and render n/a, per the round-8 absent-not-zero rule
+    "ops_issued",       # client ops (put/get/delete) issued via this plane
+    "ops_acked",        # of those, completed (quorum-acked / found / ok)
+    "repairs_pending",  # under-replicated files awaiting a repair pass
+    "repairs_done",     # re-replication plans executed so far
 )
 
 
@@ -212,6 +227,7 @@ LOG_KIND_MAP: dict[str, str] = {
     "re_replicate": "replica_repair",
     "reput": "replica_repair",
     "put": "replica_put",
+    "delete": "replica_delete",
     "lost": "replica_lost",
     "elected": "election",
     "new_master": "election",

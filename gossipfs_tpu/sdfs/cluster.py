@@ -18,7 +18,7 @@ from typing import Callable
 
 from gossipfs_tpu.sdfs import election
 from gossipfs_tpu.sdfs.master import SDFSMaster
-from gossipfs_tpu.sdfs.quorum import quorum
+from gossipfs_tpu.sdfs.quorum import read_quorum, write_quorum
 from gossipfs_tpu.sdfs.store import LocalStore
 from gossipfs_tpu.sdfs.types import WRITE_CONFLICT_WINDOW, ReplicatePlan
 
@@ -35,6 +35,9 @@ class SDFSCluster:
         self.live: list[int] = list(range(n))      # gossip membership VIEW
         self.reachable: set[int] = set(self.live)  # transport-level reachability
         self.election_pending = False  # master missing, external driver elects
+        # repairs a budgeted fail_recover pass planned but deferred (the
+        # repair-storm scheduler's backlog signal — see fail_recover)
+        self.last_repair_pending = 0
         self.master.update_member(self.live)
 
     # -- membership seam ---------------------------------------------------
@@ -129,6 +132,12 @@ class SDFSCluster:
             if confirm is None or not confirm():
                 return False  # "Write-Write conflicts!" (slave.go:681-686)
         replicas, version = self.master.handle_put(name, now)
+        return self._push(name, data, replicas, version)
+
+    def _push(self, name: str, data: bytes, replicas: list[int],
+              version: int) -> bool:
+        """Replica fan-out + W-ack count — the write path's commit half,
+        shared by :meth:`put` and :meth:`put_batch`."""
         if not replicas:
             return False  # no live members to place on
         acks = 0
@@ -136,7 +145,37 @@ class SDFSCluster:
             if node in self.reachable:  # scp to a dead host fails, no ack
                 self.stores[node].put(name, data, version)
                 acks += 1
-        return acks >= quorum(len(replicas))
+        return acks >= write_quorum(len(replicas))
+
+    def put_batch(
+        self,
+        items: list[tuple[str, bytes]],
+        now: int,
+        confirm: Callable[[], bool] | None = None,
+    ) -> dict[str, bool]:
+        """Many puts in one round: placement for every NEW file happens as
+        ONE vectorized draw (``SDFSMaster.handle_put_batch``) instead of a
+        per-file RNG walk; conflict checking, version bumps, replica
+        pushes and W-ack counting stay per file (bytes still move per
+        replica).  The traffic plane's open-loop generator drives this at
+        thousands of files per round.
+        """
+        allowed: list[str] = []
+        results: dict[str, bool] = {}
+        payload: dict[str, bytes] = {}
+        for name, data in items:
+            if self.master.updated_recently(name, now) and (
+                confirm is None or not confirm()
+            ):
+                results[name] = False  # conflict window, unconfirmed
+                continue
+            allowed.append(name)
+            payload[name] = data
+        placed = self.master.handle_put_batch(allowed, now)
+        for name in allowed:
+            replicas, version = placed[name]
+            results[name] = self._push(name, payload[name], replicas, version)
+        return results
 
     def get(self, name: str) -> bytes | None:
         """Read path with quorum of version reports + read-repair
@@ -149,7 +188,7 @@ class SDFSCluster:
             for node in replicas
             if node in self.reachable
         }
-        if len(reports) < quorum(len(replicas)):
+        if len(reports) < read_quorum(len(replicas)):
             return None  # can't reach a quorum of replicas
         # stale replicas self-repair by pulling from a fresh one (slave.go:799-813)
         fresh = [node for node, v in reports.items() if v >= version]
@@ -181,8 +220,19 @@ class SDFSCluster:
         """Files stored on one node (slave.go:919-928)."""
         return self.stores[node].listing()
 
+    def lost_files(self) -> list[str]:
+        """Files with NO replica left in the membership view — the
+        ``replica_lost`` evidence (plan_repairs silently skips them as
+        unrecoverable; the traffic plane wants them observable)."""
+        live_set = set(self.live)
+        return [
+            name
+            for name, info in self.master.files.items()
+            if not any(nd in live_set for nd in info.node_list)
+        ]
+
     # -- failure recovery (slave.go:1093-1175 + master.go:74-127) ----------
-    def fail_recover(self) -> list[ReplicatePlan]:
+    def fail_recover(self, budget: int | None = None) -> list[ReplicatePlan]:
         """Re-replicate every under-replicated file from its first healthy
         replica (Fail_recover + Re_put).  Called RECOVERY_DELAY rounds after a
         detection in the co-sim driver.
@@ -192,13 +242,31 @@ class SDFSCluster:
         dead-but-undetected) leaves the file under-replicated in metadata and
         eligible for retry on the next recovery pass.
 
+        ``budget``: the repair-storm scheduler's per-pass cap — at most this
+        many plans EXECUTE (plans arrive most-deficient-first from
+        ``plan_repairs``, so the budget drains the files closest to data
+        loss first); the remainder stays under-replicated in metadata and
+        is re-planned next pass.  ``last_repair_pending`` records how many
+        planned repairs the budget deferred, so the co-sim driver knows to
+        schedule another pass immediately instead of waiting for the next
+        detection.
+
         Returns only *executed* plans, with ``new_nodes`` narrowed to the
         copies that actually landed — what the event log and the bench's
         repair count should reflect.
         """
+        if budget is not None and budget <= 0:
+            # a zero budget would defer every plan forever while the
+            # driver reschedules a full planning sweep each round
+            raise ValueError("repair budget must be positive (None = "
+                             "unbounded)")
         plans = self.master.plan_repairs(self.live, reachable=self.reachable)
         executed: list[ReplicatePlan] = []
-        for plan in plans:
+        self.last_repair_pending = 0
+        for i, plan in enumerate(plans):
+            if budget is not None and len(executed) >= budget:
+                self.last_repair_pending = len(plans) - i
+                break
             # a listed survivor can hold no bytes (put acked by quorum while
             # it was unreachable, then it rejoined): fall through the other
             # reachable survivors instead of livelocking on an empty source

@@ -65,6 +65,40 @@ class SDFSMaster:
         info.timestamp = now
         return list(info.node_list), info.version
 
+    def handle_put_batch(
+        self, names: list[str], now: int
+    ) -> dict[str, tuple[list[int], int]]:
+        """Batch put path for the traffic plane: one vectorized placement
+        draw covers every NEW file in the batch (``placement.place_batch_np``
+        — thousands of files per round cost one Gumbel top-k instead of
+        n_files sequential ``rng.sample`` calls), then the per-file version
+        bump reuses :meth:`handle_put` (which finds the placement already
+        recorded).  Same uniform-without-replacement semantics; only the
+        random draws differ from the sequential path (both uniform), and
+        they come from a membership+batch-keyed derived RNG so batch
+        placement neither consumes nor perturbs the sequential RNG stream.
+        """
+        new = [nm for nm in names if nm not in self.files]
+        if len(new) >= BATCH_PLAN_THRESHOLD and len(self.members) > (
+            REPLICATION_FACTOR
+        ):
+            import hashlib
+
+            digest = hashlib.sha256(
+                f"{self._seed}:{self.members}:{len(self.files)}:{new[0]}"
+                .encode()
+            ).digest()
+            rng = np.random.default_rng(int.from_bytes(digest[:16], "little"))
+            rows = placement.place_batch_np(
+                rng, np.asarray(self.members), len(new)
+            )
+            for nm, nodes in zip(new, rows):
+                self.files[nm] = FileInfo(
+                    node_list=[int(x) for x in nodes], version=0,
+                    timestamp=now,
+                )
+        return {nm: self.handle_put(nm, now) for nm in names}
+
     # -- read path (master.go:177-212) ------------------------------------
     def file_info(self, name: str) -> tuple[list[int], int]:
         """Replica list + version; ([], -1) when absent (Get_file_info)."""
@@ -95,6 +129,12 @@ class SDFSMaster:
         reachable, and the caller commits the new node_list only for copies
         that succeeded — see ``commit_repair``.  Divergences documented and
         covered by tests.)
+
+        Plans come back MOST-DEFICIENT-FIRST (fewest surviving replicas at
+        the front, ties in file-iteration order): the repair-storm
+        scheduler (``SDFSCluster.fail_recover(budget=...)``) executes a
+        per-round budget off this ordering, so a mass failure spends its
+        budget on the files closest to data loss first.
         """
         live_set = set(live)
         reach = live_set if reachable is None else (set(reachable) & live_set)
@@ -138,6 +178,7 @@ class SDFSMaster:
                         survivors=tuple(working),
                     )
                 )
+        plans.sort(key=lambda p: len(p.survivors))  # most-deficient-first
         return plans
 
     def _plan_repairs_batch(
@@ -191,6 +232,9 @@ class SDFSMaster:
         digest = hashlib.sha256(f"{self._seed}:{members}".encode()).digest()
         rng = np.random.default_rng(int.from_bytes(digest[:16], "little"))
         dead_rows = np.nonzero(deficient)[0]
+        # most-deficient-first, stable on file index — the same ordering
+        # contract as the loop path (repair-budget scheduling depends on it)
+        dead_rows = dead_rows[np.argsort(w_count[dead_rows], kind="stable")]
         reach_sorted = np.sort(reach_arr)
         n_reach = len(reach_sorted)
 

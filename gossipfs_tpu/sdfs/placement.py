@@ -6,10 +6,17 @@ until it has 4 distinct ones.  Note the reference's latent bug — it draws with
 snapshot; we implement the evidently intended uniform choice (documented
 deviation, caught by statistical test).
 
-Two implementations with identical semantics:
+Three implementations with identical semantics:
   * ``place`` — plain Python over a membership list (control-plane path).
   * ``place_batch`` — vectorized JAX placement of many files at once over an
-    alive mask, for the 100k-node SDFS co-sim (BASELINE config 5).
+    alive mask, for the 100k-node SDFS co-sim (BASELINE config 5).  Two
+    methods behind one call: the exact Gumbel top-k (O(n_files x N) — fine
+    to ~8k members) and, at traffic-plane scale, a rejection-free SAMPLED
+    draw (O(n_files x m), m = a small static oversample) that never
+    materializes an [n_files, N] score matrix.
+  * ``place_batch_np`` — the host-side (numpy) batch form the metadata
+    master uses for thousands-of-puts-per-round workloads
+    (``SDFSMaster.handle_put_batch``).
 """
 
 from __future__ import annotations
@@ -18,8 +25,22 @@ import random
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from gossipfs_tpu.sdfs.types import REPLICATION_FACTOR
+
+# place_batch switches from the exact Gumbel top-k to the sampled draw
+# above this member count: the Gumbel path's [n_files, N] perturbed-score
+# matrix is exact but costs n_files x N floats (1.6 GB at 2048 files over
+# 100k members), while the sampled path is O(n_files x OVERSAMPLE)
+BATCH_GUMBEL_MAX_N = 8192
+
+# draws per file on the sampled path: first-k-distinct of iid uniform
+# draws IS uniform-without-replacement; with k=4 picks the chance of
+# fewer than k distinct among 8k draws is ~(k/n_alive)^(m-k) — negligible
+# whenever n_alive >> k (the regime the method is selected for), and a
+# short row falls back to -1 slots the caller retries
+OVERSAMPLE_FACTOR = 8
 
 
 def place(
@@ -31,19 +52,97 @@ def place(
     return rng.sample(list(members), k)
 
 
+def first_k_distinct(nodes: jnp.ndarray, k: int) -> jnp.ndarray:
+    """[rows, m] draws -> [rows, k] first-k-distinct per row, -1 padded.
+
+    Keeping the FIRST occurrence of each value in draw order is exactly
+    sequential rejection sampling, so the result is uniform without
+    replacement given iid uniform draws.
+    """
+    rows, m = nodes.shape
+    # dup[i, j, j2] — draw j repeats an EARLIER draw j2 < j of the same row
+    dup = (nodes[:, :, None] == nodes[:, None, :]) & (
+        jnp.arange(m)[None, :] < jnp.arange(m)[:, None]
+    )[None]
+    is_new = ~dup.any(axis=2) & (nodes >= 0)
+    rank = jnp.cumsum(is_new, axis=1) - 1
+    take = is_new & (rank < k)
+    out = jnp.full((rows, k), -1, dtype=jnp.int32)
+    row_idx = jnp.broadcast_to(jnp.arange(rows)[:, None], (rows, m))
+    return out.at[row_idx, jnp.where(take, rank, k)].set(
+        jnp.where(take, nodes.astype(jnp.int32), -1), mode="drop"
+    )
+
+
+def sample_members(key: jax.Array, mask: jax.Array, rows: int,
+                   m: int) -> jnp.ndarray:
+    """[rows, m] node ids drawn iid-uniformly over ``mask``'s true set.
+
+    Rank-to-index via searchsorted on the mask's cumsum — no [rows, N]
+    intermediate, no dynamic-shape nonzero.  Rows are -1 when the mask is
+    empty.
+    """
+    n_set = jnp.sum(mask)
+    cum = jnp.cumsum(mask.astype(jnp.int32))
+    ranks = jax.random.randint(key, (rows, m), 0, jnp.maximum(n_set, 1))
+    nodes = jnp.searchsorted(cum, ranks + 1).astype(jnp.int32)
+    return jnp.where(n_set > 0, nodes, -1)
+
+
 def place_batch(
-    key: jax.Array, alive: jax.Array, n_files: int, k: int = REPLICATION_FACTOR
+    key: jax.Array,
+    alive: jax.Array,
+    n_files: int,
+    k: int = REPLICATION_FACTOR,
+    method: str = "auto",
 ) -> jax.Array:
     """int32 [n_files, k] — independent uniform placements over live nodes.
 
-    Samples without replacement per file via Gumbel top-k over the alive mask
-    (one fused sort instead of a per-file rejection loop).  Files get the k
-    live nodes with the largest perturbed scores; if fewer than k nodes are
-    alive, dead slots are filled with -1.
+    ``method="gumbel"``: samples without replacement per file via Gumbel
+    top-k over the alive mask (one fused sort; exact — if fewer than k
+    nodes are alive, dead slots are filled with -1).  ``method="sampled"``:
+    rejection-free oversampled draw (``sample_members`` + first-k-distinct)
+    that scales to 100k+ members; a row may carry -1 slots when the draw
+    collides (vanishingly rare at n_alive >> k) or n_alive < k — callers
+    treat -1 as an unplaced slot and retry.  ``"auto"`` picks gumbel at or
+    below ``BATCH_GUMBEL_MAX_N`` members, sampled above.
     """
     n = alive.shape[0]
-    g = jax.random.gumbel(key, (n_files, n))
-    scores = jnp.where(alive[None, :], g, -jnp.inf)
-    _, idx = jax.lax.top_k(scores, k)
-    enough = jnp.sum(alive) >= jnp.arange(1, k + 1)[None, :]
-    return jnp.where(enough, idx.astype(jnp.int32), -1)
+    if method == "auto":
+        method = "gumbel" if n <= BATCH_GUMBEL_MAX_N else "sampled"
+    if method == "gumbel":
+        g = jax.random.gumbel(key, (n_files, n))
+        scores = jnp.where(alive[None, :], g, -jnp.inf)
+        _, idx = jax.lax.top_k(scores, k)
+        enough = jnp.sum(alive) >= jnp.arange(1, k + 1)[None, :]
+        return jnp.where(enough, idx.astype(jnp.int32), -1)
+    if method != "sampled":
+        raise ValueError(f"unknown placement method: {method!r}")
+    nodes = sample_members(key, alive, n_files, OVERSAMPLE_FACTOR * k)
+    return first_k_distinct(nodes, k)
+
+
+def place_batch_np(
+    rng: np.random.Generator,
+    members: np.ndarray,
+    n_files: int,
+    k: int = REPLICATION_FACTOR,
+) -> np.ndarray:
+    """Host-side batch placement: int64 [n_files, k] over a member array.
+
+    The metadata master's thousands-of-new-files-per-round path
+    (``SDFSMaster.handle_put_batch``): one Gumbel top-k over the member
+    list per call — same uniform-without-replacement semantics as
+    ``place``, different (still uniform) draws, numpy only so the
+    control plane stays host-side.  Fewer than k members: every file
+    gets the whole list (``place``'s small-cluster rule).
+    """
+    members = np.asarray(members, dtype=np.int64)
+    n_m = len(members)
+    if n_m <= k:
+        return np.tile(members, (n_files, 1)) if n_m else np.empty(
+            (n_files, 0), dtype=np.int64
+        )
+    g = rng.gumbel(size=(n_files, n_m))
+    idx = np.argpartition(-g, k - 1, axis=1)[:, :k]
+    return members[idx]

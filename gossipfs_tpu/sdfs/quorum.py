@@ -1,15 +1,42 @@
-"""Quorum arithmetic for replicated reads/writes.
+"""Quorum arithmetic for replicated reads/writes — the ONE owner.
 
 Reference: ``cal_quorum_num`` computes ``Ceil((len+1)/2)`` with *integer*
 division, so the Ceil is a no-op and the quorum is ``floor((n+1)/2)`` — 2 of 4
-replicas (slave/slave.go:717-722; the report claims "ACK by 3 replicas" but the
-code disagrees, BASELINE.md).  We reproduce the code's behavior, which is the
-actually-deployed semantics.
+replicas for BOTH writes and reads (slave/slave.go:717-722).  The report
+claims "ACK by 3 replicas" for writes — ``ceil((n+1)/2)`` = 3 of 4, the
+W=3/R=2 pair whose ``W + R > n`` inequality is what actually guarantees a
+read quorum intersects the last acked write.  The code disagrees with the
+report, and we reproduce the CODE's behavior (the actually-deployed
+semantics, BASELINE.md "Protocol constants"); ``claimed_write_quorum``
+exposes the report's intended value so the discrepancy stays checkable.
+
+Single-ownership rule (pinned by a lint test in tests/test_traffic.py):
+every consumer — ``sdfs/cluster.py``'s ack counting, the traffic plane's
+planner/harness (``gossipfs_tpu/traffic/``) — imports these functions.
+No re-derived ``(n + 1) // 2`` exists anywhere else in the tree.
 """
 
 from __future__ import annotations
 
 
 def quorum(n_replicas: int) -> int:
-    """Acks required before a put/get completes: floor((n+1)/2)."""
+    """The deployed quorum: floor((n+1)/2) — 2 of 4 for writes AND reads."""
     return (n_replicas + 1) // 2
+
+
+def write_quorum(n_replicas: int) -> int:
+    """W — acks required before a put commits (slave.go:717-722 deployed
+    arithmetic; the report claims ``claimed_write_quorum``)."""
+    return quorum(n_replicas)
+
+
+def read_quorum(n_replicas: int) -> int:
+    """R — replica version reports required before a get proceeds."""
+    return quorum(n_replicas)
+
+
+def claimed_write_quorum(n_replicas: int) -> int:
+    """The report's claimed W: the Ceil ``cal_quorum_num`` INTENDED —
+    ceil((n+1)/2), i.e. 3 of 4 — which with R=2 satisfies W + R > n.
+    Documented-discrepancy accessor only; nothing executes this policy."""
+    return n_replicas // 2 + 1

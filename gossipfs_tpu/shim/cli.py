@@ -25,6 +25,11 @@ Command surface matches README.md:8-29 plus fault/time controls the sim adds:
                                      suspect counts, refutations, confirms
                                      — needs --t-suspect); lsm marks a
                                      SUSPECT entry with a trailing ?
+  traffic status                     SDFS traffic-plane vitals (ops
+                                     issued/acked, repairs pending/done —
+                                     the obs/schema.py VITALS_FIELDS
+                                     tail; engines without a data plane
+                                     render every field n/a, never 0)
   grep [--node <k>] <regex>          search the event log (MP1 legacy verb);
                                      --node scopes to one machine's log view
 
@@ -252,6 +257,22 @@ def dispatch(
                           file=out)
             else:
                 print(f"unknown suspicion verb: {sub} (status)", file=out)
+        elif cmd == "traffic":
+            sub = args[0] if args else "status"
+            if sub == "status":
+                # the traffic-plane tail of obs.schema.VITALS_FIELDS; an
+                # engine without an SDFS data plane omits the fields and
+                # each renders n/a, never a measured 0 (the round-8 rule)
+                st = (sim.traffic_status()
+                      if hasattr(sim, "traffic_status") else {})
+                fmt = lambda k: ("n/a" if st.get(k) is None  # noqa: E731
+                                 else st[k])
+                print(f"ops issued={fmt('ops_issued')} "
+                      f"acked={fmt('ops_acked')}; "
+                      f"repairs pending={fmt('repairs_pending')} "
+                      f"done={fmt('repairs_done')}", file=out)
+            else:
+                print(f"unknown traffic verb: {sub} (status)", file=out)
         elif cmd == "grep":
             # ``grep [--node <k>] [--] <pattern>``: the explicit flag
             # scopes the search to node k's own log view (distributed-grep

@@ -1,0 +1,28 @@
+"""SDFS traffic plane: open-loop load + tensorized placement/repair planning.
+
+The reference is a *file system* (PAPER.md §0), yet until this subsystem
+the data plane was only benched by a handful of sequential ops at 4-8
+nodes.  ``traffic/`` closes ROADMAP's "SDFS under production traffic"
+item with three pieces:
+
+  * ``workload.py`` — a deterministic OPEN-LOOP generator (arrivals keep
+    coming whether or not the system keeps up): put/get/delete mixes at a
+    controlled per-round rate, Zipf or uniform key popularity, file-size
+    distribution mirroring the reference's ~3-4 MB Wikipedia shards;
+    drivers for the interactive CoSim and the gRPC shim.
+  * ``planner.py`` — placement and repair planning TENSORIZED against the
+    live [N] alive mask: thousands of placements per round and the whole
+    repair set (replicas-lost x under-replicated-files) as one masked
+    top-k, with a per-round repair budget (the repair-storm scheduler).
+    Quorum arithmetic is imported from ``sdfs/quorum.py`` — never
+    re-derived here (lint-tested).
+  * ``harness.py`` + ``audit.py`` — latency/throughput/durability runs
+    (steady state, churn, a write burst racing a timed partition, a
+    rack-kill repair storm), every op and repair flight-recorded so
+    ``tools/timeline.py`` re-derives the durability facts from events
+    alone (``verify_claims.py traffic_durability``).
+
+Committed artifact: ``TRAFFIC_r12.json`` (``bench/traffic_bench.py``).
+"""
+
+from gossipfs_tpu.traffic.workload import Workload, WorkloadSpec  # noqa: F401

@@ -1,0 +1,84 @@
+"""Durability facts derived from flight-recorder events ALONE.
+
+The traffic harness reports durability from cluster state (it can read
+every store).  This module re-derives the same facts by replaying the
+event stream — ``replica_put`` (acked version + acking nodes), ``crash``
+/ ``join`` (ground-truth liveness), ``replica_repair`` (landed copies),
+``replica_delete`` — with no access to the run.  ``tools/timeline.py``
+attaches this to its analysis whenever a stream carries traffic events,
+and ``tools/verify_claims.py``'s ``traffic_durability`` claim requires
+the two accountings to agree EXACTLY (the observability subsystem's
+standing-oracle pattern, applied to the data plane).
+
+Conservative by construction: read-repair refills (a stale replica
+pulling fresh bytes during a get) emit no event, so the event-side
+replica sets can only UNDER-count copies — an event-side "zero lost"
+verdict is therefore at least as strong as the harness's.
+
+Pure python + stdlib only (the obs package convention), so the deploy
+lane's jax-free tooling can import it too.
+"""
+
+from __future__ import annotations
+
+
+def durability_from_events(events) -> dict:
+    """Replay a (round-ordered) event stream into durability facts.
+
+    Returns the comparable fact set: ``acked_writes`` (replica_put event
+    count), ``files_acked`` (distinct files with an undeleted acked
+    write), ``repair_events``, ``lost`` + ``lost_files`` (files whose
+    last-acked version survives on NO event-known live replica at end of
+    stream), and ``repair_complete_round`` (the last repair's round — the
+    repair-storm completion mark).
+    """
+    events = sorted(
+        events, key=lambda e: (e.round, 0 if e.kind in ("crash", "join")
+                               else 1)
+    )
+    dead: set[int] = set()
+    # file -> {node: version} as far as events can know it
+    holders: dict[str, dict[int, int]] = {}
+    acked_version: dict[str, int] = {}
+    acked_writes = 0
+    repair_events = 0
+    repair_complete_round = None
+    for e in events:
+        d = e.detail
+        if e.kind == "crash":
+            dead.add(e.subject)
+        elif e.kind == "join":
+            dead.discard(e.subject)
+        elif e.kind == "replica_put":
+            acked_writes += 1
+            name, version = d.get("file"), int(d.get("version", 0))
+            acked_version[name] = version
+            h = holders.setdefault(name, {})
+            for nd in d.get("replicas", []):
+                h[int(nd)] = version
+        elif e.kind == "replica_repair":
+            repair_events += 1
+            repair_complete_round = e.round
+            name, version = d.get("file"), int(d.get("version", 0))
+            h = holders.setdefault(name, {})
+            for nd in d.get("targets", []):
+                h[int(nd)] = version
+        elif e.kind == "replica_delete":
+            acked_version.pop(d.get("file"), None)
+            holders.pop(d.get("file"), None)
+    lost_files = sorted(
+        name
+        for name, version in acked_version.items()
+        if not any(
+            nd not in dead and v >= version
+            for nd, v in holders.get(name, {}).items()
+        )
+    )
+    return {
+        "acked_writes": acked_writes,
+        "files_acked": len(acked_version),
+        "repair_events": repair_events,
+        "repair_complete_round": repair_complete_round,
+        "lost": len(lost_files),
+        "lost_files": lost_files,
+    }

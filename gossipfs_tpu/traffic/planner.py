@@ -1,0 +1,249 @@
+"""Tensorized placement + repair planning over the live [N] alive mask.
+
+The per-file Python repair loop (``SDFSMaster.plan_repairs``) is the right
+shape at CLI scale; at the north-star scale — 100k+ members, tens of
+thousands of files, thousands of arrivals per round — placement and repair
+planning must be ARRAY programs against the same [N] masks the gossip
+layer already produces.  This module is that program:
+
+  * **placement** — ``sdfs/placement.py::place_batch`` (extended round 12
+    with the rejection-free sampled method) places thousands of files per
+    round without an [n_files, N] intermediate;
+  * **repair planning** — the whole replicas-lost x under-replicated-files
+    diff is ONE masked computation: per-file surviving-replica counts from
+    ``alive[replicas]``, deficiency scores, and a single ``top_k`` picking
+    the ``budget`` most-deficient repairable files (the repair-storm
+    scheduler: a rack-kill's thousand deficient files drain at
+    budget/round, most-endangered first, instead of serializing);
+  * **commit** — survivors compact to the row front, fresh reachable
+    non-replica picks fill the tail, all in-array.
+
+Quorum arithmetic is IMPORTED from ``sdfs/quorum.py`` (``write_quorum`` /
+``read_quorum``) — never re-derived here; a lint test enforces it.
+
+``ReplicaTable`` is the host-side wrapper the scale bench drives
+(``bench/traffic_bench.py --scale``): it holds the replica table on
+device and exposes place / plan+commit / ack-accounting steps.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gossipfs_tpu.sdfs.placement import (
+    OVERSAMPLE_FACTOR,
+    first_k_distinct,
+    place_batch,
+    sample_members,
+)
+from gossipfs_tpu.sdfs.quorum import read_quorum, write_quorum
+from gossipfs_tpu.sdfs.types import REPLICATION_FACTOR
+
+
+class RepairPlan(NamedTuple):
+    """One budgeted planning pass over the whole table (device arrays).
+
+    ``idx``/``valid`` — the up-to-``budget`` chosen file rows (invalid
+    slots are budget headroom beyond the deficient count); ``source`` —
+    first reachable surviving replica per chosen file; ``need`` — copies
+    required; ``picks`` — [budget, k] fresh reachable non-replica nodes
+    (-1 past ``need``); ``deficient`` — total repairable-deficient files
+    BEFORE the budget cut (the backlog gauge); ``lost`` — files whose
+    replicas are all gone (unrecoverable this pass).
+    """
+
+    idx: jax.Array
+    valid: jax.Array
+    source: jax.Array
+    need: jax.Array
+    picks: jax.Array
+    deficient: jax.Array
+    lost: jax.Array
+
+
+def _working(replicas: jax.Array, mask: jax.Array) -> jax.Array:
+    """[F, k] — replica slot holds a node currently in ``mask``."""
+    return (replicas >= 0) & mask[jnp.clip(replicas, 0)]
+
+
+@functools.partial(jax.jit, static_argnames=("budget", "k"))
+def plan_repairs_tensor(
+    key: jax.Array,
+    replicas: jax.Array,
+    n_files: jax.Array,
+    alive: jax.Array,
+    reach: jax.Array,
+    budget: int,
+    k: int = REPLICATION_FACTOR,
+) -> RepairPlan:
+    """The masked-top-k repair planner (semantics of
+    ``SDFSMaster.plan_repairs``, vectorized): deficient = fewer than
+    min(k, n_alive) surviving replicas, at least one survivor reachable
+    (the copy source); the ``budget`` most-deficient files get
+    ``k - survivors`` fresh picks drawn uniformly without replacement
+    from reachable non-replica nodes.  Deterministic under ``key``.
+    """
+    cap = replicas.shape[0]
+    used = jnp.arange(cap) < n_files
+    working = _working(replicas, alive) & used[:, None]
+    w = working.sum(axis=1)
+    target = jnp.minimum(k, alive.sum())
+    sourced = working & reach[jnp.clip(replicas, 0)]
+    placed = used & (replicas >= 0).any(axis=1)
+    lost = placed & (w == 0)
+    deficient = placed & (w < target) & (w > 0) & sourced.any(axis=1)
+
+    score = jnp.where(deficient, (k - w).astype(jnp.int32), 0)
+    top, idx = jax.lax.top_k(score, min(budget, cap))
+    valid = top > 0
+
+    src_slot = jnp.argmax(sourced[idx], axis=1)
+    source = jnp.where(
+        valid, replicas[idx, src_slot], -1
+    )
+    need = jnp.where(valid, k - w[idx], 0)
+
+    # fresh picks: oversampled reachable draws, banned = the file's own
+    # current replicas (dead ones included — a dead-but-listed node must
+    # not be re-picked; it may still hold stale bytes and rejoin)
+    draws = sample_members(key, reach, idx.shape[0], OVERSAMPLE_FACTOR * k)
+    forb = replicas[idx]
+    banned = (
+        (draws[:, :, None] == forb[:, None, :]) & (forb >= 0)[:, None, :]
+    ).any(axis=2)
+    picks_full = first_k_distinct(jnp.where(banned, -1, draws), k)
+    picks = jnp.where(
+        jnp.arange(k)[None, :] < need[:, None], picks_full, -1
+    )
+    return RepairPlan(
+        idx=idx, valid=valid, source=source, need=need, picks=picks,
+        deficient=deficient.sum(), lost=lost,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def commit_repairs(
+    replicas: jax.Array,
+    idx: jax.Array,
+    valid: jax.Array,
+    picks: jax.Array,
+    alive: jax.Array,
+    k: int = REPLICATION_FACTOR,
+) -> jax.Array:
+    """Apply a :class:`RepairPlan` in-array: each chosen row becomes
+    survivors (compacted to the front) + the landed picks — exactly
+    ``commit_repair``'s survivors-plus-successful-copies rule."""
+    rows = replicas[idx]
+    working = _working(rows, alive)
+    order = jnp.argsort(~working, axis=1, stable=True)
+    compacted = jnp.take_along_axis(rows, order, axis=1)
+    w = working.sum(axis=1)
+    pos = jnp.arange(k)[None, :]
+    pick_idx = pos - w[:, None]
+    shifted = jnp.take_along_axis(picks, jnp.clip(pick_idx, 0, k - 1), 1)
+    newrow = jnp.where(
+        pos < w[:, None],
+        compacted,
+        jnp.where((pick_idx >= 0) & (shifted >= 0), shifted, -1),
+    )
+    newrow = jnp.where(valid[:, None], newrow, rows)
+    return replicas.at[idx].set(newrow)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def replication_stats(
+    replicas: jax.Array,
+    n_files: jax.Array,
+    alive: jax.Array,
+    reach: jax.Array,
+    k: int = REPLICATION_FACTOR,
+) -> jax.Array:
+    """[k + 3] summary vector: histogram of surviving-replica counts
+    (0..k live replicas — slot 0 is the lost-file count) plus acked-write
+    reachability: files whose reachable replicas meet the WRITE quorum,
+    and files meeting the READ quorum (``sdfs/quorum.py`` — the single
+    owner of both thresholds)."""
+    cap = replicas.shape[0]
+    used = jnp.arange(cap) < n_files
+    placed = used & (replicas >= 0).any(axis=1)
+    w = (_working(replicas, alive) & placed[:, None]).sum(axis=1)
+    hist = jnp.zeros((k + 1,), dtype=jnp.int32).at[
+        jnp.where(placed, w, k + 0)
+    ].add(placed.astype(jnp.int32), mode="drop")
+    r = (_working(replicas, reach) & placed[:, None]).sum(axis=1)
+    w_ok = (placed & (r >= write_quorum(k))).sum()
+    r_ok = (placed & (r >= read_quorum(k))).sum()
+    return jnp.concatenate([hist, w_ok[None], r_ok[None]])
+
+
+class ReplicaTable:
+    """Device-resident file->replica table: the 100k-member traffic lane.
+
+    The byte plane is out of scope here (BASELINE.md documents the honest
+    CPU-pinned boundary); what this models EXACTLY is the metadata
+    plane's placement and repair decisions against live membership masks
+    — the part that was per-file Python and is now O(1) array steps per
+    round at any N the masks support.
+    """
+
+    def __init__(self, capacity: int, n: int,
+                 k: int = REPLICATION_FACTOR, seed: int = 0):
+        self.capacity = capacity
+        self.n = n
+        self.k = k
+        self.replicas = jnp.full((capacity, k), -1, dtype=jnp.int32)
+        self.n_files = 0
+        self._key = jax.random.PRNGKey(seed)
+        self._ctr = 0
+
+    def _next_key(self) -> jax.Array:
+        self._ctr += 1
+        return jax.random.fold_in(self._key, self._ctr)
+
+    def place(self, alive: jax.Array, count: int,
+              method: str = "auto") -> jax.Array:
+        """Place ``count`` new files over ``alive``; returns their rows."""
+        if self.n_files + count > self.capacity:
+            raise ValueError("ReplicaTable capacity exceeded")
+        rows = place_batch(self._next_key(), alive, count, self.k,
+                           method=method)
+        self.replicas = jax.lax.dynamic_update_slice(
+            self.replicas, rows, (self.n_files, 0)
+        )
+        self.n_files += count
+        return rows
+
+    def plan_and_commit(self, alive: jax.Array, reach: jax.Array,
+                        budget: int) -> dict:
+        """One budgeted repair pass; commits landed picks in-array and
+        returns the pass's host-side counters."""
+        plan = plan_repairs_tensor(
+            self._next_key(), self.replicas, jnp.int32(self.n_files),
+            alive, reach, budget, self.k,
+        )
+        self.replicas = commit_repairs(
+            self.replicas, plan.idx, plan.valid, plan.picks, alive, self.k
+        )
+        executed = int(plan.valid.sum())
+        return {
+            "repairs_executed": executed,
+            "repairs_pending": max(int(plan.deficient) - executed, 0),
+            "copies_ordered": int((plan.picks >= 0).sum()),
+            "files_lost": int(plan.lost.sum()),
+        }
+
+    def stats(self, alive: jax.Array, reach: jax.Array) -> dict:
+        v = np.asarray(replication_stats(
+            self.replicas, jnp.int32(self.n_files), alive, reach, self.k
+        ))
+        return {
+            "files": self.n_files,
+            "replica_histogram": v[: self.k + 1].tolist(),
+            "write_quorum_reachable": int(v[self.k + 1]),
+            "read_quorum_reachable": int(v[self.k + 2]),
+        }

@@ -66,3 +66,12 @@ def pytest_configure(config):
         "tier-1; the deploy variant is additionally marked slow.  "
         "`pytest -m suspicion` runs just this subsystem.",
     )
+    config.addinivalue_line(
+        "markers",
+        "traffic: traffic-plane coverage (gossipfs_tpu/traffic/ — the "
+        "open-loop SDFS load generator, tensorized placement/repair "
+        "planning, and the durability harness).  Fast-lane cases ride "
+        "tier-1, including the small-N put/get/churn smoke asserting no "
+        "acked-write loss.  `pytest -m traffic` runs just this "
+        "subsystem.",
+    )

@@ -20,7 +20,11 @@ pins it in CI).
 
 Also ingests ``ROUNDPROF_*.jsonl`` profile artifacts (their round-9+
 header row names the schema): prints a per-config summary instead of a
-timeline.
+timeline.  Streams carrying traffic-plane rows (``replica_put`` /
+``client_op`` — bench/traffic_bench.py and bench/sdfs_ops.py ``--trace``
+artifacts) additionally get the event-replayed durability accounting
+(``traffic/audit.py``: no acked write lost, repair completion round) and
+a client-op latency rollup attached to the analysis.
 """
 
 from __future__ import annotations
@@ -189,6 +193,23 @@ def analyze(headers: list[dict], events: list[Event]) -> dict:
         )
     if confirm_fp:
         doc["confirm_false_positives"] = sum(confirm_fp.values())
+
+    # traffic-plane streams (traffic/harness.py --trace artifacts) carry
+    # replica_put/repair/delete rows: re-derive the durability facts from
+    # the events alone (traffic/audit.py — the same function the harness
+    # diffs itself against) plus the client_op latency rollup
+    if any(e.kind in ("replica_put", "client_op") for e in events):
+        from gossipfs_tpu.traffic.audit import durability_from_events
+        from gossipfs_tpu.traffic.workload import quantiles
+
+        doc["durability"] = durability_from_events(events)
+        ops = [e for e in events if e.kind == "client_op"]
+        if ops:
+            doc["client_ops"] = {
+                "issued": len(ops),
+                "acked": sum(bool(e.detail.get("ok")) for e in ops),
+                **quantiles([e.detail.get("ms", 0.0) for e in ops]),
+            }
     return doc
 
 

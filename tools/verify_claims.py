@@ -169,6 +169,29 @@ CLAIMS = {
         ["env", "JAX_PLATFORMS=cpu", sys.executable, "tools/timeline.py",
          "--selfcheck", "--n", "1024"],
         lambda d: 1.0 if d["ok"] else 0.0, 1.0, 0.0),
+    # traffic plane (TRAFFIC_r12.json is the committed artifact of the
+    # full-bench form of this command): writes race a timed partition
+    # that confines quorum reachability to the master's side; the claim
+    # requires (a) minority-starved mid-split puts actually REJECTED
+    # (the race's observable — never ack-then-lose), (b) ZERO acked
+    # writes lost across the heal under BOTH accountings — the harness's
+    # cluster-state ledger AND the event-replayed durability facts
+    # (traffic/audit.py, the same replay tools/timeline.py attaches to
+    # traffic streams) — and (c) the two accountings agreeing EXACTLY
+    # (acked writes, files, repairs, losses).  CPU-pinned.
+    "traffic_durability": (
+        ["env", "JAX_PLATFORMS=cpu", sys.executable, "-m",
+         "gossipfs_tpu.bench.traffic_bench", "--partition-race",
+         "--n", "64"],
+        lambda d: 1.0 if (
+            d["partition_race"]["durability"]["match"]
+            and d["partition_race"]["durability"]["harness"]["lost"] == 0
+            and d["partition_race"]["durability"]["events"]["lost"] == 0
+            and d["partition_race"]["durability"]["harness"]["files_acked"]
+            > 0
+            and d["partition_race"]["rejected_during_split"] > 0
+        ) else 0.0,
+        1.0, 0.0),
 }
 
 
