@@ -187,6 +187,14 @@ def main(argv=None) -> None:
                          "extra run after sampling (obs/profile.py); "
                          "open DIR in Perfetto/TensorBoard or reduce "
                          "with utils/profiling.op_breakdown")
+    ap.add_argument("--monitor", action="store_true",
+                    help="stream the measured run's decoded events "
+                         "through the online invariant monitor "
+                         "(obs/monitor.py) and stamp its verdict into "
+                         "the bench JSON (self-describing, like "
+                         "rr_rotate); exits nonzero on any violation — "
+                         "the headline number never ships over a run "
+                         "that broke a protocol invariant")
     ap.add_argument("--suspicion", action="store_true",
                     help="arm the SWIM lifecycle (t_fail=3, t_suspect=2 "
                          "— the SUSPECT_r08 fast knob) on the headline "
@@ -348,6 +356,20 @@ def main(argv=None) -> None:
     best = rates[-1]
     platform = jax.devices()[0].platform
 
+    monitor_doc = None
+    if args.monitor:
+        # decode the LAST sample's outputs (arrays a summarize-style
+        # reader transfers anyway — the timed program never saw the
+        # flag) and stream them through the invariant monitor
+        from gossipfs_tpu.obs.monitor import monitor_verdict
+        from gossipfs_tpu.obs.recorder import decode_scan
+
+        evs = decode_scan(pr, mc, n=n, alive=st.alive,
+                          suspicion=cfg.suspicion is not None)
+        monitor_doc = monitor_verdict(evs, n=n)
+        del monitor_doc["violations"]  # verdict + counts stay; evidence
+        # rides --trace artifacts, not the one-line headline doc
+
     trace_events = None
     if args.trace:
         # post-scan decode of the LAST sample's outputs — the recorder
@@ -395,12 +417,17 @@ def main(argv=None) -> None:
                 "unit": "rounds/s",
                 # reference heartbeat loop = 1 round/s of wall clock
                 "vs_baseline": round(median, 2),
+                **({"monitor": monitor_doc} if monitor_doc else {}),
                 **({"trace": args.trace, "trace_events": trace_events}
                    if args.trace else {}),
                 **({"xprof": args.xprof} if args.xprof else {}),
             }
         )
     )
+    if monitor_doc is not None and not monitor_doc["ok"]:
+        # --monitor asserts: a headline over a run that broke a protocol
+        # invariant is not a headline (verdict already stamped above)
+        sys.exit(1)
 
 
 if __name__ == "__main__":

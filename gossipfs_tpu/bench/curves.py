@@ -41,7 +41,7 @@ TRACK = 8
 def sweep(ns=DEFAULT_NS, rounds=ROUNDS, crash_rate=0.01, seed=0,
           topology="random", donate=False, hb_dtype="int16",
           time_rounds=False, arc_align=1, fanout=None,
-          trace=None) -> dict:
+          trace=None, monitor=False) -> dict:
     """``topology`` sweeps "random" (iid fanout) or "random_arc" (windowed
     arc senders) — the arc rows must match the iid rows within noise, which
     is the protocol-equivalence evidence for the fast arc merge kernel.
@@ -54,7 +54,9 @@ def sweep(ns=DEFAULT_NS, rounds=ROUNDS, crash_rate=0.01, seed=0,
     excluded).  ``trace`` writes each row's flight-recorder event stream
     (obs/schema.py JSONL; ``tools/timeline.py`` re-derives this row's
     TTD/FPR from it alone) — to ``trace`` itself for a single N, to
-    ``{trace}.n{N}`` per row otherwise."""
+    ``{trace}.n{N}`` per row otherwise.  ``monitor=True`` streams each
+    row's decoded events through the online invariant monitor
+    (obs/monitor.py) and stamps its verdict into the row."""
     import time as _time
 
     from gossipfs_tpu.core.rounds import run_rounds_donate
@@ -102,6 +104,18 @@ def sweep(ns=DEFAULT_NS, rounds=ROUNDS, crash_rate=0.01, seed=0,
                 suspicion=cfg.suspicion is not None,
                 topology=topology, fanout=cfg.fanout,
             )
+        monitor_doc = None
+        if monitor:
+            from gossipfs_tpu.obs.monitor import monitor_verdict
+            from gossipfs_tpu.obs.recorder import decode_scan
+
+            evs = decode_scan(per_round, carry, n=n,
+                              crash_rounds=crash_rounds,
+                              alive=final.alive,
+                              suspicion=cfg.suspicion is not None)
+            monitor_doc = monitor_verdict(evs, n=n)
+            del monitor_doc["violations"]  # counts in the row; evidence
+            # belongs to --trace artifacts
         rps = None
         if time_rounds:
             # free the measurement run's final state before allocating the
@@ -136,6 +150,7 @@ def sweep(ns=DEFAULT_NS, rounds=ROUNDS, crash_rate=0.01, seed=0,
                 "ttd_converged_max": max(ttd_c) if ttd_c else None,
                 "false_positive_rate": report.false_positive_rate,
                 **({"trace": trace_path} if trace_path else {}),
+                **({"monitor": monitor_doc} if monitor_doc else {}),
             }
         )
     return {
@@ -495,6 +510,11 @@ def main(argv=None) -> None:
                    help="write each row's flight-recorder event stream "
                         "(obs/ JSONL; analyze with tools/timeline.py) — "
                         "TTD/FPR sweep rows only")
+    p.add_argument("--monitor", action="store_true",
+                   help="stream each row's decoded events through the "
+                        "online invariant monitor (obs/monitor.py) and "
+                        "stamp its verdict into the row — TTD/FPR sweep "
+                        "rows only")
     p.add_argument("--merge-kernel", type=str, default="xla",
                    help="merge kernel for the --suspicion/--partition "
                         "rows (round 11: suspicion + scenarios run on "
@@ -524,7 +544,8 @@ def main(argv=None) -> None:
                                hb_dtype=args.hb_dtype,
                                time_rounds=args.time_rounds,
                                arc_align=args.arc_align,
-                               fanout=args.fanout, trace=args.trace))
+                               fanout=args.fanout, trace=args.trace,
+                               monitor=args.monitor))
     print(doc)
     if args.out:
         with open(args.out, "w") as f:

@@ -1,0 +1,38 @@
+"""Adversarial scenario campaigns: find the protocol's breaking points
+automatically.
+
+The ROADMAP's standing falsification item, industrialized: a campaign
+drives the tensor-sim engine through a FAMILY of fault scenarios
+(flapping duty cycles, loss rates, partition lengths, correlated-outage
+sizes — ``driver.FAMILIES``), uses the streaming invariant monitor
+(``obs/monitor.py``) as the per-run machine-checkable oracle, sweeps or
+BISECTS the severity axis to the exact knee where an invariant breaks,
+and commits each confirmed breaking point as a regression CASE file a
+tier-1 test replays deterministically (``driver.run_case``).
+
+``tools/campaign.py`` is the CLI; the ledger it writes is a
+``gossipfs-obs/v1`` stream (header + ``campaign_verdict`` rows) so
+``tools/timeline.py`` ingests it unchanged.
+"""
+
+from gossipfs_tpu.campaigns.driver import (
+    FAMILIES,
+    CampaignLedger,
+    bisect_axis,
+    make_scenario,
+    run_case,
+    run_scenario,
+    sweep_axis,
+    write_case,
+)
+
+__all__ = [
+    "FAMILIES",
+    "CampaignLedger",
+    "bisect_axis",
+    "make_scenario",
+    "run_case",
+    "run_scenario",
+    "sweep_axis",
+    "write_case",
+]

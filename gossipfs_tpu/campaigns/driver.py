@@ -1,0 +1,417 @@
+"""Campaign driver: scenario families x the streaming monitor oracle.
+
+One campaign RUN (:func:`run_scenario`) is: build the north-star
+gossip-only config at the requested (t_fail, t_suspect) knob, schedule
+``track`` deterministic crashes (the TTD/reconvergence probes), run the
+bulk tensor engine with the family's compiled fault scenario armed,
+decode the scan into ``gossipfs-obs/v1`` events (the PR-5 flight
+recorder — zero extra device work), and stream them through a
+:class:`~gossipfs_tpu.obs.monitor.StreamMonitor`.  The monitor's
+verdict IS the run's verdict: estimators + the invariant table, no
+hand-read artifacts.
+
+Determinism: runs take no random churn (``crash_rate=0``) — the only
+randomness is the per-round topology sampling and any Bernoulli loss
+rules, both keyed from the run seed — so a committed regression case
+replays bit-identically (the tier-1 smoke's contract).
+
+Severity axes are searched two ways: :func:`sweep_axis` (grid) and
+:func:`bisect_axis` (smallest violating value of a monotone axis — the
+breaking point).  Confirmed breaking points are committed as CASE files
+(:func:`write_case` / :func:`run_case`): scenario + config knobs +
+monitor params + the expected verdict, self-contained.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import pathlib
+
+from gossipfs_tpu.obs import schema
+from gossipfs_tpu.obs.monitor import MonitorParams, StreamMonitor
+from gossipfs_tpu.scenarios.schedule import (
+    CorrelatedOutage,
+    FaultScenario,
+    Flapping,
+    LinkFault,
+    Partition,
+)
+
+CASE_SCHEMA = "gossipfs-campaign-case/v1"
+
+# severity axis per family (the knob sweep/bisect walks), with the
+# family's fixed knob defaults.  ``frac`` knobs count as "1/frac of the
+# cohort"; node sets are drawn deterministically, skipping the tracked
+# crash victims and the introducer so the fault rules never overlap the
+# TTD probes.
+FAMILIES: dict[str, dict] = {
+    "flap": {
+        "axis": "down",
+        "knobs": {"down": 4, "up": 2, "frac": 16, "start": 2},
+        "doc": "flapping senders: `down` dark rounds per `up`+`down` "
+               "cycle on 1/frac of the cohort — the Lifeguard gray "
+               "failure; the breaking point is the dark span that "
+               "outlives the (t_fail [+ t_suspect]) window",
+    },
+    "loss": {
+        "axis": "rate_pct",
+        "knobs": {"rate_pct": 50, "frac": 16, "start": 2},
+        "doc": "Bernoulli loss on 1/frac of senders' outgoing links at "
+               "rate_pct/100 — asymmetric lossy NICs",
+    },
+    "partition": {
+        "axis": "split_len",
+        "knobs": {"split_len": 12, "start": 5},
+        "doc": "half/half netsplit held for split_len rounds, then "
+               "healed — the split-brain / reconvergence probe",
+    },
+    "outage": {
+        "axis": "size",
+        "knobs": {"size": 8, "length": 10, "start": 5},
+        "doc": "correlated rack blackout: `size` nodes lose ALL "
+               "transport for `length` rounds, then resurface with "
+               "frozen views",
+    },
+}
+
+
+def campaign_config(n: int, t_fail: int = 5, t_suspect: int = 0):
+    """The campaign protocol profile: gossip-only random log-fanout on
+    the XLA merge (the CPU-feasible oracle form — an on-TPU campaign
+    passes its own kernel knobs through ``run_scenario(config=...)``)."""
+    from gossipfs_tpu.config import SimConfig
+
+    cfg = SimConfig(
+        n=n, topology="random", fanout=SimConfig.log_fanout(n),
+        remove_broadcast=False, fresh_cooldown=True, t_fail=t_fail,
+        t_cooldown=max(12, t_fail + 4), merge_kernel="xla",
+    )
+    if t_suspect > 0:
+        from gossipfs_tpu.suspicion import SuspicionParams
+
+        cfg = dataclasses.replace(
+            cfg, suspicion=SuspicionParams(t_suspect=t_suspect))
+    return cfg
+
+
+def _pick_nodes(n: int, count: int, avoid: set[int]) -> tuple[int, ...]:
+    """First ``count`` ids outside ``avoid`` — deterministic, disjoint
+    from the tracked crash victims."""
+    out = []
+    for x in range(n):
+        if x not in avoid:
+            out.append(x)
+            if len(out) == count:
+                break
+    return tuple(out)
+
+
+def make_scenario(family: str, n: int, fault_rounds: int,
+                  avoid: set[int] | None = None, **knobs) -> FaultScenario:
+    """Build one family scenario at a severity point (see FAMILIES)."""
+    if family not in FAMILIES:
+        raise ValueError(f"unknown family {family!r}; pick from "
+                         f"{sorted(FAMILIES)}")
+    kn = dict(FAMILIES[family]["knobs"])
+    unknown = set(knobs) - set(kn)
+    if unknown:
+        raise ValueError(f"unknown {family} knobs {sorted(unknown)}; "
+                         f"family takes {sorted(kn)}")
+    kn.update(knobs)
+    avoid = set(avoid or ())
+    start = int(kn["start"])
+    name = f"{family}-" + ",".join(
+        f"{k}={kn[k]}" for k in sorted(kn) if k != "start")
+    if family == "flap":
+        nodes = _pick_nodes(n, max(n // int(kn["frac"]), 1), avoid)
+        return FaultScenario(
+            name=name, n=n,
+            flapping=(Flapping(start=start, end=start + fault_rounds,
+                               up=int(kn["up"]), down=int(kn["down"]),
+                               nodes=nodes),))
+    if family == "loss":
+        nodes = _pick_nodes(n, max(n // int(kn["frac"]), 1), avoid)
+        return FaultScenario(
+            name=name, n=n,
+            link_faults=(LinkFault(start=start, end=start + fault_rounds,
+                                   rate=int(kn["rate_pct"]) / 100.0,
+                                   src=nodes, dst=tuple(range(n))),))
+    if family == "partition":
+        return FaultScenario(
+            name=name, n=n,
+            partitions=(Partition(start=start,
+                                  end=start + int(kn["split_len"]),
+                                  groups=(tuple(range(n // 2)),)),))
+    # outage
+    nodes = _pick_nodes(n, int(kn["size"]), avoid)
+    return FaultScenario(
+        name=name, n=n,
+        outages=(CorrelatedOutage(start=start,
+                                  end=start + int(kn["length"]),
+                                  nodes=nodes),))
+
+
+def default_monitor_params(cfg, horizon: int) -> MonitorParams:
+    """The campaign invariant knobs: FPR-storm threshold 1e-4 (healthy
+    regimes measure ~4e-7, raw-t3 storms ~4e-3 — SUSPECT_r08), and the
+    reconvergence bound t_fail + gossip diameter + slack clocked from
+    the scenario horizon (faults legitimately delay convergence while
+    armed)."""
+    diameter = math.ceil(math.log(max(cfg.n, 2))
+                         / math.log(cfg.fanout + 1))
+    return MonitorParams(
+        fpr_threshold=1e-4,
+        fpr_window=10,
+        reconverge_bound=cfg.t_fail + diameter + 4,
+        clock_floor=horizon,
+        expect_suspicion=cfg.suspicion is not None,
+    )
+
+
+def run_scenario(n: int, scenario: FaultScenario, *, t_fail: int = 5,
+                 t_suspect: int = 0, rounds: int | None = None,
+                 seed: int = 0, track: int = 4, crash_at: int = 10,
+                 params: MonitorParams | None = None,
+                 config=None) -> dict:
+    """One campaign run: bulk engine + decode + streaming monitor.
+
+    Returns the ledger row: verdict, monitor estimators, the violation
+    list, and the violating event window (all decoded events within 2
+    rounds of the first violation — the evidence a human reads)."""
+    import jax
+
+    from gossipfs_tpu.bench.run import tracked_crash_events
+    from gossipfs_tpu.core.rounds import run_rounds
+    from gossipfs_tpu.core.state import init_state
+    from gossipfs_tpu.obs.recorder import decode_scan
+    from gossipfs_tpu.scenarios.tensor import compile_tensor
+
+    cfg = config if config is not None else campaign_config(
+        n, t_fail=t_fail, t_suspect=t_suspect)
+    if params is None:
+        params = default_monitor_params(cfg, scenario.horizon)
+    if rounds is None:
+        # past the last fault window + the reconvergence deadline
+        bound = params.reconverge_bound or (cfg.t_fail + 6)
+        rounds = scenario.horizon + bound + 8
+    events, crash_rounds, churn_ok = tracked_crash_events(
+        cfg, rounds, track, crash_at)
+    final, carry, per_round = run_rounds(
+        init_state(cfg), cfg, rounds, jax.random.PRNGKey(seed),
+        events=events, crash_only_events=True,
+        scenario=compile_tensor(scenario),
+    )
+    jax.block_until_ready(carry)
+    evs = decode_scan(
+        per_round, carry, n=cfg.n, crash_rounds=crash_rounds,
+        alive=final.alive, suspicion=cfg.suspicion is not None,
+    )
+    mon = StreamMonitor(params=params, n=cfg.n)
+    mon.observe_header(schema.header(
+        "campaign", n=cfg.n,
+        crash_rounds={str(k): v for k, v in crash_rounds.items()}))
+    mon.feed(evs)
+    mon.finish()
+    s = mon.summary()
+    window: list[dict] = []
+    if mon.violations:
+        w = mon.violations[0].round
+        window = [e.to_record() for e in evs
+                  if abs(e.round - w) <= 2][:48]
+    return {
+        "n": cfg.n,
+        "t_fail": cfg.t_fail,
+        "t_suspect": (cfg.suspicion.t_suspect if cfg.suspicion else 0),
+        "rounds": rounds,
+        "seed": seed,
+        "scenario": scenario.name,
+        "horizon": scenario.horizon,
+        "monitor_params": dataclasses.asdict(params),
+        "verdict": "violated" if mon.violations else "pass",
+        "monitor": mon.verdict(),
+        "estimators": {
+            "false_positive_rate": s["false_positive_rate"],
+            "worst_window_fpr": s["worst_window_fpr"],
+            "ttd_first_median": s["ttd_first_median"],
+            "detected": s["detected"],
+            "tracked_crashes": s["tracked_crashes"],
+            "storm_rounds": s["storm_rounds"],
+            "split_brain_rounds": s["split_brain_rounds"],
+            **({"fp_suppressed": s["fp_suppressed"],
+                "refutations": s["refutations"]} if s["suspicion"] else {}),
+        },
+        "violations": s["violations"],
+        "violation_window": window,
+    }
+
+
+# ---------------------------------------------------------------------------
+# the campaign ledger — a gossipfs-obs/v1 stream timeline.py ingests
+# ---------------------------------------------------------------------------
+
+
+class CampaignLedger:
+    """JSONL ledger: the obs header row, then one ``campaign_verdict``
+    event per run (detail = the ledger row).  ``tools/timeline.py``
+    loads it like any other stream; the verdict rows ride ``detail``."""
+
+    def __init__(self, path, family: str, n: int, axis: str, **meta):
+        self.path = pathlib.Path(path)
+        self.rows: list[dict] = []
+        self._fh = open(self.path, "w", encoding="utf-8")
+        self._fh.write(schema.dumps(schema.header(
+            "campaign", n=n, family=family, axis=axis, **meta)) + "\n")
+
+    def add(self, axis_value, row: dict) -> None:
+        self.rows.append(row)
+        ev = schema.Event(
+            round=len(self.rows) - 1, observer=-1, subject=-1,
+            kind="campaign_verdict",
+            detail={"axis_value": axis_value, **row})
+        self._fh.write(schema.dumps(ev.to_record()) + "\n")
+        self._fh.flush()
+
+    def close(self) -> None:
+        self._fh.close()
+
+
+def sweep_axis(family: str, n: int, values, *, fault_rounds: int = 24,
+               t_fail: int = 5, t_suspect: int = 0, seed: int = 0,
+               track: int = 4, ledger: CampaignLedger | None = None,
+               **fixed_knobs) -> dict:
+    """Grid-sweep the family's severity axis; returns rows + the
+    breaking points (axis values whose run violated an invariant)."""
+    axis = _axis_checked(family, fixed_knobs)
+    rows = []
+    for v in values:
+        sc, row = _run_point(family, n, axis, v, fault_rounds, t_fail,
+                             t_suspect, seed, track, fixed_knobs)
+        rows.append(row)
+        if ledger is not None:
+            ledger.add(v, row)
+    return {
+        "family": family, "axis": axis, "n": n,
+        "t_fail": t_fail, "t_suspect": t_suspect,
+        "rows": rows,
+        "breaking": [r["axis_value"] for r in rows
+                     if r["verdict"] == "violated"],
+    }
+
+
+def bisect_axis(family: str, n: int, lo: int, hi: int, *,
+                fault_rounds: int = 24, t_fail: int = 5,
+                t_suspect: int = 0, seed: int = 0, track: int = 4,
+                ledger: CampaignLedger | None = None,
+                **fixed_knobs) -> dict:
+    """Smallest axis value in [lo, hi] whose run violates an invariant
+    (the axis must be severity-monotone — every family's is).  Probes
+    the endpoints first: if ``lo`` already violates the breaking point
+    is <= lo; if ``hi`` passes there is none in range."""
+    axis = _axis_checked(family, fixed_knobs)
+    evals: dict[int, dict] = {}
+
+    def probe(v: int) -> dict:
+        if v not in evals:
+            _, row = _run_point(family, n, axis, v, fault_rounds, t_fail,
+                                t_suspect, seed, track, fixed_knobs)
+            evals[v] = row
+            if ledger is not None:
+                ledger.add(v, row)
+        return evals[v]
+
+    out = {"family": family, "axis": axis, "n": n, "lo": lo, "hi": hi,
+           "t_fail": t_fail, "t_suspect": t_suspect}
+    if probe(hi)["verdict"] != "violated":
+        return {**out, "breaking_point": None, "evals": len(evals),
+                "rows": [evals[v] for v in sorted(evals)]}
+    if probe(lo)["verdict"] == "violated":
+        return {**out, "breaking_point": lo, "evals": len(evals),
+                "rows": [evals[v] for v in sorted(evals)]}
+    # invariant: lo passes, hi violates
+    while hi - lo > 1:
+        mid = (lo + hi) // 2
+        if probe(mid)["verdict"] == "violated":
+            hi = mid
+        else:
+            lo = mid
+    return {**out, "breaking_point": hi, "evals": len(evals),
+            "rows": [evals[v] for v in sorted(evals)]}
+
+
+def _axis_checked(family: str, fixed_knobs: dict) -> str:
+    """The family's severity axis, rejecting a fixed-knob collision
+    up front (before any run or ledger row) instead of letting the
+    duplicate-kwarg TypeError surface mid-campaign."""
+    if family not in FAMILIES:
+        raise ValueError(f"unknown family {family!r}; pick from "
+                         f"{sorted(FAMILIES)}")
+    axis = FAMILIES[family]["axis"]
+    if axis in fixed_knobs:
+        raise ValueError(
+            f"{axis!r} is the {family} family's swept severity axis — "
+            "give it via the sweep values / bisect range, not as a "
+            "fixed knob")
+    return axis
+
+
+def _run_point(family, n, axis, value, fault_rounds, t_fail, t_suspect,
+               seed, track, fixed_knobs):
+    from gossipfs_tpu.bench.run import tracked_crash_events
+
+    cfg = campaign_config(n, t_fail=t_fail, t_suspect=t_suspect)
+    # victims are a pure function of (cfg, track) — compute them first so
+    # the family's fault nodes can avoid the TTD probes
+    _, crash_rounds, _ = tracked_crash_events(cfg, fault_rounds + 1,
+                                              track, 10)
+    sc = make_scenario(family, n, fault_rounds,
+                       avoid=set(crash_rounds) | {cfg.introducer},
+                       **{axis: value}, **fixed_knobs)
+    row = run_scenario(n, sc, t_fail=t_fail, t_suspect=t_suspect,
+                       seed=seed, track=track)
+    return sc, {"axis_value": value, **row}
+
+
+# ---------------------------------------------------------------------------
+# regression case files — committed breaking points, replayed by tier-1
+# ---------------------------------------------------------------------------
+
+
+def write_case(path, scenario: FaultScenario, *, t_fail: int,
+               t_suspect: int, seed: int, track: int,
+               params: MonitorParams, expect: dict, **meta) -> None:
+    """Commit one confirmed breaking point as a self-contained case:
+    the scenario, the exact run knobs, the monitor params, and the
+    verdict a replay must reproduce."""
+    doc = {
+        "schema": CASE_SCHEMA,
+        "scenario": json.loads(scenario.to_json()),
+        "config": {"n": scenario.n, "t_fail": t_fail,
+                   "t_suspect": t_suspect, "seed": seed, "track": track},
+        "monitor": dataclasses.asdict(params),
+        "expect": expect,
+        **meta,
+    }
+    pathlib.Path(path).write_text(json.dumps(doc, indent=2) + "\n")
+
+
+def run_case(path) -> dict:
+    """Replay a committed regression case; ``reproduced`` is the tier-1
+    assertion: the verdict matches and (for violations) every expected
+    invariant fired."""
+    doc = json.loads(pathlib.Path(path).read_text())
+    if doc.get("schema") != CASE_SCHEMA:
+        raise ValueError(f"{path}: not a {CASE_SCHEMA} case file")
+    sc = FaultScenario.from_json(json.dumps(doc["scenario"]))
+    c = doc["config"]
+    row = run_scenario(
+        c["n"], sc, t_fail=c["t_fail"], t_suspect=c["t_suspect"],
+        seed=c["seed"], track=c["track"],
+        params=MonitorParams.from_dict(doc["monitor"]),
+    )
+    expect = doc["expect"]
+    ok = row["verdict"] == expect["verdict"]
+    for inv in expect.get("invariants", []):
+        ok = ok and inv in row["monitor"]["by_invariant"]
+    return {"reproduced": bool(ok), "expect": expect, "row": row}

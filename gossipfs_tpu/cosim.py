@@ -198,7 +198,11 @@ class CoSim:
         alive = set(self.detector.alive_nodes())
         if self.scenario is None:
             return alive
-        pid = self.scenario.pid_at(self.round - self._scn_round0)
+        rel = self.round - self._scn_round0
+        # outage-group members and dark-phase flappers answer no RPC/scp
+        # at all (round-13 gray-failure rules)
+        alive -= self.scenario.unreachable_at(rel)
+        pid = self.scenario.pid_at(rel)
         if pid is None:
             return alive
         side = pid[self.cluster.master_node]
@@ -370,9 +374,17 @@ class CoSim:
         pending = len(self.cluster.master.plan_repairs(
             self.cluster.live, reachable=self.cluster.reachable
         ))
-        return {
+        doc = {
             "ops_issued": self.ops_issued,
             "ops_acked": self.ops_acked,
             "repairs_pending": pending,
             "repairs_done": self.repairs_done,
         }
+        mon = getattr(self._recorder, "monitor", None)
+        if mon is not None:
+            # online health plane (obs/monitor.py): the live invariant
+            # verdict rides the traffic/metrics surfaces.  Without an
+            # attached monitor the field is ABSENT — consumers render
+            # n/a, never a fabricated clean 0 (the round-8 rule)
+            doc["invariant_violations"] = len(mon.violations)
+        return doc

@@ -7,6 +7,11 @@
   post-scan decoder over the tensor sim's existing outputs (no new
   device work), the ``UdpNode`` seam hook, and the deploy daemons'
   structured JSONL logs;
+* ``obs/monitor.py`` — the ONLINE health plane: a streaming invariant
+  monitor (incremental TTD/FPR/durability estimators + the declarative
+  invariant table) that rides any ``attach_recorder`` surface via
+  ``MonitorRecorder`` and must agree with ``tools/timeline.py``'s
+  post-hoc derivation exactly (the ``monitor_parity`` claim);
 * ``obs/profile.py`` — the opt-in ``jax.profiler`` trace hook around
   the scan.
 
@@ -30,7 +35,10 @@ from gossipfs_tpu.obs.schema import (
     render_vitals,
 )
 
-_RECORDER_EXPORTS = ("FlightRecorder", "decode_scan", "write_trace")
+_RECORDER_EXPORTS = ("FlightRecorder", "decode_scan", "load_stream",
+                     "write_trace")
+_MONITOR_EXPORTS = ("INVARIANTS", "MonitorParams", "MonitorRecorder",
+                    "StreamMonitor", "estimator_parity", "monitor_verdict")
 
 __all__ = [
     "EVENT_KINDS",
@@ -39,6 +47,7 @@ __all__ = [
     "Event",
     "render_vitals",
     *_RECORDER_EXPORTS,
+    *_MONITOR_EXPORTS,
 ]
 
 
@@ -47,4 +56,8 @@ def __getattr__(name: str):
         from gossipfs_tpu.obs import recorder
 
         return getattr(recorder, name)
+    if name in _MONITOR_EXPORTS:
+        from gossipfs_tpu.obs import monitor
+
+        return getattr(monitor, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

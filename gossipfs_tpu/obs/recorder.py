@@ -25,12 +25,43 @@ jax-free path) use it too.
 
 from __future__ import annotations
 
+import json
 import pathlib
 
 import numpy as np
 
 from gossipfs_tpu.obs import schema
 from gossipfs_tpu.obs.schema import Event
+
+
+def load_stream(path) -> tuple[dict | None, list[Event]]:
+    """One JSONL stream -> (header row or None, schema events).
+
+    THE one reader of the ``gossipfs-obs/v1`` line format — the
+    post-hoc analyzer (``tools/timeline.py``) and the streaming monitor
+    (``obs/monitor.py feed_jsonl``) both parse through here, so the two
+    derivations the ``monitor_parity`` oracle compares can never read a
+    stream differently.  Tolerates deploy node logs (no header;
+    ``node`` names the observer) and skips rows carrying no schema kind
+    (free-text legacy lines, campaign-ledger metadata).
+    """
+    header = None
+    events: list[Event] = []
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # free-text line in a legacy log
+            if i == 0 and schema.is_header(rec):
+                header = rec
+                continue
+            if rec.get("kind") in schema.EVENT_KINDS:
+                events.append(Event.from_record(rec))
+    return header, events
 
 
 class FlightRecorder:

@@ -89,6 +89,18 @@ EVENT_KINDS: dict[str, str] = {
     "client_op": "one SDFS client operation completed (detail.op / file / "
                  "bytes / ms / ok) — the open-loop load generator's and "
                  "bench/sdfs_ops.py's per-op latency row",
+    # -- online health plane (obs/monitor.py, campaigns/)
+    "invariant_violation": "the streaming monitor caught a protocol "
+                           "invariant breaking (detail.invariant names "
+                           "the row of obs.monitor.INVARIANTS; the "
+                           "violating evidence rides detail) — emitted "
+                           "INTO the stream so timeline.py and the "
+                           "recorder lint maps stay the single source "
+                           "of truth",
+    "campaign_verdict": "one campaign run's machine-checked verdict "
+                        "(tools/campaign.py ledger row: detail carries "
+                        "the scenario point, the monitor estimators and "
+                        "the violation list)",
     # -- operational
     "node_start": "a deploy node process came up",
 }
@@ -170,6 +182,11 @@ VITALS_FIELDS = (
     "refutations",
     "confirms",
     "fp_suppressed",    # sim-only: refutations of actually-alive subjects
+    # -- online health plane (obs/monitor.py): live invariant verdicts.
+    # Present only when a StreamMonitor is attached AND the engine can
+    # evaluate the invariants (deploy has no ground truth, so its rows
+    # omit the field and render n/a — never a fabricated clean 0)
+    "invariant_violations",
     # -- traffic plane (traffic/; the CLI `traffic status` verb's set) —
     # engines without an SDFS data plane (udp, deploy today) simply omit
     # them and render n/a, per the round-8 absent-not-zero rule

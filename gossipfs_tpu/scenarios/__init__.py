@@ -16,7 +16,9 @@ scenario arms.
 
 from gossipfs_tpu.scenarios.runtime import ScenarioRuntime
 from gossipfs_tpu.scenarios.schedule import (
+    CorrelatedOutage,
     FaultScenario,
+    Flapping,
     LinkFault,
     Partition,
     SlowNode,
@@ -33,7 +35,9 @@ _TENSOR_EXPORTS = (
 )
 
 __all__ = [
+    "CorrelatedOutage",
     "FaultScenario",
+    "Flapping",
     "LinkFault",
     "Partition",
     "ScenarioRuntime",

@@ -44,6 +44,12 @@ class ScenarioRuntime:
             (s.start, s.end, s.stride, frozenset(s.nodes))
             for s in sc.slow_nodes
         ]
+        self._flaps = [
+            (f, frozenset(f.nodes)) for f in sc.flapping
+        ]
+        self._outs = [
+            (o.start, o.end, frozenset(o.nodes)) for o in sc.outages
+        ]
 
     def drops(self, src: int, dst: int, rnd: int) -> bool:
         """Whether the src -> dst message at round ``rnd`` is dropped."""
@@ -52,6 +58,16 @@ class ScenarioRuntime:
                 return True
         for start, end, stride, nodes in self._slows:
             if start <= rnd < end and src in nodes and rnd % stride != 0:
+                return True
+        for rule, nodes in self._flaps:
+            # dark-phase flappers: every outgoing datagram drops (the
+            # node keeps ticking — gray failure, not crash)
+            if src in nodes and rule.down_at(rnd):
+                return True
+        for start, end, nodes in self._outs:
+            # correlated blackout: the group talks to NO ONE, itself
+            # included (the shared switch died)
+            if start <= rnd < end and (src in nodes or dst in nodes):
                 return True
         for start, end, rate, src_set, dst_set in self._losses:
             if (start <= rnd < end and src in src_set and dst in dst_set

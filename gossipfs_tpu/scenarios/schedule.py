@@ -25,7 +25,17 @@ that engine) is dropped iff any active rule says so —
     in one direction only models an asymmetric link);
   * :class:`SlowNode`   — active, src is slow, and the round is not a
     multiple of ``stride``: the node's messages only get out every
-    ``stride``-th round (it lags, synchronous-round style).
+    ``stride``-th round (it lags, synchronous-round style);
+  * :class:`Flapping`   — active, src flaps, and the duty cycle is in
+    its dark phase: the node's outgoing datagrams all drop for ``down``
+    consecutive rounds out of every ``up + down`` (Lifeguard's gray
+    failure — the node looks dead long enough to be suspected, then
+    comes back and looks like a false positive);
+  * :class:`CorrelatedOutage` — active and src OR dst sits in the
+    group: a rack/zone-sized blackout (the top-of-rack switch died —
+    members cannot even reach each other), the correlated-failure
+    class that makes per-node-independent repair placement lose whole
+    replica sets at once.
 
 Faults affect TRANSPORT only — nodes keep ticking, bumping their own
 heartbeats and detecting; what changes is which datagrams arrive.  Heal
@@ -120,6 +130,46 @@ class SlowNode:
 
 
 @dataclasses.dataclass(frozen=True)
+class Flapping:
+    """Flapping senders: over [start, end) the nodes cycle ``up`` rounds
+    healthy then ``down`` rounds DARK (every outgoing datagram drops),
+    repeating.  The node itself keeps ticking — bumping its own
+    heartbeat, detecting — so each recovery re-announces a counter that
+    advanced through the dark phase: the gray-failure shape that storms
+    a raw short t_fail with false positives and that SWIM suspicion
+    exists to absorb (a ``down`` within the suspect window refutes; a
+    ``down`` past it confirms a live node FAILED).
+    """
+
+    start: int
+    end: int
+    up: int
+    down: int
+    nodes: tuple[int, ...]
+
+    def down_at(self, rnd: int) -> bool:
+        """Whether the rule's nodes are in the dark phase at ``rnd``."""
+        if not self.start <= rnd < self.end:
+            return False
+        return (rnd - self.start) % (self.up + self.down) >= self.up
+
+
+@dataclasses.dataclass(frozen=True)
+class CorrelatedOutage:
+    """Correlated-failure group: over [start, end) every message with
+    src OR dst in the group drops — a rack/zone blackout (the shared
+    switch died; group members cannot even reach each other).  Unlike a
+    :class:`Partition` group (which keeps internal connectivity) the
+    whole group goes dark at once, and unlike crash events the nodes
+    keep running: at ``end`` they resurface with views frozen at the
+    outage start."""
+
+    start: int
+    end: int
+    nodes: tuple[int, ...]
+
+
+@dataclasses.dataclass(frozen=True)
 class FaultScenario:
     """One declarative fault schedule (see module docstring).
 
@@ -134,6 +184,10 @@ class FaultScenario:
     link_faults: tuple[LinkFault, ...] = ()
     slow_nodes: tuple[SlowNode, ...] = ()
     seed: int = 0  # Bernoulli-loss stream id (each engine derives its own)
+    # round-13 gray-failure primitives (after ``seed`` so positional
+    # construction of the round-7 fields stays valid)
+    flapping: tuple[Flapping, ...] = ()
+    outages: tuple[CorrelatedOutage, ...] = ()
 
     def __post_init__(self) -> None:
         if self.n < 2:
@@ -164,6 +218,22 @@ class FaultScenario:
                 raise ValueError(f"slow stride must be >= 2, got {s.stride}")
             for x in s.nodes:
                 self._check_node(x)
+        for fl in self.flapping:
+            self._check_window(fl.start, fl.end, "flapping")
+            if fl.up < 1 or fl.down < 1:
+                raise ValueError(
+                    f"flapping needs up >= 1 and down >= 1, got "
+                    f"up={fl.up} down={fl.down}")
+            if not fl.nodes:
+                raise ValueError("empty flapping node set")
+            for x in fl.nodes:
+                self._check_node(x)
+        for o in self.outages:
+            self._check_window(o.start, o.end, "outage")
+            if not o.nodes:
+                raise ValueError("empty outage group")
+            for x in o.nodes:
+                self._check_node(x)
 
     def _check_window(self, start: int, end: int, kind: str) -> None:
         if start < 0 or end <= start:
@@ -175,19 +245,32 @@ class FaultScenario:
             raise ValueError(f"node id {x} out of range [0, {self.n})")
 
     # -- queries ------------------------------------------------------------
+    def _rules(self):
+        return (*self.partitions, *self.link_faults, *self.slow_nodes,
+                *self.flapping, *self.outages)
+
     @property
     def horizon(self) -> int:
         """First round past every rule window (all links healthy after)."""
-        ends = [r.end for r in (*self.partitions, *self.link_faults,
-                                *self.slow_nodes)]
-        return max(ends, default=0)
+        return max((r.end for r in self._rules()), default=0)
 
     def active_at(self, rnd: int) -> bool:
         """Any rule active at (armed-relative) round ``rnd``."""
-        return any(
-            r.start <= rnd < r.end
-            for r in (*self.partitions, *self.link_faults, *self.slow_nodes)
-        )
+        return any(r.start <= rnd < r.end for r in self._rules())
+
+    def unreachable_at(self, rnd: int) -> set[int]:
+        """Nodes no datagram can LEAVE at round ``rnd`` — outage-group
+        members and flapping nodes in their dark phase.  The control
+        plane's reachability model (cosim._reachable) subtracts these:
+        an scp to a blacked-out rack fails like one to a dead VM."""
+        out: set[int] = set()
+        for o in self.outages:
+            if o.start <= rnd < o.end:
+                out |= set(o.nodes)
+        for fl in self.flapping:
+            if fl.down_at(rnd):
+                out |= set(fl.nodes)
+        return out
 
     def pid_at(self, rnd: int) -> np.ndarray | None:
         """Combined int32 [N] partition id at round ``rnd``, None if no
@@ -232,6 +315,15 @@ class FaultScenario:
             if s.start <= rnd < s.end:
                 out.append(f"slow[{s.start},{s.end}) stride={s.stride} "
                            f"nodes={len(s.nodes)}")
+        for fl in self.flapping:
+            if fl.start <= rnd < fl.end:
+                out.append(f"flap[{fl.start},{fl.end}) up={fl.up} "
+                           f"down={fl.down} nodes={len(fl.nodes)}"
+                           f"{' DARK' if fl.down_at(rnd) else ''}")
+        for o in self.outages:
+            if o.start <= rnd < o.end:
+                out.append(f"outage[{o.start},{o.end}) "
+                           f"nodes={len(o.nodes)}")
         return out
 
     # -- JSON codec ---------------------------------------------------------
@@ -262,6 +354,15 @@ class FaultScenario:
                 {"start": s.start, "end": s.end, "stride": s.stride,
                  "nodes": sel(s.nodes)}
                 for s in self.slow_nodes
+            ],
+            "flapping": [
+                {"start": f.start, "end": f.end, "up": f.up,
+                 "down": f.down, "nodes": sel(f.nodes)}
+                for f in self.flapping
+            ],
+            "outages": [
+                {"start": o.start, "end": o.end, "nodes": sel(o.nodes)}
+                for o in self.outages
             ],
         }
         return json.dumps(doc, indent=2)
@@ -297,6 +398,21 @@ class FaultScenario:
                     nodes=expand_selector(s["nodes"], n),
                 )
                 for s in doc.get("slow_nodes", [])
+            ),
+            flapping=tuple(
+                Flapping(
+                    start=int(f["start"]), end=int(f["end"]),
+                    up=int(f["up"]), down=int(f["down"]),
+                    nodes=expand_selector(f["nodes"], n),
+                )
+                for f in doc.get("flapping", [])
+            ),
+            outages=tuple(
+                CorrelatedOutage(
+                    start=int(o["start"]), end=int(o["end"]),
+                    nodes=expand_selector(o["nodes"], n),
+                )
+                for o in doc.get("outages", [])
             ),
         )
 

@@ -22,14 +22,16 @@ merge_kernel notes):
     gather — a self-edge gathers the receiver's own view row, which the
     strict advance compare rejects, so the fast kernels needed no new
     merge semantics;
-  * ``random_arc`` with ``arc_align > 1``: partitions and slow senders
-    compose at GROUP granularity (an aligned arc is F/align whole
-    groups, so align-group-closed partition sides give exactly per-edge
-    semantics — :func:`arc_match_edges` builds the per-receiver group
-    match masks, :func:`sends_mask` the slow-sender mute).  Bernoulli
-    loss draws are irreducibly per-edge and stay a ``random``-topology
-    (or ring) capability — :func:`require_scenario_config` enforces the
-    matrix per scenario;
+  * ``random_arc`` with ``arc_align > 1``: partitions and the
+    sender-global rules (slow senders, round-13 flapping) compose at
+    GROUP granularity (an aligned arc is F/align whole groups, so
+    align-group-closed partition sides give exactly per-edge semantics
+    — :func:`arc_match_edges` builds the per-receiver group match
+    masks, :func:`sends_mask` the slow/flap sender mute).  Bernoulli
+    loss draws are irreducibly per-edge, and correlated outages mute
+    receivers too — both stay a ``random``-topology (or ring)
+    capability — :func:`require_scenario_config` enforces the matrix
+    per scenario;
   * ``remove_broadcast`` must be off: the broadcast is modeled as an
     instantaneous tensor column-OR, not as transport messages, so a
     partition could not filter it — gossip-only dissemination is the
@@ -73,6 +75,14 @@ class TensorScenario(NamedTuple):
     slow_end: jax.Array    # int32 [S]
     slow_stride: jax.Array # int32 [S]
     slow_nodes: jax.Array  # bool [S, N]
+    flap_start: jax.Array  # int32 [K]
+    flap_end: jax.Array    # int32 [K]
+    flap_up: jax.Array     # int32 [K]
+    flap_period: jax.Array # int32 [K]  (up + down)
+    flap_nodes: jax.Array  # bool [K, N]
+    out_start: jax.Array   # int32 [O]
+    out_end: jax.Array     # int32 [O]
+    out_nodes: jax.Array   # bool [O, N]
 
 
 def compile_tensor(scenario: FaultScenario, round0: int = 0) -> TensorScenario:
@@ -87,6 +97,8 @@ def compile_tensor(scenario: FaultScenario, round0: int = 0) -> TensorScenario:
     parts = scenario.partitions
     losses = scenario.link_faults
     slows = scenario.slow_nodes
+    flaps = scenario.flapping
+    outs = scenario.outages
     return TensorScenario(
         round0=jnp.int32(round0),
         part_start=jnp.asarray([p.start for p in parts], jnp.int32),
@@ -113,6 +125,29 @@ def compile_tensor(scenario: FaultScenario, round0: int = 0) -> TensorScenario:
             np.stack([mask(s.nodes) for s in slows], axis=0)
             if slows else np.zeros((0, n), bool)
         ),
+        flap_start=jnp.asarray([f.start for f in flaps], jnp.int32),
+        flap_end=jnp.asarray([f.end for f in flaps], jnp.int32),
+        flap_up=jnp.asarray([f.up for f in flaps], jnp.int32),
+        flap_period=jnp.asarray([f.up + f.down for f in flaps], jnp.int32),
+        flap_nodes=jnp.asarray(
+            np.stack([mask(f.nodes) for f in flaps], axis=0)
+            if flaps else np.zeros((0, n), bool)
+        ),
+        out_start=jnp.asarray([o.start for o in outs], jnp.int32),
+        out_end=jnp.asarray([o.end for o in outs], jnp.int32),
+        out_nodes=jnp.asarray(
+            np.stack([mask(o.nodes) for o in outs], axis=0)
+            if outs else np.zeros((0, n), bool)
+        ),
+    )
+
+
+def _flap_dark(tsc: TensorScenario, k: int, rel: jax.Array) -> jax.Array:
+    """Scalar bool: flap rule k is in its dark phase at relative round
+    ``rel`` (schedule.Flapping.down_at, traced form)."""
+    return (
+        (rel >= tsc.flap_start[k]) & (rel < tsc.flap_end[k])
+        & ((rel - tsc.flap_start[k]) % tsc.flap_period[k] >= tsc.flap_up[k])
     )
 
 
@@ -142,6 +177,13 @@ def filter_edges(
             & (rel % tsc.slow_stride[s] != 0)
         )
         drop |= active & tsc.slow_nodes[s][edges]
+    for k in range(tsc.flap_start.shape[0]):
+        drop |= _flap_dark(tsc, k, rel) & tsc.flap_nodes[k][edges]
+    for o in range(tsc.out_start.shape[0]):
+        active = (rel >= tsc.out_start[o]) & (rel < tsc.out_end[o])
+        grp = tsc.out_nodes[o]
+        # blackout: src in group OR dst in group (rack-wide, both ways)
+        drop |= active & (grp[edges] | grp[recv])
     for l in range(tsc.loss_start.shape[0]):  # noqa: E741
         active = (rel >= tsc.loss_start[l]) & (rel < tsc.loss_end[l])
         u = jax.random.uniform(jax.random.fold_in(key, l), edges.shape)
@@ -171,6 +213,10 @@ def sends_mask(tsc: TensorScenario, n: int, rnd: jax.Array) -> jax.Array:
             & (rel % tsc.slow_stride[s] != 0)
         )
         send &= ~(active & tsc.slow_nodes[s])
+    for k in range(tsc.flap_start.shape[0]):
+        # flapping is sender-global exactly like the slow-sender rule,
+        # so the aligned-arc forms inherit it through the same mute
+        send &= ~(_flap_dark(tsc, k, rel) & tsc.flap_nodes[k])
     return send
 
 
@@ -258,9 +304,11 @@ def _require_arc_scenario(scenario, config: SimConfig) -> None:
     align = config.arc_align
     if isinstance(scenario, TensorScenario):
         n_loss = int(scenario.loss_start.shape[0])
+        n_out = int(scenario.out_start.shape[0])
         pids = np.asarray(scenario.part_pid)
     else:  # declarative FaultScenario
         n_loss = len(scenario.link_faults)
+        n_out = len(scenario.outages)
         pids = (
             np.stack([p.pid(config.n) for p in scenario.partitions])
             if scenario.partitions else np.zeros((0, config.n), np.int32)
@@ -269,7 +317,15 @@ def _require_arc_scenario(scenario, config: SimConfig) -> None:
         raise ValueError(
             "Bernoulli loss rules draw per (sender, receiver) edge and "
             "have no group form: run loss scenarios on topology='random' "
-            "(or ring); aligned arcs take partitions + slow senders"
+            "(or ring); aligned arcs take partitions + slow/flapping "
+            "senders"
+        )
+    if n_out:
+        raise ValueError(
+            "correlated-outage rules mute receivers as well as senders "
+            "and have no aligned-arc group form: run outage scenarios on "
+            "topology='random' (or ring); aligned arcs take partitions "
+            "+ slow/flapping senders"
         )
     from gossipfs_tpu.ops.merge_pallas import ARC_MATCH_MAX_GROUPS
 

@@ -29,7 +29,11 @@ Command surface matches README.md:8-29 plus fault/time controls the sim adds:
                                      issued/acked, repairs pending/done —
                                      the obs/schema.py VITALS_FIELDS
                                      tail; engines without a data plane
-                                     render every field n/a, never 0)
+                                     render every field n/a, never 0.
+                                     invariant_violations appears when a
+                                     streaming monitor rides the attached
+                                     recorder — obs/monitor.py — and
+                                     renders n/a otherwise, same rule)
   grep [--node <k>] <regex>          search the event log (MP1 legacy verb);
                                      --node scopes to one machine's log view
 
@@ -267,10 +271,15 @@ def dispatch(
                       if hasattr(sim, "traffic_status") else {})
                 fmt = lambda k: ("n/a" if st.get(k) is None  # noqa: E731
                                  else st[k])
+                # invariant_violations: present only when a streaming
+                # monitor (obs/monitor.py) rides the attached recorder —
+                # engines that can't know it render n/a, never 0
                 print(f"ops issued={fmt('ops_issued')} "
                       f"acked={fmt('ops_acked')}; "
                       f"repairs pending={fmt('repairs_pending')} "
-                      f"done={fmt('repairs_done')}", file=out)
+                      f"done={fmt('repairs_done')}; "
+                      f"invariant_violations={fmt('invariant_violations')}",
+                      file=out)
             else:
                 print(f"unknown traffic verb: {sub} (status)", file=out)
         elif cmd == "grep":

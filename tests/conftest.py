@@ -68,6 +68,15 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "campaign: online-health-plane coverage (gossipfs_tpu/obs/"
+        "monitor.py + gossipfs_tpu/campaigns/ — the streaming invariant "
+        "monitor, the gray-failure scenario primitives, and the "
+        "campaign driver with its committed regression cases).  "
+        "Fast-lane cases ride tier-1, including the regression-case "
+        "replay smoke.  `pytest -m campaign` runs just this subsystem.",
+    )
+    config.addinivalue_line(
+        "markers",
         "traffic: traffic-plane coverage (gossipfs_tpu/traffic/ — the "
         "open-loop SDFS load generator, tensorized placement/repair "
         "planning, and the durability harness).  Fast-lane cases ride "

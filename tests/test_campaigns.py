@@ -1,0 +1,145 @@
+"""Campaign driver + committed regression cases (gossipfs_tpu/campaigns/).
+
+Coverage map:
+  * the committed regression case replays deterministically and the
+    monitor flags it (the tier-1 smoke the acceptance criteria name);
+  * a mild severity point of the same family is CLEARED — the monitor
+    verdict discriminates, it doesn't just always fire;
+  * bisect finds the severity knee between a passing and a violating
+    endpoint, and the grid sweep's breaking set brackets it;
+  * the ledger is a ``gossipfs-obs/v1`` stream tools/timeline.py
+    ingests unchanged (header recognized, verdict rows loaded as
+    events);
+  * family builders honor the avoid set (fault rules never overlap the
+    tracked TTD probes) and reject unknown knobs.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import pathlib
+
+import pytest
+
+from gossipfs_tpu import campaigns
+
+pytestmark = pytest.mark.campaign
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+CASE = REPO / "regressions" / "flap_storm_n256.json"
+
+
+def _timeline():
+    spec = importlib.util.spec_from_file_location(
+        "timeline_tool", REPO / "tools" / "timeline.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestRegressionCase:
+    def test_committed_flap_storm_reproduces(self):
+        """THE tier-1 smoke: the breaking point the round-13 campaign
+        bisected (flap down=3 at t_fail=5, N=256) replays bit-identically
+        and the streaming monitor flags the same invariant."""
+        out = campaigns.run_case(CASE)
+        assert out["reproduced"], out
+        assert out["row"]["verdict"] == "violated"
+        assert "fpr_storm" in out["row"]["monitor"]["by_invariant"]
+        # the committed evidence window rides the row
+        assert out["row"]["violation_window"]
+
+    def test_case_file_is_self_contained(self):
+        doc = json.loads(CASE.read_text())
+        assert doc["schema"] == campaigns.driver.CASE_SCHEMA
+        assert doc["expect"]["verdict"] == "violated"
+        assert doc["config"]["n"] == 256
+        # the embedded scenario is a valid declarative schedule
+        from gossipfs_tpu.scenarios import FaultScenario
+
+        sc = FaultScenario.from_json(json.dumps(doc["scenario"]))
+        assert sc.flapping and sc.n == 256
+
+    def test_mild_point_clears(self):
+        """One notch below the committed knee the monitor CLEARS the
+        run — deterministically, with the TTD probes intact.  Runs
+        through the driver's sweep entry so the fault nodes avoid the
+        tracked victims, exactly like the committed campaign."""
+        out = campaigns.sweep_axis("flap", 64, (2,), t_fail=5)
+        (row,) = out["rows"]
+        assert row["verdict"] == "pass", row["monitor"]
+        assert row["estimators"]["detected"] == row["estimators"][
+            "tracked_crashes"] == 4
+        assert row["estimators"]["ttd_first_median"] == 5
+
+
+class TestDriver:
+    def test_bisect_finds_knee_and_ledger_ingests(self, tmp_path):
+        led = campaigns.CampaignLedger(
+            tmp_path / "ledger.jsonl", family="flap", n=64, axis="down")
+        out = campaigns.bisect_axis("flap", 64, 2, 6, t_fail=5,
+                                    ledger=led)
+        led.close()
+        assert out["breaking_point"] == 3
+        by = {r["axis_value"]: r["verdict"] for r in out["rows"]}
+        assert by[2] == "pass" and by[3] == "violated"
+
+        # the ledger is an obs/v1 stream: timeline ingests it unchanged
+        tl = _timeline()
+        header, events = tl.load_stream(str(tmp_path / "ledger.jsonl"))
+        assert header["schema"] == "gossipfs-obs/v1"
+        assert header["family"] == "flap" and header["axis"] == "down"
+        verdicts = [e for e in events if e.kind == "campaign_verdict"]
+        assert len(verdicts) == out["evals"]
+        assert all("verdict" in e.detail for e in verdicts)
+        doc = tl.analyze([header], events)  # no crash, just ingestion
+        assert doc["events"] == len(verdicts)
+
+    def test_sweep_brackets_breaking_set(self):
+        out = campaigns.sweep_axis("flap", 64, (2, 4), t_fail=5)
+        assert out["breaking"] == [4]
+
+    def test_outage_family_violates(self):
+        """A correlated blackout: the isolated rack confirms the whole
+        far cluster (and vice versa) — an FPR storm by construction."""
+        sc = campaigns.make_scenario("outage", 64, 24, size=6, length=12)
+        row = campaigns.run_scenario(64, sc, t_fail=5)
+        assert row["verdict"] == "violated"
+        assert "fpr_storm" in row["monitor"]["by_invariant"]
+        assert row["estimators"]["split_brain_rounds"] > 0
+
+    def test_family_builders_avoid_and_validate(self):
+        from gossipfs_tpu.scenarios import FaultScenario
+
+        sc = campaigns.make_scenario("flap", 64, 10, avoid={0, 1, 2},
+                                     down=3)
+        assert isinstance(sc, FaultScenario)
+        assert not (set(sc.flapping[0].nodes) & {0, 1, 2})
+        with pytest.raises(ValueError, match="unknown family"):
+            campaigns.make_scenario("nope", 64, 10)
+        with pytest.raises(ValueError, match="knobs"):
+            campaigns.make_scenario("flap", 64, 10, stride=3)
+        # fixing the swept axis as a knob is rejected up front (before
+        # any run or ledger row), not as a mid-campaign TypeError
+        with pytest.raises(ValueError, match="severity axis"):
+            campaigns.sweep_axis("flap", 16, (3,), down=4)
+        with pytest.raises(ValueError, match="severity axis"):
+            campaigns.bisect_axis("flap", 16, 2, 6, down=4)
+
+    def test_case_roundtrip(self, tmp_path):
+        """write_case -> run_case closes the loop for a fresh breaking
+        point (the --commit path's contract)."""
+        from gossipfs_tpu.obs.monitor import MonitorParams
+
+        sc = campaigns.make_scenario("flap", 64, 24, down=4)
+        row = campaigns.run_scenario(64, sc, t_fail=5)
+        assert row["verdict"] == "violated"
+        path = tmp_path / "case.json"
+        campaigns.write_case(
+            path, sc, t_fail=5, t_suspect=0, seed=0, track=4,
+            params=MonitorParams.from_dict(row["monitor_params"]),
+            expect={"verdict": "violated", "invariants": ["fpr_storm"]},
+        )
+        out = campaigns.run_case(path)
+        assert out["reproduced"], out

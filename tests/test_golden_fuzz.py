@@ -302,3 +302,66 @@ def test_fuzz_matches_oracle(name, kwargs, introkill, seed):
         if r % 5 == 0 or r in schedule or (r - 1) in schedule:
             compare(state, naive, where=f"{name} seed={seed} round {r}")
     compare(state, naive, where=f"{name} seed={seed} final")
+
+
+@pytest.mark.scenario
+@pytest.mark.campaign
+def test_fuzz_gray_failure_matches_oracle():
+    """Round-13 golden fuzz: the gray-failure primitives — flapping duty
+    cycles + a correlated rack outage — armed over a seeded crash storm
+    WITH the SWIM lifecycle, checked entry-for-entry against the
+    per-node oracle.  The scenario path runs the interactive
+    ``gossip_round_scenario`` (the same per-edge ``filter_edges`` the
+    bulk scan applies); oracle edges are the identical sampled [N, F]
+    set put through the same rule table, so a flapping node's dark
+    phases and the outage window's total blackout must produce the
+    exact same SUSPECT/refute/confirm/cooldown walk in both."""
+    from gossipfs_tpu.core.rounds import gossip_round_scenario
+    from gossipfs_tpu.scenarios import (
+        CorrelatedOutage,
+        FaultScenario,
+        Flapping,
+    )
+    from gossipfs_tpu.scenarios.tensor import compile_tensor, filter_edges
+
+    n, rounds = 48, 60
+    cfg = SimConfig(n=n, topology="random", fanout=6,
+                    remove_broadcast=False, fresh_cooldown=True,
+                    t_fail=3, t_cooldown=8, hb_dtype="int16",
+                    view_dtype="int8",
+                    suspicion=SuspicionParams(t_suspect=2))
+    sc = FaultScenario(
+        name="fuzz-gray", n=n,
+        # two flappers whose dark span brackets the suspect window (one
+        # refutes inside it, one confirms past it) + a 5-node rack
+        # blackout long enough to walk MEMBER -> SUSPECT -> FAILED ->
+        # cooldown on both sides of the outage boundary
+        flapping=(Flapping(start=4, end=44, up=3, down=4, nodes=(5, 6)),
+                  Flapping(start=8, end=40, up=2, down=7, nodes=(11,)),),
+        outages=(CorrelatedOutage(start=14, end=30,
+                                  nodes=(20, 21, 22, 23, 24)),),
+    )
+    tsc = compile_tensor(sc)
+    rng = pyrandom.Random(1313)
+    schedule: dict[int, list[int]] = {}
+    for r in range(3, rounds):
+        if rng.random() < 0.10:
+            schedule[r] = rng.sample(
+                [x for x in range(1, n)], k=rng.randint(1, 2))
+    state = init_state(cfg)
+    naive = NaiveSim(cfg)
+    key = jax.random.PRNGKey(7)
+    for r in range(rounds):
+        crash = schedule.get(r, [])
+        ev = to_events(n, {"crash": crash})
+        k = jax.random.fold_in(key, r)
+        edges = topology.in_edges(cfg, k, None)
+        k_scn = jax.random.fold_in(k, 0x5CE)
+        state, _, _, _ = gossip_round_scenario(state, ev, edges, cfg,
+                                               tsc, k_scn)
+        oracle_edges = filter_edges(tsc, edges.astype(jnp.int32),
+                                    jnp.int32(r), k_scn)
+        naive.step(np.array(oracle_edges), crash=crash)
+        if r % 4 == 0 or r in schedule:
+            compare(state, naive, where=f"gray round {r}")
+    compare(state, naive, where="gray final")

@@ -371,6 +371,44 @@ class TestVitals:
         (line,) = servicer.Vitals({}, None)["lines"]
         assert line["engine"] == "sim" and line["round"] == 0
 
+    def test_monitor_vitals_absent_is_na_never_zero(self):
+        """The round-13 counter: `invariant_violations` appears ONLY
+        when a streaming monitor rides the attached recorder.  Without
+        one, the CLI `metrics` and `traffic status` verbs render n/a —
+        a fabricated clean 0 would claim a health check that never
+        ran."""
+        from gossipfs_tpu.cosim import CoSim
+        from gossipfs_tpu.shim import cli
+
+        assert "invariant_violations" in schema.VITALS_FIELDS
+        sim = CoSim(SimConfig(n=8, remove_broadcast=False,
+                              fresh_cooldown=True), seed=0)
+        sim.tick(2)
+        assert "invariant_violations" not in sim.vitals()
+        out = io.StringIO()
+        cli.dispatch(sim, "metrics", out=out)
+        assert "invariant_violations=n/a" in out.getvalue()
+        out = io.StringIO()
+        cli.dispatch(sim, "traffic status", out=out)
+        assert "invariant_violations=n/a" in out.getvalue()
+
+    def test_monitor_vitals_live_when_attached(self):
+        from gossipfs_tpu.cosim import CoSim
+        from gossipfs_tpu.obs.monitor import MonitorRecorder
+        from gossipfs_tpu.shim import cli
+
+        sim = CoSim(SimConfig(n=8, remove_broadcast=False,
+                              fresh_cooldown=True), seed=0)
+        sim.attach_recorder(MonitorRecorder(source="sim", n=8))
+        sim.tick(2)
+        assert sim.vitals()["invariant_violations"] == 0
+        out = io.StringIO()
+        cli.dispatch(sim, "metrics", out=out)
+        assert re.search(r"invariant_violations=\d+", out.getvalue())
+        out = io.StringIO()
+        cli.dispatch(sim, "traffic status", out=out)
+        assert re.search(r"invariant_violations=\d+", out.getvalue())
+
     def test_udp_vitals_omit_sim_only_fields(self):
         from gossipfs_tpu.detector.udp import UdpCluster
 
@@ -394,6 +432,262 @@ class TestVitals:
         # the per-refute ground truth it does not:
         assert "fp_suppressed" not in doc
         assert "fp_suppressed=n/a" in schema.render_vitals(doc)
+
+
+# ---------------------------------------------------------------------------
+# streaming invariant monitor (obs/monitor.py) — the online health plane
+# ---------------------------------------------------------------------------
+
+
+def _tick(r, fp=0, alive=32, sus=None):
+    detail = {"n_alive": alive, "true_detections": 0,
+              "false_positives": fp}
+    if sus is not None:
+        detail.update(suspects_entered=sus, refutations=0,
+                      fp_suppressed=0)
+    return schema.Event(round=r, observer=-1, subject=-1,
+                        kind="round_tick", detail=detail)
+
+
+class TestStreamMonitor:
+    """Invariant rows on synthetic streams (deterministic, jax-free) +
+    the parity oracle and the inline recorder attachment."""
+
+    def test_parity_claim_small_form(self):
+        """The monitor_parity claim at tier-1 size: the streaming
+        estimators equal timeline.py's post-hoc derivation exactly on
+        the selfcheck stream, with zero violations on the healthy run."""
+        out = _timeline().selfcheck(n=256, rounds=40, monitor=True)
+        assert out["monitor_parity"], out.get("monitor_mismatches")
+        assert out["monitor_violations"] == 0
+        assert out["ok"], out
+
+    def test_no_confirm_without_suspect(self):
+        from gossipfs_tpu.obs.monitor import StreamMonitor
+
+        mon = StreamMonitor(n=32)
+        viol = mon.feed([
+            _tick(0, sus=0),
+            schema.Event(round=2, observer=-1, subject=5, kind="suspect"),
+            schema.Event(round=4, observer=1, subject=5, kind="confirm"),
+            # subject 9 confirms with NO preceding suspect
+            schema.Event(round=5, observer=2, subject=9, kind="confirm"),
+        ])
+        assert [v.detail["invariant"] for v in viol] == [
+            "no_confirm_without_suspect"]
+        assert viol[0].subject == 9
+        # the post-hoc mirror agrees
+        assert mon.summary()["suspect_before_confirm"] is False
+
+    def test_no_acked_write_lost_end_of_stream(self):
+        from gossipfs_tpu.obs.monitor import StreamMonitor
+
+        def put(r, name, reps):
+            return schema.Event(round=r, observer=0, subject=-1,
+                                kind="replica_put",
+                                detail={"file": name, "version": 1,
+                                        "replicas": reps})
+
+        mon = StreamMonitor(n=8)
+        mon.feed([
+            put(1, "a.txt", [1, 2]),
+            put(1, "b.txt", [3]),
+            schema.Event(round=3, observer=-1, subject=3, kind="crash"),
+        ])
+        viol = mon.finish()
+        assert [v.detail["invariant"] for v in viol] == [
+            "no_acked_write_lost"]
+        assert viol[0].detail["files"] == ["b.txt"]
+        d = mon.summary()["durability"]
+        assert d["lost"] == 1 and d["acked_writes"] == 2
+        # a rejoin of the only holder heals the ledger
+        mon2 = StreamMonitor(n=8)
+        mon2.feed([
+            put(1, "b.txt", [3]),
+            schema.Event(round=3, observer=-1, subject=3, kind="crash"),
+            schema.Event(round=6, observer=-1, subject=3, kind="join"),
+        ])
+        assert mon2.finish() == []
+
+    def test_reconverge_bound(self):
+        from gossipfs_tpu.obs.monitor import MonitorParams, StreamMonitor
+
+        base = [
+            schema.Event(round=2, observer=-1, subject=4, kind="crash"),
+            *[_tick(r) for r in range(20)],
+        ]
+        # removed in time: clean
+        mon = StreamMonitor(params=MonitorParams(reconverge_bound=8), n=16)
+        mon.feed(base + [schema.Event(round=9, observer=-1, subject=4,
+                                      kind="remove")])
+        assert mon.finish() == [] and not mon.violations
+        # never removed, horizon past the deadline: flagged at finish
+        mon2 = StreamMonitor(params=MonitorParams(reconverge_bound=8), n=16)
+        mon2.feed(base)
+        viol = mon2.finish()
+        assert [v.detail["invariant"] for v in viol] == ["reconverge_bound"]
+        assert viol[0].subject == 4 and viol[0].detail["removed"] is False
+        # a scenario_clear after the crash re-clocks the deadline
+        mon3 = StreamMonitor(params=MonitorParams(reconverge_bound=8), n=16)
+        mon3.feed(base + [
+            schema.Event(round=14, observer=-1, subject=-1,
+                         kind="scenario_clear"),
+            schema.Event(round=18, observer=-1, subject=4, kind="remove"),
+        ])
+        assert mon3.finish() == [] and not mon3.violations
+
+    def test_reconverge_episodes_and_duplicate_removes(self):
+        """A rejoin + re-crash re-clocks the reconvergence deadline (a
+        prompt second removal is clean even though the FIRST crash's
+        deadline is long gone), and repeated per-observer remove rows
+        evaluate the episode once — no duplicate violations."""
+        from gossipfs_tpu.obs.monitor import MonitorParams, StreamMonitor
+
+        mon = StreamMonitor(params=MonitorParams(reconverge_bound=8), n=16)
+        mon.feed([
+            schema.Event(round=2, observer=-1, subject=4, kind="crash"),
+            *[_tick(r) for r in range(40)],
+            schema.Event(round=20, observer=-1, subject=4, kind="remove"),
+            schema.Event(round=20, observer=1, subject=4, kind="remove"),
+            schema.Event(round=21, observer=2, subject=4, kind="remove"),
+            schema.Event(round=25, observer=-1, subject=4, kind="join"),
+            schema.Event(round=30, observer=-1, subject=4, kind="crash"),
+            schema.Event(round=36, observer=-1, subject=4, kind="remove"),
+        ])
+        mon.finish()
+        # exactly ONE violation: the first episode's late removal
+        # (remove@20 > crash@2 + 8); the re-crash episode's remove@36
+        # is inside crash@30 + 8, and the repeat rows add nothing
+        assert len(mon.violations) == 1
+        v = mon.violations[0]
+        assert v.detail["crash_round"] == 2 and v.round == 20
+        # analyze-parity untouched: crash_rounds keeps the FIRST crash
+        assert mon.crash_rounds == {4: 2}
+
+    def test_durability_gate_matches_analyze(self):
+        """A repair-only tail (no replica_put/client_op) must not grow
+        a durability doc the post-hoc analyzer omits — the parity
+        oracle's gates are identical by construction."""
+        from gossipfs_tpu.obs.monitor import StreamMonitor, estimator_parity
+
+        events = [
+            _tick(0),
+            schema.Event(round=1, observer=0, subject=-1,
+                         kind="replica_repair",
+                         detail={"file": "a", "version": 1,
+                                 "targets": [2]}),
+        ]
+        mon = StreamMonitor()  # n rides the header on real streams;
+        mon.feed(events)       # none here, matching analyze's view
+        mon.finish()
+        assert "durability" not in mon.summary()
+        doc = _timeline().analyze([], events)
+        assert estimator_parity(doc, mon.summary())["ok"]
+
+    def test_fpr_storm_edge_triggered(self):
+        from gossipfs_tpu.obs.monitor import MonitorParams, StreamMonitor
+
+        mon = StreamMonitor(
+            params=MonitorParams(fpr_threshold=1e-3, fpr_window=4), n=32)
+        viol = mon.feed([_tick(r) for r in range(6)]
+                        + [_tick(6, fp=8), _tick(7, fp=8)]   # the storm
+                        + [_tick(r) for r in range(8, 14)]   # recovery
+                        + [_tick(14, fp=9)])                 # second storm
+        kinds = [v.detail["invariant"] for v in viol]
+        # edge-triggered: one violation per storm ENTRY, not per round
+        assert kinds == ["fpr_storm", "fpr_storm"]
+        assert mon.storm_rounds >= 3
+        assert mon.worst_window_fpr > 1e-3
+
+    def test_monitor_recorder_inline_and_replay_idempotent(self, tmp_path):
+        """MonitorRecorder rides attach_recorder on the interactive sim:
+        the violation lands IN the written stream; re-analyzing the file
+        surfaces it, and a fresh monitor over the same file re-derives
+        (not double-counts) it."""
+        from gossipfs_tpu.detector.sim import SimDetector
+        from gossipfs_tpu.obs.monitor import (
+            MonitorParams,
+            MonitorRecorder,
+            StreamMonitor,
+        )
+        from gossipfs_tpu.scenarios import FaultScenario, Flapping
+
+        n = 24
+        cfg = SimConfig(n=n, remove_broadcast=False, fresh_cooldown=True,
+                        t_cooldown=6, t_fail=3, merge_kernel="xla")
+        det = SimDetector(cfg, seed=0)
+        path = tmp_path / "flap_trace.jsonl"
+        rec = MonitorRecorder(
+            path, source="sim", n=n,
+            params=MonitorParams(fpr_threshold=1e-3, fpr_window=6))
+        det.attach_recorder(rec)
+        det.load_scenario(FaultScenario(
+            name="flap", n=n,
+            flapping=(Flapping(start=2, end=40, up=2, down=5,
+                               nodes=(3, 4)),)))
+        det.advance(40)
+        rec.close()
+        inline = [e for e in rec.events
+                  if e.kind == "invariant_violation"]
+        assert inline and inline[0].detail["invariant"] == "fpr_storm"
+        # the written artifact carries its own verdict
+        tl = _timeline()
+        headers, events = tl.merge([str(path)])
+        doc = tl.analyze(headers, events)
+        assert doc["invariant_violations"] == len(inline)
+        # replay idempotence: a fresh monitor over the monitored stream
+        # re-derives the same storm count from the raw rows
+        mon2 = StreamMonitor(
+            params=MonitorParams(fpr_threshold=1e-3, fpr_window=6))
+        mon2.feed_jsonl(path)
+        mon2.finish()
+        assert len(mon2.violations) == len(inline)
+
+    def test_bulk_decode_feeds_monitor(self):
+        """advance_bulk's post-scan decode flows through the inline
+        monitor exactly like interactive rounds (the bulk attachment
+        surface)."""
+        from gossipfs_tpu.detector.sim import SimDetector
+        from gossipfs_tpu.obs.monitor import MonitorRecorder
+
+        cfg = SimConfig(n=32, topology="random", fanout=5,
+                        remove_broadcast=False, fresh_cooldown=True,
+                        t_cooldown=12, merge_kernel="xla")
+        det = SimDetector(cfg, seed=0)
+        rec = MonitorRecorder(source="sim", n=32)
+        det.attach_recorder(rec)
+        det.advance_bulk(2)
+        det.crash(3)
+        det.advance_bulk(20)
+        det.drain_events()
+        rec.finish()
+        mon = rec.monitor
+        assert mon.rounds == 22
+        assert mon.crash_rounds == {3: 2}
+        assert not mon.violations
+        assert mon.summary()["ttd_converged"][3] >= 0
+
+    def test_deploy_log_tail_mode(self, tmp_path):
+        """feed_jsonl over a deploy-style node log (no header, `node`
+        names the observer): the file-attachment mode for engines the
+        monitor cannot sit inside."""
+        from gossipfs_tpu.obs.monitor import StreamMonitor
+
+        p = tmp_path / "node1.log"
+        p.write_text(
+            json.dumps({"round": 1, "node": 1, "kind": "suspect",
+                        "subject": 3}) + "\n"
+            + "free text line survives\n"
+            + json.dumps({"round": 3, "node": 1, "kind": "confirm",
+                          "subject": 3}) + "\n"
+            + json.dumps({"round": 4, "node": 1, "kind": "confirm",
+                          "subject": 5}) + "\n")
+        from gossipfs_tpu.obs.monitor import MonitorParams
+
+        mon = StreamMonitor(
+            params=MonitorParams(expect_suspicion=True), n=5)
+        viol = mon.feed_jsonl(p)
+        assert [v.subject for v in viol] == [5]
 
 
 # ---------------------------------------------------------------------------

@@ -134,6 +134,134 @@ class TestSchema:
 
 
 # ---------------------------------------------------------------------------
+# gray-failure primitives (round 13: flapping + correlated outages)
+# ---------------------------------------------------------------------------
+
+
+class TestGrayFailurePrimitives:
+    def _scenario(self, n=16):
+        from gossipfs_tpu.scenarios import CorrelatedOutage, Flapping
+
+        return FaultScenario(
+            name="gray", n=n,
+            flapping=(Flapping(start=2, end=20, up=3, down=4,
+                               nodes=(1, 2)),),
+            outages=(CorrelatedOutage(start=5, end=9, nodes=(8, 9, 10)),),
+        )
+
+    def test_runtime_drop_semantics(self):
+        """Reference semantics (scenarios/runtime.py): flapping mutes a
+        node's OUTGOING datagrams on its duty cycle's dark phase only;
+        an outage group talks to no one — itself included — for the
+        window, both directions."""
+        rt = ScenarioRuntime(self._scenario())
+        # flap cycle from start=2: rounds 2,3,4 up; 5,6,7,8 dark; 9+ up
+        assert not rt.drops(1, 0, 2) and not rt.drops(1, 0, 4)
+        assert rt.drops(1, 0, 5) and rt.drops(2, 0, 8)
+        assert not rt.drops(1, 0, 9) and not rt.drops(1, 0, 11)
+        assert rt.drops(1, 0, 12)      # next cycle's dark phase
+        assert not rt.drops(0, 1, 5)   # inbound to a dark flapper flows
+        assert not rt.drops(1, 0, 25)  # window over: healthy
+        # outage: both directions AND intra-group (the switch died)
+        assert rt.drops(8, 0, 5) and rt.drops(0, 8, 5)
+        assert rt.drops(8, 9, 6)
+        assert not rt.drops(8, 0, 4) and not rt.drops(8, 0, 9)
+
+    def test_json_roundtrip_and_queries(self):
+        sc = self._scenario()
+        assert FaultScenario.from_json(sc.to_json()) == sc
+        assert sc.horizon == 20
+        assert sc.active_at(3) and not sc.active_at(20)
+        # unreachable_at: outage members always; flappers dark-phase only
+        assert sc.unreachable_at(5) == {1, 2, 8, 9, 10}
+        assert sc.unreachable_at(3) == set()
+        assert sc.unreachable_at(10) == set()
+        rules = sc.active_rules(6)
+        assert any("flap" in r and "DARK" in r for r in rules)
+        assert any("outage" in r for r in rules)
+
+    def test_validation(self):
+        from gossipfs_tpu.scenarios import CorrelatedOutage, Flapping
+
+        with pytest.raises(ValueError, match="up >= 1"):
+            FaultScenario(name="x", n=8, flapping=(
+                Flapping(start=0, end=4, up=0, down=2, nodes=(1,)),))
+        with pytest.raises(ValueError, match="down >= 1"):
+            FaultScenario(name="x", n=8, flapping=(
+                Flapping(start=0, end=4, up=2, down=0, nodes=(1,)),))
+        with pytest.raises(ValueError, match="empty outage"):
+            FaultScenario(name="x", n=8, outages=(
+                CorrelatedOutage(start=0, end=4, nodes=()),))
+        with pytest.raises(ValueError, match="out of range"):
+            FaultScenario(name="x", n=8, outages=(
+                CorrelatedOutage(start=0, end=4, nodes=(9,)),))
+
+    def test_tensor_matches_runtime_per_edge(self):
+        """The compiled rule table drops exactly the (src, dst, round)
+        triples the per-message reference drops — flapping and outages
+        included (the round-7 parity argument extended)."""
+        from gossipfs_tpu.scenarios.tensor import filter_edges
+
+        sc = self._scenario()
+        rt = ScenarioRuntime(sc)
+        tsc = compile_tensor(sc)
+        n = sc.n
+        key = jax.random.PRNGKey(0)
+        edges = jnp.tile(jnp.arange(n, dtype=jnp.int32)[None, :], (n, 1))
+        for rnd in range(22):
+            out = np.asarray(filter_edges(tsc, edges, jnp.int32(rnd), key))
+            for i in range(n):
+                for j in range(n):
+                    if i == j:
+                        continue
+                    assert (out[i, j] == i) == rt.drops(j, i, rnd), (
+                        i, j, rnd)
+
+    def test_flap_rides_arc_sends_mask_outage_rejected(self):
+        """Capability matrix: flapping is sender-global (rides the
+        aligned-arc sends_mask like slow nodes); a correlated outage
+        mutes receivers too and must be rejected on aligned arcs with
+        a pointer to topology='random'."""
+        from gossipfs_tpu.scenarios import CorrelatedOutage, Flapping
+        from gossipfs_tpu.scenarios.tensor import sends_mask
+
+        n = 1024
+        arc = SimConfig(n=n, topology="random_arc", fanout=16, arc_align=8,
+                        remove_broadcast=False, fresh_cooldown=True)
+        flap = FaultScenario(name="f", n=n, flapping=(
+            Flapping(start=0, end=8, up=1, down=2,
+                     nodes=tuple(range(8))),))
+        require_scenario_config(arc, flap)  # accepted
+        sm = np.asarray(sends_mask(compile_tensor(flap), n, jnp.int32(1)))
+        assert not sm[:8].any() and sm[8:].all()
+        out = FaultScenario(name="o", n=n, outages=(
+            CorrelatedOutage(start=0, end=8, nodes=tuple(range(8))),))
+        with pytest.raises(ValueError, match="outage"):
+            require_scenario_config(arc, out)
+
+    def test_cosim_reachability_confined_by_outage(self):
+        """The control plane's scp/RPC reachability excludes outage
+        members and dark-phase flappers for the window (cosim.
+        _reachable) — a put cannot silently ack onto a blacked-out
+        rack."""
+        from gossipfs_tpu.cosim import CoSim
+        from gossipfs_tpu.scenarios import CorrelatedOutage
+
+        n = 12
+        sim = CoSim(gossip_only_cfg(n), seed=0)
+        sim.tick(2)
+        sc = FaultScenario(name="rack", n=n, outages=(
+            CorrelatedOutage(start=1, end=5, nodes=(6, 7, 8)),))
+        sim.load_scenario(sc)
+        sim.tick(2)  # inside the window
+        reach = sim._reachable()
+        assert reach.isdisjoint({6, 7, 8})
+        assert sim.cluster.master_node in reach
+        sim.tick(4)  # past the window
+        assert {6, 7, 8} <= sim._reachable()
+
+
+# ---------------------------------------------------------------------------
 # tensor engine (the fast-lane tier-1 smoke)
 # ---------------------------------------------------------------------------
 

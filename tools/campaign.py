@@ -1,0 +1,144 @@
+"""Adversarial campaign CLI — sweep/bisect scenario space with the
+streaming monitor as the oracle (gossipfs_tpu/campaigns/).
+
+    # grid-sweep a severity axis, ledger every verdict
+    JAX_PLATFORMS=cpu python tools/campaign.py --family flap --n 256 \
+        --t-fail 3 --values 2 3 4 5 6 --ledger CAMPAIGN.jsonl
+
+    # bisect to the exact breaking point, commit it as a regression case
+    JAX_PLATFORMS=cpu python tools/campaign.py --family flap --n 256 \
+        --t-fail 3 --bisect 1 10 --ledger CAMPAIGN.jsonl \
+        --commit regressions/flap_storm_n256.json
+
+    # replay a committed case (the tier-1 smoke's command form)
+    JAX_PLATFORMS=cpu python tools/campaign.py \
+        --case regressions/flap_storm_n256.json
+
+Families and their severity axes: ``campaigns.FAMILIES`` (flap/down,
+loss/rate_pct, partition/split_len, outage/size).  Extra fixed knobs
+ride ``--knob k=v``.  The ledger is a ``gossipfs-obs/v1`` stream
+(header + ``campaign_verdict`` rows) — ``tools/timeline.py`` ingests it
+unchanged.  Prints ONE JSON document; exit 0 iff the requested action
+succeeded (a sweep/bisect that found breaking points still exits 0 —
+finding them is the job; --case exits nonzero when NOT reproduced).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import argparse
+import json
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--family", choices=None, default=None,
+                   help="scenario family (campaigns.FAMILIES)")
+    p.add_argument("--n", type=int, default=256)
+    p.add_argument("--t-fail", type=int, default=5)
+    p.add_argument("--t-suspect", type=int, default=0,
+                   help="arm the SWIM lifecycle at this suspect window "
+                        "(0 = raw)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--track", type=int, default=4,
+                   help="tracked crashes per run (TTD/reconvergence "
+                        "probes)")
+    p.add_argument("--fault-rounds", type=int, default=24,
+                   help="how long the family's fault window stays armed")
+    p.add_argument("--values", type=int, nargs="+", default=None,
+                   help="grid-sweep these severity-axis values")
+    p.add_argument("--bisect", type=int, nargs=2, metavar=("LO", "HI"),
+                   default=None,
+                   help="bisect the severity axis over [LO, HI] to the "
+                        "smallest violating value")
+    p.add_argument("--knob", action="append", default=[],
+                   metavar="K=V", help="fix a family knob (repeatable)")
+    p.add_argument("--ledger", type=str, default=None,
+                   help="write the campaign ledger JSONL here")
+    p.add_argument("--commit", type=str, default=None,
+                   help="commit the confirmed breaking point as a "
+                        "regression case file at this path")
+    p.add_argument("--case", type=str, default=None,
+                   help="replay a committed regression case instead of "
+                        "running a campaign")
+    args = p.parse_args(argv)
+
+    from gossipfs_tpu import campaigns
+
+    if args.case:
+        out = campaigns.run_case(args.case)
+        print(json.dumps(out))
+        return 0 if out["reproduced"] else 1
+
+    if not args.family:
+        p.error("--family (or --case) is required")
+    if args.family not in campaigns.FAMILIES:
+        p.error(f"unknown family {args.family!r}; pick from "
+                f"{sorted(campaigns.FAMILIES)}")
+    if (args.values is None) == (args.bisect is None):
+        p.error("pick exactly one of --values / --bisect")
+    knobs = {}
+    for kv in args.knob:
+        k, _, v = kv.partition("=")
+        knobs[k] = int(v)
+
+    axis = campaigns.FAMILIES[args.family]["axis"]
+    if axis in knobs:
+        p.error(f"--knob {axis}=... fixes the {args.family} family's "
+                "swept severity axis; give it via --values / --bisect")
+    ledger = None
+    if args.ledger:
+        ledger = campaigns.CampaignLedger(
+            args.ledger, family=args.family, n=args.n, axis=axis,
+            t_fail=args.t_fail, t_suspect=args.t_suspect, seed=args.seed)
+    common = dict(fault_rounds=args.fault_rounds, t_fail=args.t_fail,
+                  t_suspect=args.t_suspect, seed=args.seed,
+                  track=args.track, ledger=ledger, **knobs)
+    if args.values is not None:
+        out = campaigns.sweep_axis(args.family, args.n, args.values,
+                                   **common)
+        breaking = min(out["breaking"], default=None)
+    else:
+        lo, hi = args.bisect
+        out = campaigns.bisect_axis(args.family, args.n, lo, hi, **common)
+        breaking = out["breaking_point"]
+    if ledger is not None:
+        ledger.close()
+        out["ledger"] = args.ledger
+
+    if args.commit and breaking is not None:
+        # re-derive the committed point's scenario (same avoid set as
+        # the runs) and stamp the case with the observed verdict
+        row = next(r for r in out["rows"]
+                   if r["axis_value"] == breaking)
+        from gossipfs_tpu.bench.run import tracked_crash_events
+        from gossipfs_tpu.obs.monitor import MonitorParams
+
+        cfg = campaigns.driver.campaign_config(
+            args.n, t_fail=args.t_fail, t_suspect=args.t_suspect)
+        _, crash_rounds, _ = tracked_crash_events(
+            cfg, args.fault_rounds + 1, args.track, 10)
+        sc = campaigns.make_scenario(
+            args.family, args.n, args.fault_rounds,
+            avoid=set(crash_rounds) | {cfg.introducer},
+            **{axis: breaking}, **knobs)
+        campaigns.write_case(
+            args.commit, sc, t_fail=args.t_fail,
+            t_suspect=args.t_suspect, seed=args.seed, track=args.track,
+            params=MonitorParams.from_dict(row["monitor_params"]),
+            expect={"verdict": "violated",
+                    "invariants": sorted(
+                        row["monitor"]["by_invariant"])},
+            family=args.family, axis=axis, axis_value=breaking,
+        )
+        out["committed"] = args.commit
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,7 +16,10 @@ pins it in CI).
     python tools/timeline.py /tmp/cluster/node*.log       # deploy logs merge
     python tools/timeline.py TRACE.jsonl --subject 777    # one node's story
     python tools/timeline.py TRACE.jsonl --json           # machine-readable
+    python tools/timeline.py TRACE.jsonl --monitor        # + streaming-monitor
+                                                          #   verdict & parity
     JAX_PLATFORMS=cpu python tools/timeline.py --selfcheck --n 1024
+    JAX_PLATFORMS=cpu python tools/timeline.py --selfcheck --monitor --n 1024
 
 Also ingests ``ROUNDPROF_*.jsonl`` profile artifacts (their round-9+
 header row names the schema): prints a per-config summary instead of a
@@ -45,27 +48,14 @@ from gossipfs_tpu.obs.schema import Event
 def load_stream(path: str) -> tuple[dict | None, list[Event]]:
     """One JSONL stream -> (header row or None, schema events).
 
-    Tolerates deploy node logs (no header; ``node`` names the observer)
-    and skips rows carrying no schema kind.
+    Delegates to ``obs.recorder.load_stream`` — ONE reader of the line
+    format, shared with the streaming monitor's ``feed_jsonl``, so the
+    post-hoc and online derivations can never parse a stream
+    differently.
     """
-    header = None
-    events: list[Event] = []
-    with open(path, encoding="utf-8") as f:
-        for i, line in enumerate(f):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError:
-                continue  # free-text line in a legacy log
-            if i == 0 and schema.is_header(rec):
-                header = rec
-                continue
-            kind = rec.get("kind")
-            if kind in schema.EVENT_KINDS:
-                events.append(Event.from_record(rec))
-    return header, events
+    from gossipfs_tpu.obs.recorder import load_stream as _load
+
+    return _load(path)
 
 
 def merge(paths: list[str]) -> tuple[list[dict], list[Event]]:
@@ -164,6 +154,11 @@ def analyze(headers: list[dict], events: list[Event]) -> dict:
         "n": n,
         "rounds": len(ticks),
         "events": len(events),
+        # invariant_violation rows a live monitor stamped into the
+        # stream (obs/monitor.py) — surfaced, not re-derived; run the
+        # stream through a fresh StreamMonitor (--monitor) to re-check
+        "invariant_violations": sum(
+            1 for e in events if e.kind == "invariant_violation"),
         "tracked_crashes": len(crash_rounds),
         "detected": len(ttd_vals),
         "ttd_first": ttd_first,
@@ -253,7 +248,7 @@ def summarize_roundprof(path: str) -> dict:
 
 
 def selfcheck(n: int = 1024, rounds: int = 60, seed: int = 0,
-              trace_path: str | None = None) -> dict:
+              trace_path: str | None = None, monitor: bool = False) -> dict:
     """Record a churn run, then prove the two accountings agree.
 
     Runs the N-node gossip-only churn scenario WITH the SWIM suspicion
@@ -266,6 +261,12 @@ def selfcheck(n: int = 1024, rounds: int = 60, seed: int = 0,
       * event-derived FPR == ``summarize``'s, exactly (same integers,
         same opportunity model — any drift is a real accounting bug);
       * the lifecycle invariant: no confirm without a preceding suspect.
+
+    ``monitor=True`` additionally tails the SAME trace file through the
+    streaming invariant monitor (obs/monitor.py) and requires its
+    incremental estimators to equal this analyzer's post-hoc derivation
+    field for field (``estimator_parity`` — the ``monitor_parity``
+    claim), with zero invariant violations on the healthy run.
 
     Also times the decode: the recorder runs after the scan returns, on
     arrays ``summarize`` reads anyway, so the overhead is host-side and
@@ -315,6 +316,18 @@ def selfcheck(n: int = 1024, rounds: int = 60, seed: int = 0,
     decode_ms = (time.perf_counter() - t0) * 1e3
     headers, evs = merge([trace_path])
     doc = analyze(headers, evs)
+    parity = None
+    if monitor:
+        # the streaming path end-to-end: tail the written file itself
+        # through a fresh monitor, then diff against the post-hoc doc
+        from gossipfs_tpu.obs.monitor import StreamMonitor, estimator_parity
+
+        t1 = time.perf_counter()
+        mon = StreamMonitor()
+        mon.feed_jsonl(trace_path)
+        mon.finish()
+        monitor_ms = (time.perf_counter() - t1) * 1e3
+        parity = estimator_parity(doc, mon.summary())
     if own_file:
         os.unlink(trace_path)
 
@@ -342,6 +355,11 @@ def selfcheck(n: int = 1024, rounds: int = 60, seed: int = 0,
         "fp_suppressed": report.fp_suppressed,
         "suspect_before_confirm": bool(doc.get("suspect_before_confirm")),
     }
+    if parity is not None:
+        out["monitor_parity"] = parity["ok"]
+        out["monitor_mismatches"] = parity["mismatches"]
+        out["monitor_ms"] = round(monitor_ms, 2)
+        out["monitor_violations"] = len(mon.violations)
     out["ok"] = (out["ttd_match"]
                  and out["ttd_median_events"] == out["ttd_median_summarize"]
                  and out["fpr_match"] and out["detections_match"]
@@ -349,7 +367,12 @@ def selfcheck(n: int = 1024, rounds: int = 60, seed: int = 0,
                  # non-triviality: the fast knob must have exercised the
                  # lifecycle, or the exact-match checks compared nothing
                  and out["fp_suppressed"] > 0
-                 and out["suspect_before_confirm"])
+                 and out["suspect_before_confirm"]
+                 # monitor parity (when requested): streaming estimators
+                 # exactly equal this post-hoc derivation, zero
+                 # violations on the healthy run
+                 and (parity is None or (parity["ok"]
+                                         and not mon.violations)))
     return out
 
 
@@ -364,13 +387,19 @@ def main(argv=None) -> int:
     p.add_argument("--selfcheck", action="store_true",
                    help="record a fresh CPU churn run and diff the "
                         "event-derived metrics against summarize's")
+    p.add_argument("--monitor", action="store_true",
+                   help="additionally run the stream(s) through the "
+                        "streaming invariant monitor (obs/monitor.py) "
+                        "and report its verdict + the monitor_parity "
+                        "diff against this analyzer's post-hoc doc")
     p.add_argument("--n", type=int, default=1024)
     p.add_argument("--rounds", type=int, default=60)
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args(argv)
 
     if args.selfcheck:
-        out = selfcheck(n=args.n, rounds=args.rounds, seed=args.seed)
+        out = selfcheck(n=args.n, rounds=args.rounds, seed=args.seed,
+                        monitor=args.monitor)
         print(json.dumps(out))
         return 0 if out["ok"] else 1
 
@@ -386,6 +415,16 @@ def main(argv=None) -> int:
 
     headers, events = merge(args.paths)
     doc = analyze(headers, events)
+    if args.monitor:
+        from gossipfs_tpu.obs.monitor import StreamMonitor, estimator_parity
+
+        mon = StreamMonitor()
+        for h in headers:
+            mon.observe_header(h)
+        mon.feed(events)
+        mon.finish()
+        doc["monitor"] = mon.verdict()
+        doc["monitor_parity"] = estimator_parity(doc, mon.summary())
     if args.json:
         print(json.dumps(doc))
         return 0

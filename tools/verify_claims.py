@@ -169,6 +169,18 @@ CLAIMS = {
         ["env", "JAX_PLATFORMS=cpu", sys.executable, "tools/timeline.py",
          "--selfcheck", "--n", "1024"],
         lambda d: 1.0 if d["ok"] else 0.0, 1.0, 0.0),
+    # online health plane (obs/monitor.py): the STREAMING monitor is a
+    # second, incremental derivation of the same estimators — the claim
+    # runs the N=1024 churn selfcheck stream through it and requires
+    # estimator-for-estimator equality with timeline.py's post-hoc
+    # analysis (monitor_parity == exact match on every PARITY_FIELDS
+    # row) plus zero invariant violations on the healthy run.  CPU.
+    "monitor_parity": (
+        ["env", "JAX_PLATFORMS=cpu", sys.executable, "tools/timeline.py",
+         "--selfcheck", "--monitor", "--n", "1024"],
+        lambda d: 1.0 if (d["ok"] and d["monitor_parity"]
+                          and d["monitor_violations"] == 0) else 0.0,
+        1.0, 0.0),
     # traffic plane (TRAFFIC_r12.json is the committed artifact of the
     # full-bench form of this command): writes race a timed partition
     # that confines quorum reachability to the master's side; the claim
@@ -190,6 +202,11 @@ CLAIMS = {
             and d["partition_race"]["durability"]["harness"]["files_acked"]
             > 0
             and d["partition_race"]["rejected_during_split"] > 0
+            # round 13: the STREAMING monitor rides the harness recorder
+            # (obs/monitor.py) — zero no_acked_write_lost violations and
+            # its incremental ledger exactly equal to the post-hoc replay
+            and d["partition_race"]["durability"]["monitor"]["ok"]
+            and d["partition_race"]["durability"]["monitor"]["match_events"]
         ) else 0.0,
         1.0, 0.0),
 }
