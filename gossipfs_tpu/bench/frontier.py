@@ -89,7 +89,7 @@ def run(n: int, rounds: int, block_c: int, crash_at: int, track: int,
         )
         # lanes stay on device; only the [N]-vector liveness and the
         # metrics leave (alive feeds the flight recorder's ground truth)
-        return out[2], out[6], out[7]
+        return out[2], out[7], out[8]
 
     key = jax.random.PRNGKey(seed)
     alive, mcarry, per_round = go(key, events, churn_ok)

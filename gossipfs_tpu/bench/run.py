@@ -43,6 +43,19 @@ from gossipfs_tpu.sdfs.cluster import SDFSCluster
 from gossipfs_tpu.sdfs.types import RECOVERY_DELAY
 
 
+def tracked_victims(n: int, track: int, introducer: int = 0,
+                    n_live: int | None = None) -> list[int]:
+    """The tracked-crash victim ids — the ONE derivation every engine's
+    probe schedule shares (the tensor scan here, the socket campaign
+    runners in campaigns/engines.py): ``track`` nodes spread evenly
+    across the live id space, skipping the introducer."""
+    live = n if n_live is None else n_live
+    track = min(track, live - 1)
+    stride = max(live // (track + 1), 1)
+    nodes = [(introducer + (k + 1) * stride) % live for k in range(track)]
+    return sorted({x for x in nodes if x != introducer})
+
+
 def tracked_crash_events(
     cfg: SimConfig, rounds: int, track: int, at: int, n_live: int | None = None
 ) -> tuple[RoundEvents, dict[int, int], jnp.ndarray]:
@@ -61,11 +74,7 @@ def tracked_crash_events(
     it — a random rejoin would otherwise resurrect a pad into the cohort.
     """
     n = cfg.n
-    live = n if n_live is None else n_live
-    track = min(track, live - 1)
-    stride = max(live // (track + 1), 1)
-    nodes = [(cfg.introducer + (k + 1) * stride) % live for k in range(track)]
-    nodes = sorted({x for x in nodes if x != cfg.introducer})
+    nodes = tracked_victims(n, track, cfg.introducer, n_live=n_live)
     crash = np.zeros((rounds, n), dtype=bool)
     at = min(at, rounds - 1)
     crash[at, nodes] = True

@@ -15,10 +15,19 @@ tier-1 test replays deterministically (``driver.run_case``).
 ``tools/timeline.py`` ingests it unchanged.
 """
 
+from gossipfs_tpu.campaigns.engines import (
+    run_case_engine,
+    scale_case,
+    verdict_agreement,
+)
 from gossipfs_tpu.campaigns.driver import (
     FAMILIES,
     CampaignLedger,
     bisect_axis,
+    campaign_config,
+    case_verdict_ok,
+    knob_surface,
+    load_case,
     make_scenario,
     run_case,
     run_scenario,
@@ -30,9 +39,16 @@ __all__ = [
     "FAMILIES",
     "CampaignLedger",
     "bisect_axis",
+    "campaign_config",
+    "case_verdict_ok",
+    "knob_surface",
+    "load_case",
     "make_scenario",
     "run_case",
+    "run_case_engine",
     "run_scenario",
+    "scale_case",
     "sweep_axis",
+    "verdict_agreement",
     "write_case",
 ]

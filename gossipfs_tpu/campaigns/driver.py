@@ -77,10 +77,18 @@ FAMILIES: dict[str, dict] = {
 }
 
 
-def campaign_config(n: int, t_fail: int = 5, t_suspect: int = 0):
+def campaign_config(n: int, t_fail: int = 5, t_suspect: int = 0,
+                    lh_multiplier: int = 0, lh_frac: float = 0.25):
     """The campaign protocol profile: gossip-only random log-fanout on
     the XLA merge (the CPU-feasible oracle form — an on-TPU campaign
-    passes its own kernel knobs through ``run_scenario(config=...)``)."""
+    passes its own kernel knobs through ``run_scenario(config=...)``).
+
+    ``lh_multiplier``/``lh_frac`` (round 14): the Lifeguard local-health
+    knobs, now first-class campaign axes — an observer whose own view
+    holds more than ``lh_frac`` of its peers simultaneously SUSPECT
+    stretches its confirmation window by ``1 + lh_multiplier``.  Use
+    exact binary fractions (1/32, 1/64...) per suspicion/params.py.
+    """
     from gossipfs_tpu.config import SimConfig
 
     cfg = SimConfig(
@@ -92,7 +100,13 @@ def campaign_config(n: int, t_fail: int = 5, t_suspect: int = 0):
         from gossipfs_tpu.suspicion import SuspicionParams
 
         cfg = dataclasses.replace(
-            cfg, suspicion=SuspicionParams(t_suspect=t_suspect))
+            cfg, suspicion=SuspicionParams(
+                t_suspect=t_suspect, lh_multiplier=lh_multiplier,
+                lh_frac=lh_frac))
+    elif lh_multiplier > 0:
+        raise ValueError(
+            "lh_multiplier > 0 (Lifeguard local health) requires the "
+            "SWIM lifecycle: pass t_suspect >= 1")
     return cfg
 
 
@@ -156,26 +170,52 @@ def make_scenario(family: str, n: int, fault_rounds: int,
 def default_monitor_params(cfg, horizon: int) -> MonitorParams:
     """The campaign invariant knobs: FPR-storm threshold 1e-4 (healthy
     regimes measure ~4e-7, raw-t3 storms ~4e-3 — SUSPECT_r08), and the
-    reconvergence bound t_fail + gossip diameter + slack clocked from
-    the scenario horizon (faults legitimately delay convergence while
-    armed)."""
+    reconvergence bound: the armed detector's WORST-CASE confirmation
+    window + gossip diameter + slack, clocked from the scenario horizon
+    (faults legitimately delay convergence while armed).  The worst-case
+    window is ``t_fail + t_suspect * (1 + lh_multiplier)`` under the
+    SWIM lifecycle (``SuspicionParams.max_confirm_after`` — a
+    local-health-stretched observer legitimately confirms, and
+    stops gossiping, that much later) and plain ``t_fail`` without it;
+    round 13's ``t_fail``-only bound under-counted armed suspicion by
+    ``t_suspect`` and flagged correctly-converging lh runs."""
     diameter = math.ceil(math.log(max(cfg.n, 2))
                          / math.log(cfg.fanout + 1))
+    worst = (cfg.suspicion.max_confirm_after(cfg.t_fail)
+             if cfg.suspicion is not None else cfg.t_fail)
     return MonitorParams(
         fpr_threshold=1e-4,
         fpr_window=10,
-        reconverge_bound=cfg.t_fail + diameter + 4,
+        reconverge_bound=worst + diameter + 4,
         clock_floor=horizon,
         expect_suspicion=cfg.suspicion is not None,
     )
 
 
-def run_scenario(n: int, scenario: FaultScenario, *, t_fail: int = 5,
-                 t_suspect: int = 0, rounds: int | None = None,
+def campaign_rounds(horizon: int, crash_at: int, bound: int) -> int:
+    """THE run-length derivation every engine shares: past the last
+    fault window AND the tracked crashes' own detection horizon, plus
+    the reconvergence deadline and slack.  One owner — the socket
+    runners (campaigns/engines.py) are verdict-compared against the
+    tensor replay round for round, so a drifted copy would silently
+    compare different experiments."""
+    return max(horizon, crash_at) + bound + 8
+
+
+def run_scenario(n: int, scenario: FaultScenario | None, *,
+                 t_fail: int = 5,
+                 t_suspect: int = 0, lh_multiplier: int = 0,
+                 lh_frac: float = 0.25, rounds: int | None = None,
                  seed: int = 0, track: int = 4, crash_at: int = 10,
                  params: MonitorParams | None = None,
                  config=None) -> dict:
     """One campaign run: bulk engine + decode + streaming monitor.
+
+    ``scenario=None`` runs the QUIET baseline — no fault rules, same
+    tracked crashes — which is what the local-health knob surface
+    compares outage rows against (the deterministic t_fail=5 quiet run
+    has ZERO false positives, so "FPR at the t_fail=5 baseline" is an
+    exact-count comparison, not a tolerance).
 
     Returns the ledger row: verdict, monitor estimators, the violation
     list, and the violating event window (all decoded events within 2
@@ -188,14 +228,16 @@ def run_scenario(n: int, scenario: FaultScenario, *, t_fail: int = 5,
     from gossipfs_tpu.obs.recorder import decode_scan
     from gossipfs_tpu.scenarios.tensor import compile_tensor
 
+    if scenario is None:
+        scenario = FaultScenario(name="quiet", n=n)
     cfg = config if config is not None else campaign_config(
-        n, t_fail=t_fail, t_suspect=t_suspect)
+        n, t_fail=t_fail, t_suspect=t_suspect,
+        lh_multiplier=lh_multiplier, lh_frac=lh_frac)
     if params is None:
         params = default_monitor_params(cfg, scenario.horizon)
     if rounds is None:
-        # past the last fault window + the reconvergence deadline
         bound = params.reconverge_bound or (cfg.t_fail + 6)
-        rounds = scenario.horizon + bound + 8
+        rounds = campaign_rounds(scenario.horizon, crash_at, bound)
     events, crash_rounds, churn_ok = tracked_crash_events(
         cfg, rounds, track, crash_at)
     final, carry, per_round = run_rounds(
@@ -224,6 +266,9 @@ def run_scenario(n: int, scenario: FaultScenario, *, t_fail: int = 5,
         "n": cfg.n,
         "t_fail": cfg.t_fail,
         "t_suspect": (cfg.suspicion.t_suspect if cfg.suspicion else 0),
+        "lh_multiplier": (cfg.suspicion.lh_multiplier
+                          if cfg.suspicion else 0),
+        "lh_frac": (cfg.suspicion.lh_frac if cfg.suspicion else 0.0),
         "rounds": rounds,
         "seed": seed,
         "scenario": scenario.name,
@@ -232,6 +277,7 @@ def run_scenario(n: int, scenario: FaultScenario, *, t_fail: int = 5,
         "verdict": "violated" if mon.violations else "pass",
         "monitor": mon.verdict(),
         "estimators": {
+            "false_positives": s["false_positives"],
             "false_positive_rate": s["false_positive_rate"],
             "worst_window_fpr": s["worst_window_fpr"],
             "ttd_first_median": s["ttd_first_median"],
@@ -278,7 +324,9 @@ class CampaignLedger:
 
 
 def sweep_axis(family: str, n: int, values, *, fault_rounds: int = 24,
-               t_fail: int = 5, t_suspect: int = 0, seed: int = 0,
+               t_fail: int = 5, t_suspect: int = 0,
+               lh_multiplier: int = 0, lh_frac: float = 0.25,
+               seed: int = 0,
                track: int = 4, ledger: CampaignLedger | None = None,
                **fixed_knobs) -> dict:
     """Grid-sweep the family's severity axis; returns rows + the
@@ -287,13 +335,15 @@ def sweep_axis(family: str, n: int, values, *, fault_rounds: int = 24,
     rows = []
     for v in values:
         sc, row = _run_point(family, n, axis, v, fault_rounds, t_fail,
-                             t_suspect, seed, track, fixed_knobs)
+                             t_suspect, lh_multiplier, lh_frac, seed,
+                             track, fixed_knobs)
         rows.append(row)
         if ledger is not None:
             ledger.add(v, row)
     return {
         "family": family, "axis": axis, "n": n,
         "t_fail": t_fail, "t_suspect": t_suspect,
+        "lh_multiplier": lh_multiplier, "lh_frac": lh_frac,
         "rows": rows,
         "breaking": [r["axis_value"] for r in rows
                      if r["verdict"] == "violated"],
@@ -302,7 +352,8 @@ def sweep_axis(family: str, n: int, values, *, fault_rounds: int = 24,
 
 def bisect_axis(family: str, n: int, lo: int, hi: int, *,
                 fault_rounds: int = 24, t_fail: int = 5,
-                t_suspect: int = 0, seed: int = 0, track: int = 4,
+                t_suspect: int = 0, lh_multiplier: int = 0,
+                lh_frac: float = 0.25, seed: int = 0, track: int = 4,
                 ledger: CampaignLedger | None = None,
                 **fixed_knobs) -> dict:
     """Smallest axis value in [lo, hi] whose run violates an invariant
@@ -315,14 +366,16 @@ def bisect_axis(family: str, n: int, lo: int, hi: int, *,
     def probe(v: int) -> dict:
         if v not in evals:
             _, row = _run_point(family, n, axis, v, fault_rounds, t_fail,
-                                t_suspect, seed, track, fixed_knobs)
+                                t_suspect, lh_multiplier, lh_frac, seed,
+                                track, fixed_knobs)
             evals[v] = row
             if ledger is not None:
                 ledger.add(v, row)
         return evals[v]
 
     out = {"family": family, "axis": axis, "n": n, "lo": lo, "hi": hi,
-           "t_fail": t_fail, "t_suspect": t_suspect}
+           "t_fail": t_fail, "t_suspect": t_suspect,
+           "lh_multiplier": lh_multiplier, "lh_frac": lh_frac}
     if probe(hi)["verdict"] != "violated":
         return {**out, "breaking_point": None, "evals": len(evals),
                 "rows": [evals[v] for v in sorted(evals)]}
@@ -357,10 +410,11 @@ def _axis_checked(family: str, fixed_knobs: dict) -> str:
 
 
 def _run_point(family, n, axis, value, fault_rounds, t_fail, t_suspect,
-               seed, track, fixed_knobs):
+               lh_multiplier, lh_frac, seed, track, fixed_knobs):
     from gossipfs_tpu.bench.run import tracked_crash_events
 
-    cfg = campaign_config(n, t_fail=t_fail, t_suspect=t_suspect)
+    cfg = campaign_config(n, t_fail=t_fail, t_suspect=t_suspect,
+                          lh_multiplier=lh_multiplier, lh_frac=lh_frac)
     # victims are a pure function of (cfg, track) — compute them first so
     # the family's fault nodes can avoid the TTD probes
     _, crash_rounds, _ = tracked_crash_events(cfg, fault_rounds + 1,
@@ -369,6 +423,7 @@ def _run_point(family, n, axis, value, fault_rounds, t_fail, t_suspect,
                        avoid=set(crash_rounds) | {cfg.introducer},
                        **{axis: value}, **fixed_knobs)
     row = run_scenario(n, sc, t_fail=t_fail, t_suspect=t_suspect,
+                       lh_multiplier=lh_multiplier, lh_frac=lh_frac,
                        seed=seed, track=track)
     return sc, {"axis_value": value, **row}
 
@@ -380,15 +435,20 @@ def _run_point(family, n, axis, value, fault_rounds, t_fail, t_suspect,
 
 def write_case(path, scenario: FaultScenario, *, t_fail: int,
                t_suspect: int, seed: int, track: int,
-               params: MonitorParams, expect: dict, **meta) -> None:
+               params: MonitorParams, expect: dict,
+               lh_multiplier: int = 0, lh_frac: float = 0.25,
+               crash_at: int = 10, **meta) -> None:
     """Commit one confirmed breaking point as a self-contained case:
-    the scenario, the exact run knobs, the monitor params, and the
-    verdict a replay must reproduce."""
+    the scenario, the exact run knobs (local health included), the
+    monitor params, and the verdict a replay must reproduce."""
     doc = {
         "schema": CASE_SCHEMA,
         "scenario": json.loads(scenario.to_json()),
         "config": {"n": scenario.n, "t_fail": t_fail,
-                   "t_suspect": t_suspect, "seed": seed, "track": track},
+                   "t_suspect": t_suspect,
+                   "lh_multiplier": lh_multiplier, "lh_frac": lh_frac,
+                   "crash_at": crash_at,
+                   "seed": seed, "track": track},
         "monitor": dataclasses.asdict(params),
         "expect": expect,
         **meta,
@@ -396,22 +456,184 @@ def write_case(path, scenario: FaultScenario, *, t_fail: int,
     pathlib.Path(path).write_text(json.dumps(doc, indent=2) + "\n")
 
 
-def run_case(path) -> dict:
-    """Replay a committed regression case; ``reproduced`` is the tier-1
-    assertion: the verdict matches and (for violations) every expected
-    invariant fired."""
+def load_case(path) -> dict:
+    """Parse + schema-check one committed case file (shared by the
+    tensor replay below and the socket-engine runners in engines.py)."""
     doc = json.loads(pathlib.Path(path).read_text())
     if doc.get("schema") != CASE_SCHEMA:
         raise ValueError(f"{path}: not a {CASE_SCHEMA} case file")
+    return doc
+
+
+def case_verdict_ok(row: dict, expect: dict) -> bool:
+    """Whether a replay row reproduces the case's expectation — the one
+    predicate every engine's replay shares."""
+    ok = row["verdict"] == expect["verdict"]
+    for inv in expect.get("invariants", []):
+        ok = ok and inv in row["monitor"]["by_invariant"]
+    return bool(ok)
+
+
+def run_case_doc(doc: dict) -> dict:
+    """Replay one parsed case document on the tensor engine."""
     sc = FaultScenario.from_json(json.dumps(doc["scenario"]))
     c = doc["config"]
     row = run_scenario(
         c["n"], sc, t_fail=c["t_fail"], t_suspect=c["t_suspect"],
+        lh_multiplier=int(c.get("lh_multiplier", 0)),
+        lh_frac=float(c.get("lh_frac", 0.25)),
         seed=c["seed"], track=c["track"],
+        crash_at=int(c.get("crash_at", 10)),
         params=MonitorParams.from_dict(doc["monitor"]),
     )
     expect = doc["expect"]
-    ok = row["verdict"] == expect["verdict"]
-    for inv in expect.get("invariants", []):
-        ok = ok and inv in row["monitor"]["by_invariant"]
-    return {"reproduced": bool(ok), "expect": expect, "row": row}
+    return {"reproduced": case_verdict_ok(row, expect), "expect": expect,
+            "row": row}
+
+
+def run_case(path) -> dict:
+    """Replay a committed regression case; ``reproduced`` is the tier-1
+    assertion: the verdict matches and (for violations) every expected
+    invariant fired."""
+    return run_case_doc(load_case(path))
+
+
+# ---------------------------------------------------------------------------
+# the local-health knob surface — (outage size x lh knobs) absorption map
+# ---------------------------------------------------------------------------
+
+
+def _slim(row: dict) -> dict:
+    """One surface row, estimators only (the full violation windows make
+    a sizes x knobs artifact unreadable)."""
+    return {
+        "verdict": row["verdict"],
+        "by_invariant": row["monitor"]["by_invariant"],
+        "false_positives": row["estimators"].get("false_positives"),
+        "false_positive_rate": row["estimators"]["false_positive_rate"],
+        "worst_window_fpr": row["estimators"]["worst_window_fpr"],
+        "ttd_first_median": row["estimators"]["ttd_first_median"],
+        "detected": row["estimators"]["detected"],
+        "tracked_crashes": row["estimators"]["tracked_crashes"],
+    }
+
+
+def knob_surface(n: int, sizes, lh_points, *, t_fail: int = 3,
+                 t_suspect: int = 2, baseline_t_fail: int = 5,
+                 length: int = 10, start: int = 5, rounds: int = 35,
+                 seed: int = 0, track: int = 4, crash_at: int = 10,
+                 ledger: CampaignLedger | None = None) -> dict:
+    """Map the Lifeguard knob surface against correlated outages.
+
+    For every outage ``size`` x ``(lh_multiplier, lh_frac)`` point, runs
+    the outage scenario AND the quiet baseline at the SWIM knob
+    (t_fail=3 + t_suspect=2 — the SUSPECT_r08 production profile, total
+    window == the t_fail=5 reference), next to three reference rows: the
+    raw t_fail=5 detector on the same outage (the designed-in storm),
+    the lh-off SWIM knob on the same outage, and the lh-off quiet run.
+
+    A point ABSORBS a size when (a) its outage run's FPR sits in the
+    t_fail=5-class band — ``max(10x the t5 quiet baseline, 1e-6)``, the
+    exact floor ``verify_claims.suspicion_fpr`` already uses (the quiet
+    baseline is deterministic and measures 0.0; the floor admits the
+    1-2 FP events from entries already past the detection window when
+    the outage lands, ~7e-7, while rejecting the heal-race leak at
+    ~7e-5 and the full storm at ~4e-4 by two orders each), (b) its
+    outage run passes every monitor invariant, and (c) the
+    tracked-crash median TTD grew at most one round over the lh-off
+    QUIET baseline — on the outage run AND on the point's own quiet run
+    (the stretch must not tax detection; the lh-off OUTAGE row is not a
+    usable TTD reference, its storm confirms the probes before they
+    crash).
+
+    ``crash_at`` is a load-bearing axis, not a nuisance parameter: the
+    probes' suspect windows overlap the outage's heal, and the surface
+    at several crash_at values is what exposed the HEAL RACE — an
+    observer whose rack refutations arrive staggered un-degrades while
+    its remaining rack entries are still stale and confirms them (fp
+    ~200 at crash_at >= 14, where the probe suspicions no longer cover
+    the gap).  See BASELINE.md's knob-surface summary.
+
+    Returns the surface document (LOCALHEALTH_r14.json's per-probe
+    shape): baselines, one row per (size, point), and the absorption
+    frontier.
+    """
+    from gossipfs_tpu.bench.run import tracked_crash_events
+
+    cfg0 = campaign_config(n, t_fail=t_fail, t_suspect=t_suspect)
+    _, crash_rounds, _ = tracked_crash_events(cfg0, rounds, track,
+                                              crash_at)
+    avoid = set(crash_rounds) | {cfg0.introducer}
+
+    def outage(size):
+        return make_scenario("outage", n, length, avoid=avoid,
+                             size=size, length=length, start=start)
+
+    def point_row(sc, tf, ts, m, f):
+        row = run_scenario(n, sc, t_fail=tf, t_suspect=ts,
+                           lh_multiplier=m, lh_frac=f, rounds=rounds,
+                           seed=seed, track=track, crash_at=crash_at)
+        if ledger is not None:
+            ledger.add(sc.name if sc is not None else "quiet", row)
+        return row
+
+    base = {
+        "t5_quiet": _slim(point_row(None, baseline_t_fail, 0, 0, 0.25)),
+        "lh_off_quiet": _slim(point_row(None, t_fail, t_suspect, 0, 0.25)),
+    }
+    base["t5_outage"] = {
+        str(s): _slim(point_row(outage(s), baseline_t_fail, 0, 0, 0.25))
+        for s in sizes
+    }
+    base["lh_off_outage"] = {
+        str(s): _slim(point_row(outage(s), t_fail, t_suspect, 0, 0.25))
+        for s in sizes
+    }
+
+    def growth(a, b):
+        if a is None or b is None:
+            return None
+        return a - b
+
+    fpr_floor = max(10 * base["t5_quiet"]["false_positive_rate"], 1e-6)
+    rows = []
+    for (m, f) in lh_points:
+        quiet = _slim(point_row(None, t_fail, t_suspect, m, f))
+        qg = growth(quiet["ttd_first_median"],
+                    base["lh_off_quiet"]["ttd_first_median"])
+        for s in sizes:
+            r = _slim(point_row(outage(s), t_fail, t_suspect, m, f))
+            og = growth(r["ttd_first_median"],
+                        base["lh_off_quiet"]["ttd_first_median"])
+            absorbed = (
+                r["false_positive_rate"] <= fpr_floor
+                and r["verdict"] == "pass"
+                and og is not None and og <= 1
+                and qg is not None and qg <= 1
+            )
+            rows.append({
+                "size": s, "lh_multiplier": m, "lh_frac": f,
+                "outage": r, "quiet": quiet,
+                "ttd_growth_outage": og, "ttd_growth_quiet": qg,
+                "absorbed": absorbed,
+            })
+    return {
+        "metric": "Lifeguard local-health knob surface vs correlated "
+                  "outages (tensor engine, deterministic campaign runs)",
+        "n": n, "t_fail": t_fail, "t_suspect": t_suspect,
+        "baseline_t_fail": baseline_t_fail,
+        "outage": {"length": length, "start": start},
+        "rounds": rounds, "seed": seed, "track": track,
+        "crash_at": crash_at,
+        "fpr_floor": fpr_floor,
+        "baselines": base,
+        "rows": rows,
+        "frontier": {
+            str(s): [
+                {"lh_multiplier": r["lh_multiplier"],
+                 "lh_frac": r["lh_frac"]}
+                for r in rows if r["size"] == s and r["absorbed"]
+            ]
+            for s in sizes
+        },
+    }

@@ -1,0 +1,436 @@
+"""Socket-engine campaign runners: the SAME committed case files, real
+transports.
+
+Round 13's campaigns ran only the tensor engine; the UDP and deploy
+lanes had never been driven through a fault family at all, even though
+``ScenarioRuntime`` implements every primitive per message.  This module
+closes that gap: :func:`run_case_engine` takes the SAME
+``gossipfs-campaign-case/v1`` files tier-1 replays on the tensor engine
+and drives them through
+
+  * the asyncio UDP cluster (``detector/udp.py`` — real datagrams on
+    localhost, the scenario armed at the ``UdpNode._send`` hook, crashes
+    as socket teardown), recording a ``gossipfs-obs/v1`` stream whose
+    ``round_tick`` rows carry the in-process ground truth
+    (``UdpCluster.run(emit_round_ticks=True)``), or
+  * the per-process deployment (``deploy/launcher.py`` — one OS process
+    per node, the rule table pushed over the control plane with the
+    round-14 bounded-backoff RPC discipline, crashes as ``kill -9``,
+    events tailed from the per-node ``node<i>.log`` schema streams),
+
+then feeds the recorded stream through ``StreamMonitor.feed_jsonl`` —
+the SAME file-attachment seam, the SAME invariant table, the SAME
+``MonitorParams`` the case file carries — and requires the verdict to
+AGREE with the tensor replay's on every invariant both engines can
+check.  A campaign case that reproduces its storm (or its absorption)
+over real sockets is the strongest evidence the finding is protocol
+physics, not a tensor-engine artifact — and a deploy campaign that
+finishes at all under a correlated outage is itself evidence the
+control plane degrades gracefully (the round-14 backoff hardening).
+
+Real-socket runs are wall-clock and scheduling-jittered, so they are
+NOT bit-reproducible like tensor replays; what must reproduce is the
+VERDICT.  Committed cases are campaign-generated (family metadata in
+the case doc), so :func:`scale_case` can regenerate the same family
+point at a smaller n — the deploy lane's process budget is ~8 nodes,
+not 256 — with the severity knobs (duty cycles, loss rates, outage
+size) preserved and the fault cohorts re-picked around the scaled
+tracked victims.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import copy
+import json
+import pathlib
+import tempfile
+import time
+
+from gossipfs_tpu.campaigns.driver import (
+    campaign_rounds,
+    case_verdict_ok,
+    load_case,
+    make_scenario,
+    run_case_doc,
+)
+from gossipfs_tpu.obs.monitor import MonitorParams, StreamMonitor
+from gossipfs_tpu.scenarios.schedule import FaultScenario
+
+ENGINES = ("tensor", "udp", "deploy")
+
+
+def scale_case(doc: dict, n: int) -> dict:
+    """Regenerate a campaign case at a different cohort size.
+
+    Only campaign-GENERATED cases scale (they carry ``family`` /
+    ``axis`` / ``axis_value`` metadata plus the fixed knobs in the
+    scenario name): the scenario is re-made by the same
+    ``make_scenario`` rules at the new n — fractional cohorts (1/frac)
+    scale naturally, absolute knobs (outage size, flap duty cycle) are
+    preserved, and the fault nodes re-avoid the scaled tracked victims.
+    Hand-written cases without the metadata are rejected rather than
+    guessed at.
+    """
+    from gossipfs_tpu.bench.run import tracked_victims
+
+    if "family" not in doc or "axis" not in doc:
+        raise ValueError(
+            "case carries no campaign family metadata — only "
+            "campaign-generated cases can be scaled; run it at its "
+            "committed n instead")
+    out = copy.deepcopy(doc)
+    c = out["config"]
+    old_sc = FaultScenario.from_json(json.dumps(doc["scenario"]))
+    # fault_rounds: reconstruct the window length from the committed
+    # scenario (make_scenario's windows are [start, start + rounds))
+    rules = (*old_sc.flapping, *old_sc.link_faults, *old_sc.slow_nodes,
+             *old_sc.partitions, *old_sc.outages)
+    fault_rounds = max(r.end - r.start for r in rules)
+    knobs = {}
+    for kv in old_sc.name.split("-", 1)[1].split(","):
+        k, _, v = kv.partition("=")
+        knobs[k] = int(v)
+    # make_scenario excludes `start` from the name; recover it from the
+    # committed windows so a non-default start survives the rescale (the
+    # probe/heal phase alignment the surface shows is crash_at-coupled)
+    knobs["start"] = min(r.start for r in rules)
+    avoid = set(tracked_victims(n, int(c["track"]))) | {0}
+    sc = make_scenario(doc["family"], n, fault_rounds, avoid=avoid,
+                       **knobs)
+    out["scenario"] = json.loads(sc.to_json())
+    n_old = int(c["n"])
+    c["n"] = n
+    if float(c.get("lh_frac", 0.0)) > 0 and int(c.get("lh_multiplier", 0)):
+        # the Lifeguard degradation threshold is an ABSOLUTE suspect
+        # count in disguise (frac x listed ~ frac x n): a case tuned to
+        # sit between "4 simultaneous tracked probes" and "an 8-node
+        # rack" must keep those COUNTS when the cohort shrinks, so the
+        # fraction scales by n_old/n — 1/64 at n=256 (threshold ~4)
+        # becomes 1/16 at n=64 (threshold ~4), not 1/64 (threshold ~1,
+        # which would stretch on every routine probe)
+        c["lh_frac"] = min(float(c["lh_frac"]) * n_old / n, 0.5)
+    out["scaled_from"] = n_old
+    return out
+
+
+def _suspicion_params(c: dict):
+    if int(c.get("t_suspect", 0)) <= 0:
+        return None
+    from gossipfs_tpu.suspicion import SuspicionParams
+
+    return SuspicionParams(
+        t_suspect=int(c["t_suspect"]),
+        lh_multiplier=int(c.get("lh_multiplier", 0)),
+        lh_frac=float(c.get("lh_frac", 0.25)),
+    )
+
+
+def _monitor_row(trace_path, params: MonitorParams, n: int,
+                 crash_rounds: dict[int, int] | None = None) -> dict:
+    """Feed one written stream through a fresh monitor (the
+    ``feed_jsonl`` file-attachment seam — deliberately NOT the inline
+    recorder: the committed artifact is re-derivable from the file
+    alone) and shape the verdict like a campaign ledger row."""
+    mon = StreamMonitor(params=params, n=n)
+    if crash_rounds:
+        mon.observe_header({"n": n, "crash_rounds": {
+            str(k): v for k, v in crash_rounds.items()}})
+    mon.feed_jsonl(trace_path)
+    mon.finish()
+    s = mon.summary()
+    return {
+        "verdict": "violated" if mon.violations else "pass",
+        "monitor": mon.verdict(),
+        # round_tick rows seen: zero means the stream cannot evaluate
+        # the rolling-FPR invariant at all (deploy node logs carry no
+        # ground-truth ticks) — verdict_agreement drops fpr_storm then
+        "observed_round_ticks": s["rounds"],
+        "estimators": {
+            "false_positives": s["false_positives"],
+            "false_positive_rate": s["false_positive_rate"],
+            "worst_window_fpr": s["worst_window_fpr"],
+            "ttd_first_median": s["ttd_first_median"],
+            "detected": s["detected"],
+            "tracked_crashes": s["tracked_crashes"],
+        },
+        "violations": s["violations"],
+    }
+
+
+def verdict_agreement(tensor_row: dict, engine_row: dict) -> dict:
+    """Per-invariant agreement over the invariants BOTH engines checked.
+
+    The UDP lane checks the full table (its ``round_tick`` rows carry
+    ground truth); the deploy lane has no ground-truth FPR, so its
+    stream never grows ``fpr_storm`` windows — comparing only the
+    intersection keeps the agreement requirement honest instead of
+    vacuously failing on unknowables.
+    """
+    a = tensor_row["monitor"]
+    b = engine_row["monitor"]
+    compared = sorted(set(a["invariants_checked"])
+                      & set(b["invariants_checked"]))
+    if engine_row.get("observed_round_ticks") == 0:
+        # the invariant table lists fpr_storm whenever a threshold is
+        # set, but a stream with no round_tick rows never evaluated it
+        compared = [inv for inv in compared if inv != "fpr_storm"]
+    mismatch = [
+        inv for inv in compared
+        if bool(a["by_invariant"].get(inv)) != bool(
+            b["by_invariant"].get(inv))
+    ]
+    return {"match": not mismatch, "compared": compared,
+            "mismatched": mismatch}
+
+
+# ---------------------------------------------------------------------------
+# UDP engine
+# ---------------------------------------------------------------------------
+
+
+def _free_udp_base(n: int) -> int:
+    """A UDP port window with room for ``n`` sockets — the launcher's
+    bind-and-hold probe (ONE owner), UDP-only: two concurrent campaign
+    runners (a tier-1 smoke racing a committed-artifact run) must not
+    land on the same window and cross-talk their clusters (observed: a
+    fixed base_port made two overlapping runs merge memberships)."""
+    from gossipfs_tpu.deploy.launcher import _free_port_base
+
+    return _free_port_base(n, tcp=False)
+
+
+async def _udp_case(doc: dict, trace: str, period: float,
+                    warmup_timeout: float) -> dict[int, int]:
+    """Drive one case on an in-process UdpCluster; returns the crash
+    schedule ({victim: round}) for the monitor's TTD accounting."""
+    from gossipfs_tpu.bench.run import tracked_victims
+    from gossipfs_tpu.detector.udp import UdpCluster
+    from gossipfs_tpu.obs.recorder import FlightRecorder
+
+    c = doc["config"]
+    n = int(c["n"])
+    sc = FaultScenario.from_json(json.dumps(doc["scenario"]))
+    crash_at = int(c.get("crash_at", 10))
+    bound = doc["monitor"].get("reconverge_bound") or (int(c["t_fail"]) + 6)
+    rounds = campaign_rounds(sc.horizon, crash_at, bound)
+    victims = tracked_victims(n, int(c["track"]))
+
+    from gossipfs_tpu.config import SimConfig
+
+    cluster = UdpCluster(
+        n, base_port=_free_udp_base(n), period=period,
+        t_fail=int(c["t_fail"]),
+        t_cooldown=max(12, int(c["t_fail"]) + 4), fresh_cooldown=True,
+        suspicion=_suspicion_params(c),
+        # the campaign protocol profile — the same knobs
+        # campaigns.campaign_config sets on the tensor engine (random
+        # log-fanout push, gossip-only removal): verdict agreement must
+        # compare PROTOCOLS, not the reference ring's O(N)-tick event
+        # propagation (see UdpCluster's push notes)
+        push="random", fanout=SimConfig.log_fanout(n),
+        remove_broadcast=False,
+    )
+    await cluster.start_all()
+    try:
+        # fully-joined steady-state start, like the tensor campaign's
+        # init_state (the O(N^2) protocol boot takes minutes at
+        # campaign cohort sizes), then a short warmup OFF the
+        # observational round clock (nodes tick on their own heartbeat
+        # tasks; cluster._round stays 0, so the recorded stream's
+        # rounds are scenario-relative like the tensor trace's) until
+        # every counter is past the hb<=1 detection grace
+        cluster.seed_full_membership()
+        deadline = time.monotonic() + warmup_timeout
+        while time.monotonic() < deadline:
+            full = all(
+                len(node.members) == n
+                and min(m.hb for m in node.members.values()) > 1
+                for node in cluster.nodes
+            )
+            if full:
+                break
+            await asyncio.sleep(period)
+        else:
+            raise TimeoutError(
+                f"udp cluster (n={n}) did not converge within "
+                f"{warmup_timeout}s of warmup")
+
+        rec = FlightRecorder(trace, source="udp-campaign", n=n,
+                             case=doc.get("family", "case"),
+                             crash_rounds={str(v): crash_at
+                                           for v in victims})
+        cluster.attach_recorder(rec)
+        cluster.load_scenario(sc)
+        for r in range(rounds):
+            if r == crash_at:
+                for v in victims:
+                    cluster.crash(v)
+            await cluster.run(1, emit_round_ticks=True)
+        rec.close()
+        return {v: crash_at for v in victims}
+    finally:
+        cluster.stop_all()
+
+
+def run_case_udp(doc: dict, *, period: float = 0.05,
+                 trace: str | None = None,
+                 warmup_timeout: float = 60.0) -> dict:
+    """One case on the asyncio UDP engine; returns the ledger-row shape
+    plus the written trace path (re-feed it through
+    ``StreamMonitor.feed_jsonl`` to re-derive the verdict)."""
+    if trace is None:
+        trace = tempfile.mktemp(prefix="udp_case_", suffix=".jsonl")
+    crash_rounds = asyncio.run(
+        _udp_case(doc, trace, period, warmup_timeout))
+    row = _monitor_row(trace, MonitorParams.from_dict(doc["monitor"]),
+                       int(doc["config"]["n"]),
+                       crash_rounds=crash_rounds)
+    row.update(engine="udp", trace=str(trace), period=period)
+    return row
+
+
+# ---------------------------------------------------------------------------
+# deploy engine
+# ---------------------------------------------------------------------------
+
+
+def _merge_streams(paths) -> str:
+    """Stable round-order merge of several node logs into one stream
+    file feed_jsonl can tail (tools/timeline.py's merge semantics:
+    concatenate, stable-sort by round — per-node logs are already
+    round-ordered)."""
+    from gossipfs_tpu.obs import schema
+    from gossipfs_tpu.obs.recorder import load_stream
+
+    events = []
+    for p in paths:
+        _, evs = load_stream(p)
+        events.extend(evs)
+    events.sort(key=lambda e: e.round)
+    out = tempfile.mktemp(prefix="deploy_case_", suffix=".jsonl")
+    with open(out, "w", encoding="utf-8") as f:
+        f.write(schema.dumps(schema.header("deploy-campaign")) + "\n")
+        for e in events:
+            f.write(schema.dumps(e.to_record()) + "\n")
+    return out
+
+
+def run_case_deploy(doc: dict, *, period: float = 0.1,
+                    trace: str | None = None) -> dict:
+    """One case on the per-process deployment.
+
+    Spawns the launcher cluster, pushes the scenario + suspicion params
+    over the (backoff-hardened) control plane, ``kill -9``s the tracked
+    victims at the case's crash round, and tails the per-node
+    ``node<i>.log`` schema streams through the monitor.  The deploy
+    daemons have no ground-truth aliveness, so the verdict covers the
+    invariants their streams can carry (``verdict_agreement`` compares
+    only those against the tensor run).
+    """
+    from gossipfs_tpu.bench.run import tracked_victims
+    from gossipfs_tpu.deploy.launcher import Cluster
+
+    c = doc["config"]
+    n = int(c["n"])
+    sc = FaultScenario.from_json(json.dumps(doc["scenario"]))
+    crash_at = int(c.get("crash_at", 10))
+    bound = doc["monitor"].get("reconverge_bound") or (int(c["t_fail"]) + 6)
+    rounds = campaign_rounds(sc.horizon, crash_at, bound)
+    victims = tracked_victims(n, int(c["track"]))
+
+    cluster = Cluster(n, period=period, t_fail=int(c["t_fail"]))
+    try:
+        cluster.start()
+        sus = _suspicion_params(c)
+        if sus is not None:
+            acked = cluster.load_suspicion(sus)
+            if len(acked) != n:
+                raise RuntimeError(f"suspicion push acked by {acked}")
+        acked = cluster.load_scenario(sc)
+        if len(acked) != n:
+            raise RuntimeError(f"scenario push acked by {acked}")
+        # scenario-relative clock: each node anchored its windows at the
+        # push; read the survivors' round counters to place the crashes
+        r0 = max((line.get("round", 0)
+                  for line in cluster.vitals()), default=0)
+        time.sleep(crash_at * period)
+        for v in victims:
+            cluster.kill9(v)
+        time.sleep(max(rounds - crash_at, 0) * period)
+        logs = [str(pathlib.Path(cluster.root) / f"node{i}.log")
+                for i in range(n)]
+    finally:
+        cluster.stop()
+
+    merged = _merge_streams(logs)
+    # shift the monitor clocks to the arming-relative frame: the crash
+    # landed ~crash_at rounds after the push-time round r0
+    row = _monitor_row(
+        merged, MonitorParams.from_dict(doc["monitor"]), n,
+        crash_rounds={v: r0 + crash_at for v in victims})
+    if trace is not None:
+        pathlib.Path(merged).rename(trace)
+        merged = trace
+    row.update(engine="deploy", trace=str(merged), period=period,
+               arming_round=r0)
+    return row
+
+
+# ---------------------------------------------------------------------------
+# the one entry tools/campaign.py --engine calls
+# ---------------------------------------------------------------------------
+
+
+def run_case_engine(path, engine: str = "udp", *, scale_n: int | None = None,
+                    period: float | None = None,
+                    trace: str | None = None) -> dict:
+    """Drive a committed case file through a socket engine and require
+    its monitor verdict to agree with the tensor replay's.
+
+    Returns ``{"reproduced": ..., "agreement": {...}, "tensor": ...,
+    "engine_row": ...}`` — ``reproduced`` is True iff the socket
+    verdict reproduces the case's expectation AND agrees with the
+    tensor run on every invariant both checked.
+    """
+    if engine not in ENGINES:
+        raise ValueError(f"unknown engine {engine!r}; pick from {ENGINES}")
+    doc = load_case(path)
+    if scale_n is not None:
+        doc = scale_case(doc, scale_n)
+    tensor = run_case_doc(doc)
+    if engine == "tensor":
+        return {**tensor, "engine": "tensor", "n": doc["config"]["n"]}
+    if engine == "udp":
+        row = run_case_udp(doc, **({"period": period} if period else {}),
+                           trace=trace)
+    else:
+        row = run_case_deploy(doc, **({"period": period} if period else {}),
+                              trace=trace)
+    agreement = verdict_agreement(tensor["row"], row)
+    # the cross-engine contract is AGREEMENT with the tensor replay on
+    # every invariant both checked.  The case's own expectation applies
+    # on top only at the COMMITTED cohort size: a rescaled run's
+    # breaking point legitimately moves (the absorption knife-edge is
+    # cohort-sized — see scale_case / the n=64 twin's finding), so there
+    # the tensor replay of the SAME scaled doc is the reference.
+    reproduced = agreement["match"]
+    expect_ok = None
+    if doc.get("scaled_from") is None and (
+        set(doc["expect"].get("invariants", []))
+        <= set(row["monitor"]["invariants_checked"])
+    ):
+        expect_ok = case_verdict_ok(row, doc["expect"])
+        reproduced = reproduced and expect_ok
+    return {
+        "engine": engine,
+        "n": doc["config"]["n"],
+        "scaled_from": doc.get("scaled_from"),
+        "reproduced": bool(reproduced),
+        "expect_reproduced": expect_ok,
+        "agreement": agreement,
+        "expect": doc["expect"],
+        "tensor_verdict": tensor["row"]["verdict"],
+        "engine_verdict": row["verdict"],
+        "engine_row": row,
+    }

@@ -196,16 +196,16 @@ class SimConfig:
                                      # crash-on-timeout.  Requires the
                                      # gossip-only protocol mode
                                      # (remove_broadcast off + fresh
-                                     # cooldown).  Round 11: runs on
-                                     # every merge kernel and both
-                                     # elementwise forms (the lifecycle
-                                     # is fused into the rr/SWAR fast
-                                     # path); one graceful degradation —
-                                     # lh_multiplier > 0 needs
-                                     # per-receiver SUSPECT counts the rr
-                                     # kernel doesn't carry, so those
-                                     # runs take the stripe/XLA merge
-                                     # (core/rounds._use_rr), same bits
+                                     # cooldown).  Round 11 fused the
+                                     # lifecycle into every merge kernel
+                                     # and both elementwise forms; round
+                                     # 14 fused the Lifeguard stretch
+                                     # too (lh_multiplier > 0: the rr
+                                     # scan carries per-receiver SUSPECT
+                                     # counts and the kernel applies the
+                                     # stretched confirm threshold as a
+                                     # per-row select on flags bit 4) —
+                                     # no degradation remains
     fused_tick: str = "auto"         # "auto": rounds with no join/leave events
                                      # and remove_broadcast off fuse the
                                      # heartbeat tick (bump/detect/cooldown)

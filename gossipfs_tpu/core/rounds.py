@@ -1604,14 +1604,12 @@ def _use_rr(config: SimConfig, n: int, nloc: int) -> bool:
         or config.fused_tick != "auto"
     ):
         return False
-    if config.suspicion is not None and config.suspicion.lh_multiplier > 0:
-        # the Lifeguard local-health stretch derives a per-receiver
-        # confirmation threshold from per-receiver SUSPECT counts, which
-        # the rr kernel does not carry — such runs degrade gracefully to
-        # the stripe/XLA merge (same bits, slower path); the plain
-        # lifecycle (lh_multiplier == 0, the SUSPECT_r08 production knob)
-        # is fully fused
-        return False
+    # Round 14: the Lifeguard local-health stretch (lh_multiplier > 0)
+    # is fused too — the scan carries the per-receiver SUSPECT counts
+    # (a kernel output, like the member counts), derives the degraded
+    # mask outside the kernel, and the kernel applies the stretched
+    # confirmation threshold as a per-row select on flags bit 4.  The
+    # old stripe/XLA degradation is gone.
     if config.topology == "random_arc" and (
         config.n % merge_pallas.ARC_CHUNK
         or not 1 < config.fanout <= merge_pallas.ARC_CHUNK
@@ -1697,7 +1695,7 @@ def _scan_rounds_rr(
     tr = lambda a: a.transpose(1, 0, 2, 3)  # noqa: E731
     hb4 = tr(state.hb)
     as4 = merge_pallas.pack_age_status(tr(state.age), tr(state.status))
-    hb4, as4, alive, hb_base, rnd, _, mcarry, per_round = (
+    hb4, as4, alive, hb_base, rnd, _, _, mcarry, per_round = (
         _scan_rounds_rr_packed(
             hb4, as4, state.alive, state.hb_base, state.round,
             config, key, events, crash_rate, churn_ok, mcarry0,
@@ -1791,6 +1789,7 @@ def _scan_rounds_rr_packed(
     churn_ok: jax.Array | None,
     mcarry0: MetricsCarry | None = None,
     counts0: jax.Array | None = None,
+    sus_counts0: jax.Array | None = None,
     ctx: ShardCtx = LOCAL_CTX,
     scenario=None,
 ) -> tuple:
@@ -1810,8 +1809,18 @@ def _scan_rounds_rr_packed(
     ``hb_base0``/``mcarry0`` are the shard's per-subject slices, and
     ``alive``/``counts``/events stay replicated.  The kernel gets the
     shard's global column offset for its diagonal mask; the only
-    cross-shard traffic is the [N]-vector member-count psum and the
-    scalar metric psums — the row gather never leaves the chip.
+    cross-shard traffic is the [N]-vector member-count psum (joined by
+    the [N]-vector suspect-count psum on lh-armed runs — round 14's
+    local-health lane) and the scalar metric psums — the row gather
+    never leaves the chip.
+
+    ``sus_counts0``: the carried per-receiver SUSPECT counts (the
+    local-health lane, ``config.suspicion.lh_multiplier > 0`` only);
+    None computes them from the packed lanes, exactly like ``counts0``.
+    The degraded mask anchors on the pre-tick status — on this path the
+    previous round's post-merge status, which the kernel counts on the
+    side (``suspect_cnt``) — matching the XLA ``_tick``'s ``status0``
+    anchor bit for bit.
     """
     from gossipfs_tpu.ops import merge_pallas
 
@@ -1847,25 +1856,37 @@ def _scan_rounds_rr_packed(
         rows = jl + ctx.offset         # the diagonal sits at global row j
         return arr4[jl // c_blk, rows, (jl % c_blk) // lane, jl % lane]
 
-    if counts0 is None:
+    lh = sus is not None and sus.lh_multiplier > 0
+    if counts0 is None or (lh and sus_counts0 is None):
         # a full pass over the packed lane; per-round drivers
         # (detector.sim.PackedDetector) thread the carried counts back in
         # instead of paying it every advance.  Listed = MEMBER | SUSPECT
         # under suspicion (a suspect still counts toward min_group) —
         # status bit 0 is the listed bit in the core/state.py encoding
         st0 = merge_pallas.unpack_age_status(as4)[1]
-        listed0 = (st0 & 1) == 1 if sus is not None else st0 == MEMBER
-        counts0 = ctx.psum(jnp.sum(
-            listed0.astype(jnp.int32),
-            axis=(0, 2, 3),
-        ))
+        if counts0 is None:
+            listed0 = (st0 & 1) == 1 if sus is not None else st0 == MEMBER
+            counts0 = ctx.psum(jnp.sum(
+                listed0.astype(jnp.int32),
+                axis=(0, 2, 3),
+            ))
+        if lh and sus_counts0 is None:
+            # the local-health lane's initial per-receiver suspect counts
+            sus_counts0 = ctx.psum(jnp.sum(
+                (st0 == SUSPECT).astype(jnp.int32),
+                axis=(0, 2, 3),
+            ))
 
     class _Cols(NamedTuple):  # what _round_stats/_update_carry consume
         alive: jax.Array
         n: int
 
     def step(carry, ev: RoundEvents):
-        hb4, as4, alive0, hb_base, rnd, mc, counts = carry
+        if lh:
+            hb4, as4, alive0, hb_base, rnd, mc, counts, sus_counts = carry
+        else:
+            hb4, as4, alive0, hb_base, rnd, mc, counts = carry
+            sus_counts = None
         k = jax.random.fold_in(key, rnd)
         k_edge, k_churn = jax.random.split(k)
         crash = ev.crash | ev.leave
@@ -1894,11 +1915,23 @@ def _scan_rounds_rr_packed(
             # equivalent of rewriting all its out-edges (the per-edge
             # form aligned arcs don't have)
             muted = ~scn_sends_mask(scenario, n, rnd)
+        lh_deg = None
+        if lh:
+            # Lifeguard degraded mask — the SAME float32 compare as the
+            # XLA _tick's status0-anchored count branch (and runtime.py's
+            # ``degraded``, given lh_frac as an exact binary fraction):
+            # an anomalous fraction of this receiver's listed entries
+            # simultaneously SUSPECT.  The carried counts ARE the
+            # pre-tick counts on this path (post-merge status of round
+            # t-1 == pre-tick status of round t under the lean model).
+            lh_deg = (sus_counts.astype(jnp.float32)
+                      > sus.lh_frac * counts.astype(jnp.float32))
         flags = (
             active.astype(jnp.int32)
             + refresher.astype(jnp.int32) * 2
             + alive.astype(jnp.int32) * 4
             + (muted.astype(jnp.int32) * 8 if muted is not None else 0)
+            + (lh_deg.astype(jnp.int32) * 16 if lh_deg is not None else 0)
         ).astype(jnp.int8)
         # LANE-compacted flags layout ([N/LANE, LANE] row-major, 1 B/row
         # of kernel VMEM instead of the lane-replicated LANE B/row); the
@@ -1926,7 +1959,8 @@ def _scan_rounds_rr_packed(
                 # at the receiver — the kernel gathers the receiver's own
                 # view row, a no-op merge (scenarios/tensor.py)
                 edges = scn_filter_edges(scenario, edges, rnd, k_scn)
-        hb2, as2, cnt_incl, ndet, fobs, rcnt, nsus, nref, suscnt = (
+        (hb2, as2, cnt_incl, ndet, fobs, rcnt, nsus, nref, suscnt,
+         *lh_out) = (
             merge_pallas.resident_round_blocked(
                 edges, hb4, as4, flags,
                 sa.reshape(subj_shape), sb.reshape(subj_shape),
@@ -1941,6 +1975,7 @@ def _scan_rounds_rr_packed(
                 rotate=config.rr_rotate != "off",
                 suspect=int(SUSPECT) if sus is not None else None,
                 t_suspect=sus.t_suspect if sus is not None else 0,
+                lh_multiplier=sus.lh_multiplier if lh else 0,
                 edge_filter=edge_filter,
             )
         )
@@ -1952,13 +1987,18 @@ def _scan_rounds_rr_packed(
         # strided gather, ~7x slower over the 33 MB buffer).  Sharded:
         # each shard's rcnt covers its own stripes — the psum completes
         # the per-receiver count (the scan's one [N]-vector collective)
-        if rcnt.size == n:
-            counts_local = rcnt.reshape(n).astype(jnp.int32)
-        else:
-            counts_local = jnp.sum(
-                rcnt.reshape(n, -1), axis=1, dtype=jnp.int32
-            ) // lane
-        counts_next = ctx.psum(counts_local)
+        def recv_count_vec(cnt):
+            if cnt.size == n:
+                return cnt.reshape(n).astype(jnp.int32)
+            return jnp.sum(cnt.reshape(n, -1), axis=1, dtype=jnp.int32) // lane
+
+        counts_next = ctx.psum(recv_count_vec(rcnt))
+        sus_counts_next = None
+        if lh:
+            # the local-health lane: the kernel's per-receiver suspect
+            # counts (same two forms as rcnt) become next round's
+            # degraded-mask input; psum completes them across shards
+            sus_counts_next = ctx.psum(recv_count_vec(lh_out[0]))
         cols = _Cols(alive=alive, n=n)
         n_det = ndet.reshape(nloc)
         first_obs = fobs.reshape(nloc)
@@ -1988,16 +2028,21 @@ def _scan_rounds_rr_packed(
         rejoined = jnp.zeros_like(alive)  # constant: resets fold away
         mc = _update_carry(mc, cols, rejoined, any_fail, first_obs, rnd,
                            ctx, member_col=member_col, any_suspect=any_sus)
-        return (hb2, as2, alive, store_base, rnd + 1, mc, counts_next), metrics
+        out_carry = (hb2, as2, alive, store_base, rnd + 1, mc, counts_next)
+        if lh:
+            out_carry = out_carry + (sus_counts_next,)
+        return out_carry, metrics
 
     if mcarry0 is None:
         mcarry0 = MetricsCarry.init(nloc)
-    (hb4, as4, alive, hb_base, rnd, mcarry, counts), per_round = lax.scan(
-        step,
-        (hb4, as4, alive0, hb_base0, round0, mcarry0, counts0),
-        events,
-    )
-    return hb4, as4, alive, hb_base, rnd, counts, mcarry, per_round
+    carry0 = (hb4, as4, alive0, hb_base0, round0, mcarry0, counts0)
+    if lh:
+        carry0 = carry0 + (sus_counts0,)
+    final, per_round = lax.scan(step, carry0, events)
+    (hb4, as4, alive, hb_base, rnd, mcarry, counts, *lh_tail) = final
+    sus_counts = lh_tail[0] if lh else None
+    return (hb4, as4, alive, hb_base, rnd, counts, sus_counts, mcarry,
+            per_round)
 
 
 def _scan_rounds(

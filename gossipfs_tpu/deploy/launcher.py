@@ -26,18 +26,23 @@ import sys
 import tempfile
 import time
 
+from gossipfs_tpu.shim import retry
 from gossipfs_tpu.shim.client import ShimClient
 
 
-def _free_port_base(span: int) -> int:
+def _free_port_base(span: int, *, tcp: bool = True, udp: bool = True) -> int:
     """A base port with ``span`` free ports above it.
 
-    Probes EVERY port in the window — TCP and UDP both, since the cluster
-    binds gossip sockets on UDP and RPC servers on TCP — by bind-and-hold
-    before releasing the lot (round-5 advisor: the old single-ephemeral
-    probe let two concurrent clusters land overlapping windows and
-    cross-talk).  A race remains between release and the cluster's own
-    binds, but it is milliseconds wide instead of window-sized.
+    Probes EVERY port in the window — TCP and/or UDP per the flags; the
+    deploy cluster needs both (gossip sockets on UDP, RPC servers on
+    TCP), the in-process udp campaign runner (campaigns/engines.py)
+    UDP only — by bind-and-hold before releasing the lot (round-5
+    advisor: the old single-ephemeral probe let two concurrent clusters
+    land overlapping windows and cross-talk; round 14 re-observed the
+    same failure between a tier-1 udp smoke and a concurrent campaign
+    run on a fixed base port).  A race remains between release and the
+    cluster's own binds, but it is milliseconds wide instead of
+    window-sized.
     """
     for _ in range(64):
         s = socket.socket()
@@ -49,12 +54,14 @@ def _free_port_base(span: int) -> int:
         held: list[socket.socket] = []
         try:
             for p in range(base, base + span):
-                t = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-                t.bind(("127.0.0.1", p))
-                held.append(t)
-                u = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-                u.bind(("127.0.0.1", p))
-                held.append(u)
+                if tcp:
+                    t = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                    t.bind(("127.0.0.1", p))
+                    held.append(t)
+                if udp:
+                    u = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                    u.bind(("127.0.0.1", p))
+                    held.append(u)
         except OSError:
             continue
         finally:
@@ -68,7 +75,8 @@ class Cluster:
     """n node processes + per-node ShimClients."""
 
     def __init__(self, n: int, period: float = 0.1, root: str | None = None,
-                 rpc_timeout: float = 5.0, t_fail: int = 5):
+                 rpc_timeout: float = 5.0, t_fail: int = 5,
+                 ctrl_timeout: float = 2.0):
         self.n = n
         self.period = period
         self.t_fail = t_fail  # detection timeout in rounds (slave.go:24);
@@ -82,6 +90,13 @@ class Cluster:
         # on a loaded 1-core host the reference-size workload (5-10 MB,
         # bench/ref_workflow.py) needs deadlines past the 5 s default
         self.rpc_timeout = rpc_timeout
+        # per-RPC deadline for the small idempotent CONTROL-PLANE verbs
+        # (scenario/suspicion pushes, vitals, status): far shorter than
+        # the data-plane timeout — a dead node should cost a campaign
+        # runner ~2 s, not 5+ s per probe — with transient failures
+        # retried under the shared bounded-backoff discipline
+        # (shim/retry.py; round 14)
+        self.ctrl_timeout = ctrl_timeout
         base = _free_port_base(2 * n + 16)
         self.udp_base = base
         self.rpc_base = base + n + 8
@@ -182,6 +197,28 @@ class Cluster:
         self.procs[idx].send_signal(signal.SIGKILL)
         self.procs[idx].wait()
 
+    def _ctrl_call(self, idx: int, method: str, **request):
+        """One idempotent control-plane RPC to node ``idx`` under the
+        shared bounded-backoff discipline (shim/retry.py): a short
+        per-RPC deadline (``ctrl_timeout``) so a dead node fails fast,
+        transient codes (UNAVAILABLE mid-restart, DEADLINE_EXCEEDED on
+        a starved host, backpressure) retried with exponential backoff,
+        total retry time hard-bounded — replacing the round-7 one-shot
+        try/except fan-outs that silently dropped a push whenever a
+        node hiccuped for one scheduling quantum.  ``retries=False``
+        disables the ShimClient's own backpressure loop: THIS is the
+        one retry layer (nesting the two would multiply the bound —
+        ~4 x the inner 10 s ceiling instead of the ~3 s promised here).
+        """
+        return retry.call_with_backoff(
+            lambda: self.client(idx).call(
+                method, timeout=self.ctrl_timeout, retries=False,
+                **request),
+            retryable=retry.grpc_transient,
+            attempts=4, base_delay=0.1, max_delay=0.8,
+            total_deadline=3.0,
+        )
+
     def load_scenario(self, scenario) -> list[int]:
         """Push one scenarios.FaultScenario rule table to every live node
         (the deploy backend of the scenario engine).  Each node anchors
@@ -194,8 +231,9 @@ class Cluster:
             if proc.poll() is not None:
                 continue
             try:
-                ok = self.client(idx).call(
-                    "ScenarioLoad", file=scenario.name, data_b64=payload
+                ok = self._ctrl_call(
+                    idx, "ScenarioLoad", file=scenario.name,
+                    data_b64=payload,
                 ).get("ok")
             except Exception:
                 ok = False
@@ -214,8 +252,9 @@ class Cluster:
             if proc.poll() is not None:
                 continue
             try:
-                ok = self.client(idx).call(
-                    "SuspicionLoad", file="suspicion", data_b64=payload
+                ok = self._ctrl_call(
+                    idx, "SuspicionLoad", file="suspicion",
+                    data_b64=payload,
                 ).get("ok")
             except Exception:
                 ok = False
@@ -232,7 +271,7 @@ class Cluster:
             if proc.poll() is not None:
                 continue
             try:
-                lines += self.client(idx).call("Vitals").get("lines") or []
+                lines += self._ctrl_call(idx, "Vitals").get("lines") or []
             except Exception:
                 pass
         return lines
@@ -244,7 +283,7 @@ class Cluster:
             if proc.poll() is not None:
                 continue
             try:
-                lines += self.client(idx).call("ScenarioStatus").get(
+                lines += self._ctrl_call(idx, "ScenarioStatus").get(
                     "lines") or []
             except Exception:
                 pass

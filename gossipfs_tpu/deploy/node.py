@@ -73,6 +73,12 @@ class _Env:
         self.t_cooldown = t_cooldown
         self.min_group = min_group
         self.fresh_cooldown = True
+        # protocol-mode knobs (round 14, detector/udp.py): the deploy
+        # daemons keep the Go-parity wire behavior — ring pushes + the
+        # REMOVE broadcast (the reference's per-machine topology)
+        self.push = "ring"
+        self.fanout = 3
+        self.remove_broadcast = True
         # suspicion subsystem (suspicion/): SuspicionParams pushed over
         # the control plane (SuspicionLoad RPC); the UdpNode reads this
         # every tick, exactly like the in-process UdpCluster's attribute

@@ -627,12 +627,21 @@ class PackedDetector:
         self._pending_crash: set[int] = set()
         self._pending_join: list[int] = []
         self._events: list[DetectionEvent] = []
+        # local-health lane (round 14): lh-armed rr configs carry the
+        # per-receiver suspect counts between donated scans, exactly like
+        # the member counts (a fresh fully-joined cluster holds zero)
+        self._lh = (config.suspicion is not None
+                    and config.suspicion.lh_multiplier > 0)
+        self._sus_counts = (jnp.zeros((config.n,), jnp.int32)
+                            if self._lh else None)
 
-        def one_round(hb4, as4, alive, hb_base, rnd, counts, mc, ev):
+        def one_round(hb4, as4, alive, hb_base, rnd, counts, sus_counts,
+                      mc, ev):
             return R._scan_rounds_rr_packed(
                 hb4, as4, alive, hb_base, rnd, config,
                 # fold the round into the session key inside the core
                 self._key, ev, 0.0, None, mcarry0=mc, counts0=counts,
+                sus_counts0=sus_counts,
             )
 
         self._step = jax.jit(one_round, donate_argnums=(0, 1))
@@ -782,6 +791,12 @@ class PackedDetector:
                     )
                     if bool(ok):
                         mask[j] = False
+                        if self._lh:
+                            # the joiner's fresh row holds no SUSPECT
+                            # entries; other receivers' suspect counts
+                            # are untouched (the join add writes only
+                            # UNKNOWN entries)
+                            self._sus_counts = self._sus_counts.at[j].set(0)
                 self._pending_join.clear()
                 self._carry = (hb4, as4, alive, hb_base, rnd, counts)
                 self._mcarry = mc
@@ -791,11 +806,13 @@ class PackedDetector:
             hb4, as4, alive, hb_base, rnd, counts = self._carry
             round_idx = int(rnd)
             prev_first = self._mcarry.first_detect
-            (hb4, as4, alive, hb_base, rnd, counts, mc, per_round) = (
+            (hb4, as4, alive, hb_base, rnd, counts, sus_counts, mc,
+             per_round) = (
                 self._step(hb4, as4, alive, hb_base, rnd, counts,
-                           self._mcarry, ev)
+                           self._sus_counts, self._mcarry, ev)
             )
             self._carry = (hb4, as4, alive, hb_base, rnd, counts)
+            self._sus_counts = sus_counts
             self._mcarry = mc
             if int(per_round.true_detections[0]) + int(
                 per_round.false_positives[0]

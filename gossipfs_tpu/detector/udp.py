@@ -16,6 +16,7 @@ which is the whole point: consumers can't tell them apart.
 from __future__ import annotations
 
 import asyncio
+import random
 import time
 
 from gossipfs_tpu.detector.api import DetectionEvent
@@ -65,6 +66,9 @@ class UdpNode:
         # (params, runtime) pair so a mid-run re-arm rebuilds the table
         self._sus: tuple[object, object] | None = None
         self._last_refute_t = float("-inf")  # rate-limits REFUTE broadcasts
+        # per-node stream for the random-push topology draw (the
+        # north-star campaign profile; unused in the reference ring mode)
+        self._rng = random.Random(0x5EED ^ (idx * 2654435761))
 
     def _suspicion(self):
         """The armed SuspicionRuntime, tracking the host's params."""
@@ -341,22 +345,33 @@ class UdpNode:
             # carry (the flight-recorder parity tests compare sequences)
             c.record_detection(self.idx, addr)
             self._remove_member(addr)
-            msg = f"{addr}{CMD_SEP}REMOVE"
-            for peer in list(self.members):
-                if peer != self.addr:
-                    self._send(peer, msg)
+            if c.remove_broadcast:
+                msg = f"{addr}{CMD_SEP}REMOVE"
+                for peer in list(self.members):
+                    if peer != self.addr:
+                        self._send(peer, msg)
         # fail-list cooldown (slave.go:484-497)
         t_cool = c.t_cooldown * c.period
         for addr in list(self.fail_list):
             if self.fail_list[addr] < now - t_cool:
                 del self.fail_list[addr]
+        msg = self._encode()
+        if c.push == "random":
+            # north-star / campaign push topology: fanout random listed
+            # peers per tick (the tensor engine's topology='random' —
+            # event propagation in O(log N) rounds instead of the ring's
+            # O(N) position walk; see UdpCluster's push notes)
+            peers = [a for a in self.members if a != self.addr]
+            for peer in self._rng.sample(peers,
+                                         min(c.fanout, len(peers))):
+                self._send(peer, msg)
+            return
         # ring push to list positions self-1, self+1, self+2 (slave.go:515-542)
         ordered = sorted(self.members)
         if self.addr not in ordered:
             return  # removed-self edge case: no push targets defined
         i = ordered.index(self.addr)
         n = len(ordered)
-        msg = self._encode()
         for off in (-1, 1, 2):
             peer = ordered[(i + off) % n]
             if peer != self.addr:
@@ -377,6 +392,9 @@ class UdpCluster:
         fresh_cooldown: bool = False,
         scenario=None,
         suspicion=None,
+        push: str = "ring",
+        fanout: int | None = None,
+        remove_broadcast: bool = True,
     ):
         self.n = n
         self.period = period
@@ -384,6 +402,25 @@ class UdpCluster:
         self.t_cooldown = t_cooldown
         self.min_group = min_group
         self.fresh_cooldown = fresh_cooldown
+        # protocol-mode knobs (round 14): the reference defaults are the
+        # Go-parity wire behavior — ring-position pushes + the REMOVE
+        # broadcast.  ``push="random"`` + ``remove_broadcast=False`` is
+        # the NORTH-STAR profile the tensor campaigns run (SimConfig
+        # topology='random' + gossip-only dissemination): fanout random
+        # peers per tick, removal by local timeout only.  The ring walks
+        # an event (a heal's fresh counter, a refutation) ~3 positions
+        # per tick, so any fault longer than t_fail - n/3 rounds storms
+        # distant observers BY TOPOLOGY at campaign cohort sizes; the
+        # random push propagates in O(log N) rounds like the tensor
+        # engine, which is what makes cross-engine verdict agreement a
+        # protocol comparison instead of a topology artifact
+        # (campaigns/engines.py).
+        if push not in ("ring", "random"):
+            raise ValueError(f"unknown push mode: {push!r}")
+        self.push = push
+        self.fanout = fanout if fanout is not None else max(
+            2, (n - 1).bit_length())
+        self.remove_broadcast = remove_broadcast
         # suspicion subsystem (suspicion/): SuspicionParams or None; the
         # nodes read it every tick, so (dis)arming mid-run takes effect
         # on their next heartbeat
@@ -568,9 +605,52 @@ class UdpCluster:
                 node._send(intro.addr, f"{node.addr}{CMD_SEP}JOIN")
         await asyncio.sleep(self.period)
 
-    async def run(self, rounds: int) -> None:
+    def seed_full_membership(self) -> None:
+        """Start from the fully-joined steady state the tensor engine's
+        ``init_state`` models: every node lists everyone at hb 0 with a
+        fresh local stamp (inside the hb<=1 detection grace, exactly
+        like the sim's hb_grace).  The protocol boot instead funnels
+        N-1 JOINs through the introducer — an O(N^2) full-list push
+        burst that takes minutes (and drops datagrams) at campaign
+        cohort sizes; the campaign runner (campaigns/engines.py) seeds
+        and lets the counters flow for a couple of periods instead."""
+        now = time.monotonic()
+        addrs = [node.addr for node in self.nodes]
+        for node in self.nodes:
+            if node.alive:
+                node.members = {a: _Member(0, now) for a in addrs}
+
+    async def run(self, rounds: int, emit_round_ticks: bool = False) -> None:
+        """Advance the cluster clock ``rounds`` heartbeat periods.
+
+        ``emit_round_ticks`` (round 14, the socket campaign runner):
+        emit one ``round_tick`` schema event per period carrying the
+        ground truth this in-process engine KNOWS — n_alive and the
+        period's detection/false-positive deltas (plus the suspicion
+        counters when armed) — so a recorded udp stream feeds the
+        streaming monitor's rolling-FPR invariant exactly like a tensor
+        trace.  ``fp_suppressed`` stays absent (per-refute ground truth
+        is sim-only — the n/a-not-0 rule).
+        """
         for _ in range(rounds):
+            det0, fp0 = self._det_total, self._fp_total
+            sus0 = self.suspicion_status() if emit_round_ticks else None
             await asyncio.sleep(self.period)
+            if emit_round_ticks:
+                det_d = self._det_total - det0
+                fp_d = self._fp_total - fp0
+                detail = {
+                    "n_alive": len(self.alive_nodes()),
+                    "true_detections": det_d - fp_d,
+                    "false_positives": fp_d,
+                }
+                sus1 = self.suspicion_status()
+                if sus0 is not None and sus1 is not None:
+                    detail["suspects_entered"] = (
+                        sus1["suspects_entered"] - sus0["suspects_entered"])
+                    detail["refutations"] = (
+                        sus1["refutations"] - sus0["refutations"])
+                self._rec_cluster("round_tick", -1, **detail)
             self._round += 1
 
     # -- FailureDetector verbs (used inside the event loop) -----------------

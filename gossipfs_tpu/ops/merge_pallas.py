@@ -1458,7 +1458,8 @@ N_VEC = 11
 
 
 def _rr_tick_packed(hb, asl, act_r, ref_r, eye, thr_g, member, failed,
-                    t_fail, t_cooldown, suspect=None, confirm_thr=0):
+                    t_fail, t_cooldown, suspect=None, confirm_thr=0,
+                    confirm_thr_hi=0, lh_r=None):
     """The heartbeat tick over i32-widened hb + PACKED age|status.
 
     Mirrors core/rounds.py ``_tick`` (lean crash-only path: small-group
@@ -1481,11 +1482,21 @@ def _rr_tick_packed(hb, asl, act_r, ref_r, eye, thr_g, member, failed,
     enters SUSPECT (status bits 1 -> 3, the AGE LANE keeps running — it IS
     the suspicion clock, ``age - t_fail`` = rounds in SUSPECT), and a
     SUSPECT lane confirms to FAILED once ``age > confirm_thr``
-    (= t_fail + t_suspect; the rr path runs lh_multiplier == 0 — see
-    core/rounds._use_rr).  ``fail`` then carries the CONFIRMATIONS, the
+    (= t_fail + t_suspect).  ``fail`` then carries the CONFIRMATIONS, the
     lifecycle's actual failure declarations, exactly as the XLA ``_tick``.
     The confirm compare carries no ``~eye`` term either: the diagonal is
     never SUSPECT (self-suspicion needs ``stale``, which excludes self).
+
+    ``lh_r`` (round 14) arms the fused Lifeguard local-health stretch: a
+    per-ROW bool mask of receivers whose own view holds an anomalous
+    SUSPECT fraction (derived OUTSIDE the kernel from the carried
+    per-receiver suspect counts, riding flags bit 4).  A degraded row's
+    confirmation threshold is ``confirm_thr_hi``
+    (= t_fail + t_suspect * (1 + lh_multiplier)) instead of
+    ``confirm_thr`` — a one-select per-row threshold shift, so the
+    rr/SWAR fast path no longer degrades to stripe/XLA for
+    lh_multiplier > 0.  ``lh_r=None`` keeps the scalar compare
+    bit-identical to round 11.
     """
     st_bits = asl & 3
     st_mem = st_bits == member
@@ -1525,10 +1536,14 @@ def _rr_tick_packed(hb, asl, act_r, ref_r, eye, thr_g, member, failed,
         elig = st_mem & ~fail
     else:
         st_sus = st_bits == suspect
-        confirm = (
-            act_r & st_sus
-            & (asl > ((confirm_thr << 2) | suspect) - 128)
-        )
+        thr_b = ((confirm_thr << 2) | suspect) - 128
+        if lh_r is not None:
+            # Lifeguard stretch: degraded rows confirm at the stretched
+            # threshold (one per-row select; both byte constants static)
+            thr_b = jnp.where(
+                lh_r, ((confirm_thr_hi << 2) | suspect) - 128, thr_b
+            )
+        confirm = act_r & st_sus & (asl > thr_b)
         # member -> suspect is one status bit (1 -> 3): age bits unchanged
         # (the clock keeps running); both masks derive from the pre-write
         # status, so an entry spends >= 1 round SUSPECT before confirming
@@ -1621,7 +1636,7 @@ def _rr_merge_packed(hb, asl, best, recv, vec, member, unknown, age_clamp,
 
 def _rr_tick_view_swar(hb, asl, act_h, ref_h, vec, member, failed,
                        t_fail, t_cooldown, suspect=None, confirm_thr=0,
-                       send_h=None):
+                       confirm_thr_hi=0, lh_h=None, send_h=None):
     """SWAR mirror of :func:`_rr_tick_packed` (diagonal-free chunks) plus
     the gossip-view encode, over packed words.
 
@@ -1634,7 +1649,9 @@ def _rr_tick_view_swar(hb, asl, act_h, ref_h, vec, member, failed,
     CONFIRMATIONS when ``suspect`` arms the fused SWIM lifecycle —
     see :func:`_rr_tick_packed`).  ``send_h``: optional per-row
     sends-this-round hmask (scenario slow-sender mute — a muted row's
-    view lanes encode absent, its tick is untouched).
+    view lanes encode absent, its tick is untouched).  ``lh_h``: optional
+    per-row degraded hmask (flags bit 4) selecting the Lifeguard-
+    stretched ``confirm_thr_hi`` word — see :func:`_rr_tick_packed`.
     """
     st_bits = asl & swar.word(3)
     stm_h = swar.eq(st_bits, swar.word(member))
@@ -1660,10 +1677,15 @@ def _rr_tick_view_swar(hb, asl, act_h, ref_h, vec, member, failed,
         asl = swar.sel(swar.to_bytes(fail_h), swar.word(failed - 128), asl)
         elig_h = stm_h & ~fail_h
     else:
-        confirm_h = (
-            act_h & sus_pre_h
-            & swar.gts(asl, swar.word(((confirm_thr << 2) | suspect) - 128))
-        )
+        thr_w = swar.word(((confirm_thr << 2) | suspect) - 128)
+        if lh_h is not None:
+            # degraded rows take the stretched threshold word (flags are
+            # row-uniform, so all 4 bytes of a word agree)
+            thr_w = swar.sel(
+                swar.to_bytes(lh_h),
+                swar.word(((confirm_thr_hi << 2) | suspect) - 128), thr_w,
+            )
+        confirm_h = act_h & sus_pre_h & swar.gts(asl, thr_w)
         # member -> suspect: set status bit 1, age bits untouched (the
         # age lane IS the suspicion clock)
         asl = asl | (swar.to_bytes(stale_h) & swar.word(2))
@@ -1748,7 +1770,8 @@ def _rr_kernel(
     arc_rows: int = ARC_CHUNK, vslots: int = VSLOTS, arc_align: int = 1,
     rcnt_acc: bool = False, swar_mode: bool = False, ring: bool = False,
     flags_compact: bool = False, suspect: int | None = None,
-    confirm_thr: int = 0, edge_filter: bool = False, *, nstripes: int,
+    confirm_thr: int = 0, confirm_thr_hi: int = 0, lh_lane: bool = False,
+    edge_filter: bool = False, *, nstripes: int,
 ):
     # swar_mode: run the elementwise stages over packed 4-subject words
     # (see the SWAR section above _rr_tick_view_swar).  The view-build
@@ -1761,7 +1784,13 @@ def _rr_kernel(
     # suspect (round 11): the fused SWIM lifecycle — suspect/confirm in
     # the tick stages, refute-on-advance in the merge stages, plus three
     # per-subject suspicion reductions (entered / refuted / held-SUSPECT)
-    # accumulated exactly like ndet.  edge_filter (round 11): the
+    # accumulated exactly like ndet.  lh_lane (round 14): the Lifeguard
+    # local-health lane — flags bit 4 marks degraded receivers (derived
+    # outside from the carried per-receiver suspect counts), the confirm
+    # threshold becomes a per-row two-value select (confirm_thr vs
+    # confirm_thr_hi), and a per-RECEIVER post-merge SUSPECT count output
+    # (scnt, accumulated exactly like the rcnt member counts — both
+    # forms) feeds the NEXT round's degraded mask.  edge_filter: the
     # scenario-armed aligned-arc build — group maxes land in a FULL int8
     # T buffer (no W pass, no ring) and the per-receiver gather is an
     # nw-way masked max driven by the (base, group-match-bitmask) pairs
@@ -1799,9 +1828,13 @@ def _rr_kernel(
     def kernel(
         edges_ref, col0_ref, flags_all, vecs_ref, hb_any, as_any,
         hb_out, as_out, cnt_out, ndet_out, fobs_out, rcnt_out,
-        nsus_out, nref_out, sus_out,
-        stripe, best_scratch, vbuf, vsems, dbuf, flbuf, *rest,
+        nsus_out, nref_out, sus_out, *more,
     ):
+        # the local-health lane appends one output (the per-receiver
+        # suspect counts) between the fixed outputs and the scratch list
+        more = list(more)
+        scnt_out = more.pop(0) if lh_lane else None
+        stripe, best_scratch, vbuf, vsems, dbuf, flbuf, *rest = more
         # resident mode parks the TICKED lanes in VMEM during the
         # view-build pass, so the receiver sweep touches no HBM at all —
         # the round's wire drops to the 4 N^2 information floor (read
@@ -1811,6 +1844,7 @@ def _rr_kernel(
         # advances every age before store), so the sweep reconstructs the
         # fail mask with one compare.
         rest = list(rest)
+        sacc = rest.pop() if (rcnt_acc and lh_lane) else None
         racc = rest.pop() if rcnt_acc else None
         if resident:
             hb_res, as_res, *arc_scratch = rest
@@ -1950,7 +1984,7 @@ def _rr_kernel(
                     # chunks only — see _rr_tick_view_swar)
                     hbw = pltpu.bitcast(vbuf[slot, 0], jnp.int32)
                     aslw = pltpu.bitcast(vbuf[slot, 1], jnp.int32)
-                    send_h = None
+                    send_h = lh_h = None
                     if "noflags" in stub:
                         act_h = ref_h = jnp.int32(-1)
                     else:
@@ -1962,10 +1996,15 @@ def _rr_kernel(
                             # scenario mute (flag bit 3): the slow-sender
                             # rows send nothing this round
                             send_h = swar.eq(flw & swar.word(8), 0)
+                        if lh_lane:
+                            # Lifeguard degraded rows (flag bit 4)
+                            lh_h = swar.ne(flw & swar.word(16), 0)
                     hbw, aslw, _fail, enc = _rr_tick_view_swar(
                         hbw, aslw, act_h, ref_h, vecw, member, failed,
                         t_fail, t_cooldown, suspect=suspect,
-                        confirm_thr=confirm_thr, send_h=send_h,
+                        confirm_thr=confirm_thr,
+                        confirm_thr_hi=confirm_thr_hi, lh_h=lh_h,
+                        send_h=send_h,
                     )
                     if resident and "park" not in stub:
                         hb_res[pl.ds(c * chunk, chunk)] = pltpu.bitcast(
@@ -2014,7 +2053,7 @@ def _rr_kernel(
                                 tbuf_a.dtype)
 
                 def tick_view(eye):
-                    sends = None
+                    sends = lh_r = None
                     if "noflags" in stub:
                         act_r = ref_r = jnp.bool_(True)
                     else:
@@ -2023,12 +2062,15 @@ def _rr_kernel(
                         ref_r = (flb & 2) != 0
                         if edge_filter:
                             sends = (flb & 8) == 0  # scenario mute bit
+                        if lh_lane:
+                            lh_r = (flb & 16) != 0  # Lifeguard degraded
                     hb = vbuf[slot, 0].astype(jnp.int32)
                     asl = vbuf[slot, 1].astype(jnp.int32)
                     hb, asl, _fail, stm = _rr_tick_packed(
                         hb, asl, act_r, ref_r, eye, vec[V_THR_G],
                         member, failed, t_fail, t_cooldown,
                         suspect=suspect, confirm_thr=confirm_thr,
+                        confirm_thr_hi=confirm_thr_hi, lh_r=lh_r,
                     )
                     if resident and "park" not in stub:
                         # park the TICKED lanes: the receiver sweep reads
@@ -2299,6 +2341,8 @@ def _rr_kernel(
             hb_out[0] = raw_hb
             as_out[0] = raw_as
             rcnt_out[...] = jnp.zeros_like(rcnt_out)
+            if lh_lane:
+                scnt_out[...] = jnp.zeros_like(scnt_out)
 
             @pl.when(i == 0)
             def _():
@@ -2333,6 +2377,14 @@ def _rr_kernel(
                 listed_new = pltpu.bitcast(
                     swar.to_bytes(swar.ne(new_aslw & swar.L, 0)),
                     jnp.int8) != 0
+                if lh_lane:
+                    # per-receiver sums reduce over the subject axes, so
+                    # the 0/1-word trick (byte lanes < 256) cannot apply
+                    # — one byte-space mask, only on lh-armed runs
+                    lh_held = pltpu.bitcast(
+                        swar.to_bytes(swar.eq(new_aslw & swar.word(3),
+                                              swar.word(suspect))),
+                        jnp.int8) != 0
                 if sus_red:
                     # 0/1-byte counter WORDS (hmask sign bit -> per-byte
                     # one): the suspicion sums below reduce these int32
@@ -2368,6 +2420,8 @@ def _rr_kernel(
                     act_r, ref_r, eye, vec[V_THR_G],
                     member, failed, t_fail, t_cooldown,
                     suspect=suspect, confirm_thr=confirm_thr,
+                    confirm_thr_hi=confirm_thr_hi,
+                    lh_r=((flb & 16) != 0) if lh_lane else None,
                 )
 
             best = best_scratch[...].astype(jnp.int32)
@@ -2380,6 +2434,8 @@ def _rr_kernel(
             st_new = new_asl & 3
             if sus:
                 listed_new = (st_new == member) | (st_new == suspect)
+                if lh_lane:
+                    lh_held = st_new == suspect
                 if sus_red:
                     # post-tick (SUSPECT, age == t_fail + 1) == entered
                     # THIS round (see sus_new_byte above)
@@ -2459,30 +2515,44 @@ def _rr_kernel(
         # crashes the TPU lowering (layout.h implicit_dim check)
         if "rcnt" in stub:
             rcnt_out[...] = jnp.zeros_like(rcnt_out)
+            if lh_lane:
+                scnt_out[...] = jnp.zeros_like(scnt_out)
         else:
-            rc = jnp.sum(listed_new.astype(jnp.int32), axis=2)
-            rc = jnp.sum(rc, axis=1, keepdims=True)
-            if not rcnt_acc:
-                # int16 output: a per-stripe partial is <= cs*LANE <= 4096
-                rcnt_out[...] = jnp.broadcast_to(
-                    rc, (rc.shape[0], LANE)
-                ).astype(rcnt_out.dtype)
-            else:
-                rpl = r_blk // LANE
-                rc2 = rc.reshape(rpl, LANE)   # sublane -> lane relayout
-                arows = pl.ds(i * rpl, rpl)
+            rpl = r_blk // LANE
+            arows = pl.ds(i * rpl, rpl)
 
-                @pl.when(j == 0)
-                def _():
-                    racc[arows] = rc2
+            def recv_count(mask, out_ref, acc_ref):
+                """Per-receiver count of ``mask`` entries, in the same
+                two output forms as the member counts (the rc block
+                below IS this helper applied to listed_new)."""
+                c = jnp.sum(mask.astype(jnp.int32), axis=2)
+                c = jnp.sum(c, axis=1, keepdims=True)
+                if not rcnt_acc:
+                    # int16 output: a per-stripe partial <= cs*LANE <= 4096
+                    out_ref[...] = jnp.broadcast_to(
+                        c, (c.shape[0], LANE)
+                    ).astype(out_ref.dtype)
+                else:
+                    c2 = c.reshape(rpl, LANE)  # sublane -> lane relayout
 
-                @pl.when(j > 0)
-                def _():
-                    racc[arows] = racc[arows] + rc2
+                    @pl.when(j == 0)
+                    def _():
+                        acc_ref[arows] = c2
 
-                @pl.when((j == nstripes - 1) & (i == nblocks - 1))
-                def _():
-                    rcnt_out[...] = racc[...]
+                    @pl.when(j > 0)
+                    def _():
+                        acc_ref[arows] = acc_ref[arows] + c2
+
+                    @pl.when((j == nstripes - 1) & (i == nblocks - 1))
+                    def _():
+                        out_ref[...] = acc_ref[...]
+
+            recv_count(listed_new, rcnt_out, racc)
+            if lh_lane:
+                # the local-health lane's per-receiver suspect counts —
+                # next round's degraded mask derives from these outside
+                # the kernel (core/rounds._scan_rounds_rr_packed)
+                recv_count(lh_held, scnt_out, sacc)
 
         @pl.when(i == 0)
         def _():
@@ -2511,13 +2581,25 @@ def _rr_kernel(
     return kernel
 
 
+def _recv_cnt_spec(n: int, r_blk: int, use_acc: bool) -> "pl.BlockSpec":
+    """The per-receiver count output BlockSpec, shared by the member
+    counts and the local-health lane's suspect counts (one owner, so the
+    two forms cannot drift)."""
+    if use_acc:
+        return pl.BlockSpec((n // LANE, LANE), lambda j, i: (0, 0),
+                            memory_space=pltpu.VMEM)
+    return pl.BlockSpec((r_blk, LANE), lambda j, i: (i, j),
+                        memory_space=pltpu.VMEM)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
         "fanout", "member", "unknown", "failed", "age_clamp", "window",
         "t_fail", "t_cooldown", "block_r", "chunk", "interpret",
         "resident", "gather_unroll", "arc_align", "rcnt_acc", "elementwise",
-        "rotate", "suspect", "t_suspect", "edge_filter", "_stub",
+        "rotate", "suspect", "t_suspect", "lh_multiplier", "edge_filter",
+        "_stub",
     ),
 )
 def resident_round_blocked(
@@ -2549,6 +2631,7 @@ def resident_round_blocked(
     rotate: bool = True,
     suspect: int | None = None,
     t_suspect: int = 0,
+    lh_multiplier: int = 0,
     edge_filter: bool = False,
     _stub: str = "",
 ) -> tuple[jax.Array, ...]:
@@ -2579,8 +2662,13 @@ def resident_round_blocked(
       the kernel then window-maxes the view stripe once (O(log F)
       vectorized passes) and the per-receiver merge is a single load.
     * ``flags`` int8: bit 0 = active sender this round (alive & group >=
-      min_group), bit 1 = small-group refresher, bit 2 = alive.  Derived
-      per round from the carried member counts.  Two accepted layouts:
+      min_group), bit 1 = small-group refresher, bit 2 = alive, bit 3 =
+      scenario sender mute (edge_filter runs), bit 4 = Lifeguard-degraded
+      receiver (lh_multiplier > 0 runs — derived per round from the
+      carried per-receiver suspect counts; the confirm threshold is then
+      a per-row select between t_fail + t_suspect and t_fail + t_suspect
+      * (1 + lh_multiplier)).  Derived per round from the carried member
+      counts.  Two accepted layouts:
       LANE-COMPACTED [N/LANE, LANE] row-major (1 B/row — what capacity
       callers pass) or lane-replicated [N, LANE] (legacy); the wrapper
       converts to whichever layout the blocking admits (compact needs
@@ -2606,6 +2694,12 @@ def resident_round_blocked(
     count vector; ``rcnt_acc`` overrides the choice).  The counts feed
     the NEXT round's active/refresher split (carried by the scan — the
     member-count XLA pass is gone too).
+
+    ``lh_multiplier > 0`` (with ``suspect`` armed) appends ONE more
+    output: ``suspect_cnt`` — the per-receiver count of post-merge
+    SUSPECT entries, in exactly ``recv_cnt``'s two forms — which the
+    scan carries to derive the next round's flags-bit-4 degraded mask
+    (the Lifeguard local-health stretch, fully fused since round 14).
     """
     nc, n, cs, _ = hb.shape
     arc = fanout is not None
@@ -2627,6 +2721,21 @@ def resident_round_blocked(
                 f"age_clamp ({age_clamp}); the age lane is the suspicion "
                 f"clock (got t_fail={t_fail}, t_suspect={t_suspect})"
             )
+        if lh_multiplier and (
+            t_fail + t_suspect * (1 + lh_multiplier) >= age_clamp
+        ):
+            raise ValueError(
+                f"t_fail + t_suspect * (1 + lh_multiplier) must be < "
+                f"age_clamp ({age_clamp}); the stretched confirm window "
+                f"rides the same age-lane clock (got t_fail={t_fail}, "
+                f"t_suspect={t_suspect}, lh_multiplier={lh_multiplier})"
+            )
+    elif lh_multiplier:
+        raise ValueError(
+            "lh_multiplier > 0 (the Lifeguard local-health lane) "
+            "requires the fused SWIM lifecycle (suspect=...)"
+        )
+    lh_lane = suspect is not None and lh_multiplier > 0
     if edge_filter:
         if not arc or arc_align <= 1:
             raise ValueError(
@@ -2860,7 +2969,9 @@ def resident_round_blocked(
                    arc_rows=arc_rows, vslots=vslots, arc_align=arc_align,
                    rcnt_acc=use_acc, swar_mode=elementwise == "swar",
                    ring=ring, flags_compact=flags_compact, suspect=suspect,
-                   confirm_thr=t_fail + t_suspect, edge_filter=edge_filter,
+                   confirm_thr=t_fail + t_suspect,
+                   confirm_thr_hi=t_fail + t_suspect * (1 + lh_multiplier),
+                   lh_lane=lh_lane, edge_filter=edge_filter,
                    nstripes=nc),
         grid=(nc, n // r_blk),
         # in-place lane update: safe because every [row-block, stripe]
@@ -2892,17 +3003,14 @@ def resident_round_blocked(
             # block (N/LANE rows: 4 B/receiver, small enough to stay
             # resident for the entire grid), written once at the final
             # step from the compact accumulator
-            pl.BlockSpec(
-                (n // LANE, LANE), lambda j, i: (0, 0),
-                memory_space=pltpu.VMEM,
-            ) if use_acc else pl.BlockSpec(
-                (r_blk, LANE), lambda j, i: (i, j),
-                memory_space=pltpu.VMEM,
-            ),
+            _recv_cnt_spec(n, r_blk, use_acc),
             # suspicion reductions (round 11): suspects entered, refuted,
             # and held-SUSPECT per subject — zeros when suspicion is off
             subj_spec, subj_spec, subj_spec,
-        ],
+        ] + (
+            # the local-health lane's per-receiver suspect counts, in
+            # exactly the recv_cnt forms (round 14)
+            [_recv_cnt_spec(n, r_blk, use_acc)] if lh_lane else []),
         out_shape=[
             jax.ShapeDtypeStruct((nc, n, cs, LANE), jnp.int8),
             jax.ShapeDtypeStruct((nc, n, cs, LANE), jnp.int8),
@@ -2914,7 +3022,9 @@ def resident_round_blocked(
             jax.ShapeDtypeStruct((nc, cs, LANE), jnp.int32),
             jax.ShapeDtypeStruct((nc, cs, LANE), jnp.int32),
             jax.ShapeDtypeStruct((nc, cs, LANE), jnp.int32),
-        ],
+        ] + ([jax.ShapeDtypeStruct(
+            (n // LANE, LANE) if use_acc else (n, nc * LANE), cnt_dt)]
+            if lh_lane else []),
         scratch_shapes=[
             # aligned-arc mode never reads the stripe (write-only): a
             # token allocation keeps the kernel signature; the real
@@ -2932,7 +3042,10 @@ def resident_round_blocked(
         ] + rblock_scratch + arc_scratch + (
             # the accumulated form's LANE-COMPACTED count scratch
             # (persists across the whole grid; flushed at the final step)
-            [pltpu.VMEM((n // LANE, LANE), cnt_dt)] if use_acc else []),
+            # — doubled when the local-health lane accumulates suspect
+            # counts the same way (racc first, then sacc)
+            [pltpu.VMEM((n // LANE, LANE), cnt_dt)]
+            * ((1 + int(lh_lane)) if use_acc else 0)),
         compiler_params=_CompilerParams(
             vmem_limit_bytes=126 * 1024 * 1024),
         interpret=interpret,

@@ -12,8 +12,10 @@ The per-round merge gathers whole *rows* of the state by sender index
 of subjects, so the row gather needs **no communication at all** — each chip
 merges its slice of every node's table independently.  The only collectives
 XLA inserts are cheap [N]-vector reductions over the subject axis
-(member counts, detection aggregates), which ride ICI.  Row sharding, by
-contrast, would turn the gather into an all-gather of the full matrix.
+(member counts, detection aggregates, and — on lh-armed rr runs since
+round 14 — the per-receiver SUSPECT counts feeding the Lifeguard
+local-health lane), which ride ICI.  Row sharding, by contrast, would
+turn the gather into an all-gather of the full matrix.
 
 Everything goes through GSPMD: we annotate inputs with NamedSharding and let
 ``jax.jit`` partition the identical round kernel that runs single-chip.

@@ -27,11 +27,17 @@ merge_kernel notes):
     GROUP granularity (an aligned arc is F/align whole groups, so
     align-group-closed partition sides give exactly per-edge semantics
     — :func:`arc_match_edges` builds the per-receiver group match
-    masks, :func:`sends_mask` the slow/flap sender mute).  Bernoulli
-    loss draws are irreducibly per-edge, and correlated outages mute
-    receivers too — both stay a ``random``-topology (or ring)
-    capability — :func:`require_scenario_config` enforces the matrix
-    per scenario;
+    masks, :func:`sends_mask` the slow/flap sender mute).  Round 14:
+    correlated outages compose EXACTLY on aligned arcs with no
+    group-closure requirement at all — the rule is separable into a
+    sender-global mute (src in group: rides :func:`sends_mask`, a muted
+    row's view lanes encode absent to every receiver) and a
+    receiver-global mute (dst in group: the receiver's match mask goes
+    to ZERO, dropping every window group at once), whose union is
+    ``grp[src] | grp[dst]``, the per-edge rule verbatim.  Only
+    Bernoulli loss draws remain irreducibly per-edge and stay a
+    ``random``-topology (or ring) capability —
+    :func:`require_scenario_config` enforces the matrix per scenario;
   * ``remove_broadcast`` must be off: the broadcast is modeled as an
     instantaneous tensor column-OR, not as transport messages, so a
     partition could not filter it — gossip-only dissemination is the
@@ -204,6 +210,9 @@ def sends_mask(tsc: TensorScenario, n: int, rnd: jax.Array) -> jax.Array:
     node's gossip-view row encodes absent everywhere, which drops every
     out-edge at once while its own tick (bump/detect) runs untouched —
     exactly the per-edge rewrite's effect for sender-global rules.
+    Correlated outages (round 14) contribute their src-side half here;
+    the dst-side half rides :func:`arc_match_edges`'s receiver zero-mask
+    — together the per-edge ``grp[src] | grp[dst]`` rule exactly.
     """
     rel = rnd - tsc.round0
     send = jnp.ones((n,), bool)
@@ -217,6 +226,9 @@ def sends_mask(tsc: TensorScenario, n: int, rnd: jax.Array) -> jax.Array:
         # flapping is sender-global exactly like the slow-sender rule,
         # so the aligned-arc forms inherit it through the same mute
         send &= ~(_flap_dark(tsc, k, rel) & tsc.flap_nodes[k])
+    for o in range(tsc.out_start.shape[0]):
+        active = (rel >= tsc.out_start[o]) & (rel < tsc.out_end[o])
+        send &= ~(active & tsc.out_nodes[o])
     return send
 
 
@@ -232,8 +244,12 @@ def arc_match_edges(
     separates the group from receiver i.  Valid when every partition
     side is align-group-closed (``require_scenario_config`` checks), so
     one representative node decides for the whole group and group
-    granularity IS per-edge granularity.  Consumed by the rr kernel's
-    ``edge_filter`` masked gather and by
+    granularity IS per-edge granularity.  Correlated outages (round 14)
+    add a RECEIVER-global term needing no closure at all: a receiver
+    inside an active outage zeroes its whole mask (every in-edge drops
+    at once — the dst-side half of ``grp[src] | grp[dst]``; the
+    src-side half rides :func:`sends_mask`).  Consumed by the rr
+    kernel's ``edge_filter`` masked gather and by
     ``ops.merge_pallas.arc_group_window_max_xla`` (the XLA oracle).
     """
     n = bases.shape[0]
@@ -250,6 +266,9 @@ def arc_match_edges(
             pid = tsc.part_pid[p]
             ok &= ~active | (pid[rep] == pid[recv])
         mask |= jnp.where(ok, jnp.int32(1 << k), 0)
+    for o in range(tsc.out_start.shape[0]):
+        active = (rel >= tsc.out_start[o]) & (rel < tsc.out_end[o])
+        mask = jnp.where(active & tsc.out_nodes[o], 0, mask)
     return jnp.stack([bases.astype(jnp.int32), mask], axis=1)
 
 
@@ -261,11 +280,13 @@ def require_scenario_config(config: SimConfig, scenario=None) -> None:
       (the UDP/deploy engines DO filter their real REMOVE datagrams);
       gossip-only dissemination is the transport-faithful mode.
     * ``random_arc``: aligned arcs (arc_align > 1) take partitions with
-      align-group-closed sides plus slow-sender rules at group
-      granularity (== per-edge granularity for group-closed sides — see
-      :func:`arc_match_edges`); Bernoulli loss draws are irreducibly
-      per-edge and need ``random`` (or ring).  Unaligned arcs
-      (arc_align == 1) have no group form at all — use ``random``.
+      align-group-closed sides, slow/flapping senders, and (round 14)
+      correlated outages — the outage rule is separable into sender-
+      global + receiver-global mutes, so it needs no group closure (see
+      :func:`arc_match_edges` / :func:`sends_mask`); Bernoulli loss
+      draws are irreducibly per-edge and need ``random`` (or ring).
+      Unaligned arcs (arc_align == 1) have no group form at all — use
+      ``random``.
 
     ``scenario``: the concrete :class:`TensorScenario` (or the
     declarative ``FaultScenario``) when available — arc-capability
@@ -304,11 +325,9 @@ def _require_arc_scenario(scenario, config: SimConfig) -> None:
     align = config.arc_align
     if isinstance(scenario, TensorScenario):
         n_loss = int(scenario.loss_start.shape[0])
-        n_out = int(scenario.out_start.shape[0])
         pids = np.asarray(scenario.part_pid)
     else:  # declarative FaultScenario
         n_loss = len(scenario.link_faults)
-        n_out = len(scenario.outages)
         pids = (
             np.stack([p.pid(config.n) for p in scenario.partitions])
             if scenario.partitions else np.zeros((0, config.n), np.int32)
@@ -317,15 +336,8 @@ def _require_arc_scenario(scenario, config: SimConfig) -> None:
         raise ValueError(
             "Bernoulli loss rules draw per (sender, receiver) edge and "
             "have no group form: run loss scenarios on topology='random' "
-            "(or ring); aligned arcs take partitions + slow/flapping "
-            "senders"
-        )
-    if n_out:
-        raise ValueError(
-            "correlated-outage rules mute receivers as well as senders "
-            "and have no aligned-arc group form: run outage scenarios on "
-            "topology='random' (or ring); aligned arcs take partitions "
-            "+ slow/flapping senders"
+            "(or ring); aligned arcs take partitions, slow/flapping "
+            "senders and correlated outages"
         )
     from gossipfs_tpu.ops.merge_pallas import ARC_MATCH_MAX_GROUPS
 

@@ -11,11 +11,10 @@ dict out — the json_format transcoding lives in wire.py).
 from __future__ import annotations
 
 import base64
-import time
 
 import grpc
 
-from gossipfs_tpu.shim import wire
+from gossipfs_tpu.shim import retry, wire
 from gossipfs_tpu.shim.wire import SERVICE
 
 
@@ -35,10 +34,15 @@ class ShimClient:
         self.timeout = timeout
         self._methods: dict[str, grpc.UnaryUnaryMultiCallable] = {}
 
-    def call(self, method: str, timeout: float | None = None, **request):
+    def call(self, method: str, timeout: float | None = None,
+             retries: bool = True, **request):
         """One RPC; ``timeout`` overrides the client default per call
         (bulk-data methods carry multi-MB payloads and need deadlines far
-        past the control-plane default)."""
+        past the control-plane default).  ``retries=False`` issues
+        exactly one attempt — for callers that own their OWN retry
+        policy (the launcher's ``_ctrl_call``), so two backoff loops
+        never nest (a nested inner loop would multiply the outer
+        policy's advertised time bound)."""
         fn = self._methods.get(method)
         if fn is None:
             fn = self._methods[method] = self.channel.unary_unary(
@@ -47,20 +51,22 @@ class ShimClient:
                 response_deserializer=wire.response_deserializer(method),
             )
         deadline = self.timeout if timeout is None else timeout
+        if not retries:
+            return fn(request, timeout=deadline)
         # RESOURCE_EXHAUSTED is the server's explicit backpressure (its
         # Advance handlers fail fast instead of holding workers parked on
         # the election lock — service.py ShimServicer._advance_slots):
-        # retry with backoff rather than surfacing it to every caller
-        delay = 0.05
-        for _ in range(6):
-            try:
-                return fn(request, timeout=deadline)
-            except grpc.RpcError as e:
-                if e.code() is not grpc.StatusCode.RESOURCE_EXHAUSTED:
-                    raise
-                time.sleep(delay)
-                delay = min(delay * 2, 1.0)
-        return fn(request, timeout=deadline)
+        # retry with backoff rather than surfacing it to every caller.
+        # Round 14: the raw loop became the shared bounded-backoff
+        # discipline (shim/retry.py) — same 7-attempt 50ms-doubling
+        # schedule, now with a hard ceiling on total retry time so a
+        # saturated server cannot park callers open-endedly
+        return retry.call_with_backoff(
+            lambda: fn(request, timeout=deadline),
+            retryable=retry.grpc_backpressure,
+            attempts=7, base_delay=0.05, max_delay=1.0,
+            total_deadline=10.0,
+        )
 
     # -- convenience wrappers for the common verbs -------------------------
     def join(self, node: int) -> None:

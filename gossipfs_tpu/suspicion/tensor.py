@@ -13,13 +13,16 @@ runs, bit-equal to the XLA oracle (pinned by the oracle grid, the golden
 fuzz suite, and ``verify_claims.py fastpath_parity``).
 
 What this module still owns is the PROTOCOL-MODE requirement
-(:func:`require_suspicion_config`): gossip-only dissemination.  One
-capability note survives as graceful degradation rather than a gate: the
-Lifeguard local-health stretch (``lh_multiplier > 0``) derives a
-per-receiver confirmation threshold from per-receiver SUSPECT counts,
-which the resident-round kernel does not carry — such configs
-automatically take the stripe/XLA merge for the round
-(core/rounds.py ``_use_rr``), same bits, slower path.
+(:func:`require_suspicion_config`): gossip-only dissemination.  Round 14
+removed the last capability note: the Lifeguard local-health stretch
+(``lh_multiplier > 0``) is fused into the rr/SWAR fast path too — the
+scan carries the per-receiver SUSPECT counts (a kernel side output,
+like the member counts), derives each receiver's degraded bit outside
+the kernel, and the kernel applies the stretched confirmation threshold
+as a per-row select (flags bit 4; ops/merge_pallas.py) — so every
+suspicion knob, local health included, runs on every merge path,
+oracle-pinned bit-exact against ``suspicion/runtime.py`` semantics by
+the lh parity tests and the golden fuzz suite.
 
 :func:`with_suspicion` survives as a deprecated alias of
 ``config.fallback_config`` — the one owner of oracle-path substitution —

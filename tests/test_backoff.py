@@ -1,0 +1,218 @@
+"""Bounded-backoff discipline (shim/retry.py) — the round-14 hardening
+of the deploy/shim control plane.
+
+The property under test is the one campaigns/engines.py leans on when it
+calls a deploy campaign surviving a correlated outage "evidence of
+graceful degradation": a control-plane call's TOTAL retry time is
+hard-bounded no matter how transient failures interleave — injected
+RPC failures below.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from gossipfs_tpu.shim import retry
+
+
+class _Clock:
+    """Deterministic time stand-in: sleep() advances monotonic() and
+    records every delay, so the tests assert exact schedules."""
+
+    def __init__(self):
+        self.now = 0.0
+        self.sleeps: list[float] = []
+
+    def monotonic(self) -> float:
+        return self.now
+
+    def sleep(self, s: float) -> None:
+        self.sleeps.append(s)
+        self.now += s
+
+
+class _Transient(Exception):
+    pass
+
+
+class _Fatal(Exception):
+    pass
+
+
+def _is_transient(e: BaseException) -> bool:
+    return isinstance(e, _Transient)
+
+
+@pytest.fixture
+def clock(monkeypatch):
+    c = _Clock()
+    monkeypatch.setattr(retry, "time", c)
+    return c
+
+
+class TestCallWithBackoff:
+    def test_transient_failures_then_success(self, clock):
+        calls = []
+
+        def fn():
+            calls.append(clock.now)
+            if len(calls) < 4:
+                raise _Transient(f"flake {len(calls)}")
+            return "ok"
+
+        out = retry.call_with_backoff(
+            fn, retryable=_is_transient, attempts=6,
+            base_delay=0.05, max_delay=1.0, total_deadline=10.0)
+        assert out == "ok"
+        assert len(calls) == 4
+        # exponential schedule, exactly: 50 ms, 100 ms, 200 ms
+        assert clock.sleeps == [0.05, 0.1, 0.2]
+
+    def test_permanent_failure_total_time_bounded(self, clock):
+        def fn():
+            raise _Transient("down")
+
+        with pytest.raises(_Transient):
+            retry.call_with_backoff(
+                fn, retryable=_is_transient, attempts=6,
+                base_delay=0.05, max_delay=1.0, total_deadline=10.0)
+        # attempts respected; total sleep == the capped geometric sum
+        # (0.05 + 0.1 + 0.2 + 0.4 + 0.8) and <= the hard deadline
+        assert len(clock.sleeps) == 5
+        assert sum(clock.sleeps) == pytest.approx(1.55)
+        assert sum(clock.sleeps) <= 10.0
+
+    def test_total_deadline_clips_and_stops(self, clock):
+        """Injected failures against a tight budget: each sleep is
+        clipped to the REMAINING budget and an exhausted budget stops
+        retrying — total wall time spent sleeping never exceeds
+        total_deadline even when attempts would allow more."""
+        attempts_made = []
+
+        def fn():
+            attempts_made.append(clock.now)
+            raise _Transient("down")
+
+        with pytest.raises(_Transient):
+            retry.call_with_backoff(
+                fn, retryable=_is_transient, attempts=50,
+                base_delay=4.0, max_delay=8.0, total_deadline=10.0)
+        assert sum(clock.sleeps) <= 10.0
+        # 4 + 6(clip) = 10 -> budget gone -> stop: 3 attempts, not 50
+        assert clock.sleeps == [4.0, 6.0]
+        assert len(attempts_made) == 3
+
+    def test_max_delay_caps_the_doubling(self, clock):
+        def fn():
+            raise _Transient("down")
+
+        with pytest.raises(_Transient):
+            retry.call_with_backoff(
+                fn, retryable=_is_transient, attempts=5,
+                base_delay=0.3, max_delay=0.5, total_deadline=60.0)
+        assert clock.sleeps == [0.3, 0.5, 0.5, 0.5]
+
+    def test_non_retryable_raises_immediately(self, clock):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise _Fatal("real bug")
+
+        with pytest.raises(_Fatal):
+            retry.call_with_backoff(
+                fn, retryable=_is_transient, attempts=6,
+                base_delay=0.05, total_deadline=10.0)
+        assert len(calls) == 1 and clock.sleeps == []
+
+    def test_first_try_success_sleeps_nothing(self, clock):
+        assert retry.call_with_backoff(
+            lambda: 7, retryable=_is_transient) == 7
+        assert clock.sleeps == []
+
+
+class TestGrpcPredicates:
+    """The two call-site policies classify grpc codes as documented."""
+
+    @staticmethod
+    def _rpc_error(code_name: str):
+        import grpc
+
+        class _Err(grpc.RpcError):
+            def code(self):
+                return getattr(grpc.StatusCode, code_name)
+
+        return _Err()
+
+    def test_backpressure_only_resource_exhausted(self):
+        assert retry.grpc_backpressure(self._rpc_error("RESOURCE_EXHAUSTED"))
+        assert not retry.grpc_backpressure(self._rpc_error("UNAVAILABLE"))
+        assert not retry.grpc_backpressure(ValueError("x"))
+
+    def test_transient_covers_control_plane_codes(self):
+        for code in ("RESOURCE_EXHAUSTED", "UNAVAILABLE",
+                     "DEADLINE_EXCEEDED"):
+            assert retry.grpc_transient(self._rpc_error(code))
+        assert not retry.grpc_transient(self._rpc_error("NOT_FOUND"))
+        assert not retry.grpc_transient(RuntimeError("x"))
+
+
+class TestLauncherControlPlane:
+    """The launcher's fan-outs ride the shared discipline: a node that
+    flakes transiently still acks; total retry time stays bounded."""
+
+    def test_load_scenario_retries_transient_node(self, clock, monkeypatch,
+                                                  tmp_path):
+        from gossipfs_tpu.deploy import launcher
+        from gossipfs_tpu.scenarios.schedule import FaultScenario
+
+        cluster = launcher.Cluster(2, root=str(tmp_path))
+
+        class _Proc:
+            def poll(self):
+                return None
+
+        class _FlakyClient:
+            def __init__(self):
+                self.calls = 0
+
+            def call(self, method, timeout=None, retries=True, **request):
+                assert timeout == cluster.ctrl_timeout
+                # the launcher owns the one retry layer — the client's
+                # inner backpressure loop must be OFF (nesting the two
+                # would multiply the advertised time bound)
+                assert retries is False
+                self.calls += 1
+                if self.calls < 3:
+                    raise TestGrpcPredicates._rpc_error("UNAVAILABLE")
+                return {"ok": True}
+
+        flaky = _FlakyClient()
+        cluster.procs = {0: _Proc(), 1: _Proc()}
+        monkeypatch.setattr(cluster, "client", lambda idx: flaky)
+        sc = FaultScenario(name="noop", n=2)
+        assert cluster.load_scenario(sc) == [0, 1]
+        # node 0 flaked twice then acked (2 sleeps); node 1 acked cold
+        assert clock.sleeps == [0.1, 0.2]
+
+    def test_dead_node_fails_fast_within_budget(self, clock, monkeypatch,
+                                                tmp_path):
+        from gossipfs_tpu.deploy import launcher
+
+        cluster = launcher.Cluster(1, root=str(tmp_path))
+
+        class _Proc:
+            def poll(self):
+                return None
+
+        class _DeadClient:
+            def call(self, method, timeout=None, retries=True, **request):
+                raise TestGrpcPredicates._rpc_error("UNAVAILABLE")
+
+        cluster.procs = {0: _Proc()}
+        monkeypatch.setattr(cluster, "client", lambda idx: _DeadClient())
+        assert cluster.vitals() == []
+        # bounded: 4 attempts, 3 backoffs, total sleep well under the
+        # 3 s control-plane retry budget
+        assert len(clock.sleeps) == 3
+        assert sum(clock.sleeps) <= 3.0

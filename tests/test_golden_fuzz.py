@@ -365,3 +365,75 @@ def test_fuzz_gray_failure_matches_oracle():
         if r % 4 == 0 or r in schedule:
             compare(state, naive, where=f"gray round {r}")
     compare(state, naive, where="gray final")
+
+
+def test_fuzz_rr_lh_outage_matches_oracle():
+    """Round-14 golden fuzz: the Lifeguard local-health lane fused into
+    the rr/SWAR resident-round kernel (flags bit 4 + carried
+    per-receiver suspect counts) AND the aligned-arc correlated-outage
+    form (sends_mask sender mute + zero receiver match mask), driven by
+    a rack blackout + crash storms against the per-node oracle.
+
+    The schedule makes the stretch fire on BOTH sides of the lh_frac
+    compare: a 40-node rack blackout (rack members see ~95% of their
+    view SUSPECT -> degraded; cluster observers see ~4% -> not) and a
+    ~20% mass crash storm (every survivor crosses lh_frac=0.125 ->
+    degraded, confirms at the stretched threshold).  Oracle edges
+    mirror the rr scan's per-round sampling, expanded to explicit
+    [N, F] form through ``filter_edges`` — whose per-edge outage rule
+    the group form must equal exactly (the round-14 equivalence
+    scenarios/tensor.py argues).  n=1024: the aligned-arc rr scan
+    requires N % ARC_CHUNK == 0, so smaller fuzz shapes silently fall
+    back to the stripe dispatch (the gate this test asserts)."""
+    from gossipfs_tpu.core.rounds import _use_rr
+    from gossipfs_tpu.scenarios import CorrelatedOutage, FaultScenario, SlowNode
+    from gossipfs_tpu.scenarios.tensor import compile_tensor, filter_edges
+
+    cfg = SimConfig(n=1024, topology="random_arc", fanout=16, arc_align=8,
+                    remove_broadcast=False, fresh_cooldown=True,
+                    t_fail=3, t_cooldown=12, view_dtype="int8",
+                    hb_dtype="int8", merge_kernel="pallas_rr_interpret",
+                    merge_block_c=512, merge_block_r=128, rr_resident="on",
+                    elementwise="swar",
+                    suspicion=SuspicionParams(t_suspect=2, lh_multiplier=3,
+                                              lh_frac=0.125))
+    n, rounds, seg = cfg.n, 40, 5
+    assert _use_rr(cfg, n, n), "the lh config must take the rr scan"
+    sc = FaultScenario(
+        name="fuzz-rack", n=n,
+        outages=(CorrelatedOutage(start=4, end=16,
+                                  nodes=tuple(range(32, 72))),),
+        slow_nodes=(SlowNode(start=2, end=30, stride=3,
+                             nodes=tuple(range(16))),),
+    )
+    tsc = compile_tensor(sc)
+    rng = pyrandom.Random(1414)
+    schedule: dict[int, list[int]] = {}
+    for r in range(2, rounds):
+        if rng.random() < 0.12:
+            schedule[r] = rng.sample(range(1, n), k=rng.randint(1, 3))
+    # the mass storm: ~20% simultaneous crashes crosses lh_frac
+    schedule[18] = sorted(
+        set(rng.sample(range(1, n), k=200)) - set(schedule.get(18, [])))
+    state = init_state(cfg)
+    naive = NaiveSim(cfg)
+    key = jax.random.PRNGKey(23)
+    for r0 in range(0, rounds, seg):
+        crash = np.zeros((seg, n), dtype=bool)
+        for r in range(r0, r0 + seg):
+            for idx in schedule.get(r, []):
+                crash[r - r0, idx] = True
+        z = jnp.zeros((seg, n), dtype=bool)
+        ev = RoundEvents(crash=jnp.asarray(crash), leave=z, join=z)
+        state, _, _ = gossip_run_rounds(state, cfg, seg, key, events=ev,
+                                        crash_only_events=True,
+                                        scenario=tsc)
+        for r in range(r0, r0 + seg):
+            k = jax.random.fold_in(key, r)
+            k_edge, _ = jax.random.split(k)
+            bases = topology.in_edges(cfg, k_edge, None)
+            edges = filter_edges(
+                tsc, topology.arc_edges(bases, cfg.fanout).astype(jnp.int32),
+                jnp.int32(r), k)
+            naive.step(np.array(edges), crash=schedule.get(r, []))
+        compare(state, naive, where=f"rr-lh-outage round {r0 + seg}")

@@ -486,6 +486,85 @@ def test_rr_rcnt_accumulated_form_matches_per_stripe():
     np.testing.assert_array_equal(red_ps, red_ac)
 
 
+def test_rr_lh_suspect_count_forms_match():
+    """Round 14: the local-health lane's per-receiver SUSPECT-count
+    output rides both recv_cnt forms (per-stripe partials and the
+    lane-compacted accumulator) and must reduce identically; the
+    degraded flag (bit 4) applies the stretched confirm threshold
+    per ROW — rows with it set must differ from an un-flagged run
+    exactly where SUSPECT entries sit between the two thresholds."""
+    import numpy as np
+
+    from gossipfs_tpu.config import AGE_CLAMP
+    from gossipfs_tpu.core.state import FAILED, MEMBER, SUSPECT, UNKNOWN
+    from gossipfs_tpu.ops import merge_pallas as mp
+
+    n, c_blk, fanout = 1024, 512, 8
+    nc, cs = n // c_blk, c_blk // mp.LANE
+    key = jax.random.PRNGKey(9)
+    ks = jax.random.split(key, 5)
+    hb = jax.random.randint(ks[0], (nc, n, cs, mp.LANE), 2, 127, jnp.int8)
+    age = jax.random.randint(ks[1], (nc, n, cs, mp.LANE), 1, 12, jnp.int32)
+    st = jax.random.randint(ks[2], (nc, n, cs, mp.LANE), 0, 4, jnp.int32)
+    asl = mp.pack_age_status(age, st)
+    # rows [0, n/2) degraded (flags bit 4), the rest not — the per-row
+    # threshold select must honor exactly this split
+    fl = jnp.where(jnp.arange(n) < n // 2, jnp.int8(1 + 4 + 16),
+                   jnp.int8(1 + 4))
+    flags = jnp.broadcast_to(fl[:, None], (n, mp.LANE)).astype(jnp.int8)
+    sa = jnp.zeros((nc, cs, mp.LANE), jnp.int32)
+    sb = jnp.zeros((nc, cs, mp.LANE), jnp.int32)
+    g = jnp.full((nc, cs, mp.LANE), -120, jnp.int32)
+    bases = (jax.random.randint(ks[3], (n,), 0, n // 8, jnp.int32) * 8
+             ).reshape(n, 1)
+    kw = dict(fanout=fanout, member=int(MEMBER), unknown=int(UNKNOWN),
+              failed=int(FAILED), age_clamp=AGE_CLAMP, window=126,
+              t_fail=3, t_cooldown=12, block_r=128, arc_align=8,
+              interpret=True, suspect=int(SUSPECT), t_suspect=2,
+              lh_multiplier=3)
+    out_ps = mp.resident_round_blocked(bases, hb, asl, flags, sa, sb, g,
+                                       rcnt_acc=False, **kw)
+    out_ac = mp.resident_round_blocked(bases, hb, asl, flags, sa, sb, g,
+                                       rcnt_acc=True, **kw)
+    assert len(out_ps) == 10 and len(out_ac) == 10
+    for a, b, name in zip(out_ps[:5], out_ac[:5],
+                          ("hb", "asl", "cnt", "ndet", "fobs")):
+        assert jnp.array_equal(a, b), name
+
+    def red(cnt):
+        if cnt.size == n:
+            return np.asarray(cnt.reshape(n)).astype(np.int32)
+        return np.asarray(jnp.sum(cnt.reshape(n, -1), axis=1,
+                                  dtype=jnp.int32) // mp.LANE)
+
+    np.testing.assert_array_equal(red(out_ps[9]), red(out_ac[9]))
+    # the suspect counts really count post-merge SUSPECT entries
+    st_new = mp.unpack_age_status(out_ps[1])[1]
+    want = np.asarray(jnp.sum((st_new == int(SUSPECT)).astype(jnp.int32),
+                              axis=(0, 2, 3)))
+    np.testing.assert_array_equal(red(out_ps[9]), want)
+    # per-row stretch: degraded rows confirm LATER — rerun with no
+    # degraded rows.  A stretched row holds its SUSPECT entries past
+    # the base threshold instead of confirming them, so this round's
+    # total confirmations strictly drop (and the held entries keep
+    # gossiping, so the whole view — clean receivers included —
+    # legitimately shifts; per-row isolation is NOT the invariant)
+    flags0 = jnp.broadcast_to(jnp.int8(1 + 4), (n, mp.LANE)).astype(jnp.int8)
+    out0 = mp.resident_round_blocked(bases, hb, asl, flags0, sa, sb, g,
+                                     rcnt_acc=False, **kw)
+    ndet_lh = int(np.asarray(out_ps[3]).sum())
+    ndet_0 = int(np.asarray(out0[3]).sum())
+    assert ndet_lh < ndet_0, (ndet_lh, ndet_0)
+    # ...and the degraded rows hold MORE post-merge suspects than the
+    # unstretched run left standing
+    st0_new = mp.unpack_age_status(out0[1])[1]
+    held0 = int(np.asarray(
+        (st0_new[:, :n // 2] == int(SUSPECT)).sum()))
+    held_lh = int(np.asarray(
+        (st_new[:, :n // 2] == int(SUSPECT)).sum()))
+    assert held_lh > held0, (held_lh, held0)
+
+
 def test_stripe_and_arc_kernel_smoke():
     """Fast-lane coverage for the stripe/arc production kernels against
     the XLA round (the slow lane runs the deep 6-8 round versions above).

@@ -217,13 +217,20 @@ class TestGrayFailurePrimitives:
                     assert (out[i, j] == i) == rt.drops(j, i, rnd), (
                         i, j, rnd)
 
-    def test_flap_rides_arc_sends_mask_outage_rejected(self):
-        """Capability matrix: flapping is sender-global (rides the
-        aligned-arc sends_mask like slow nodes); a correlated outage
-        mutes receivers too and must be rejected on aligned arcs with
-        a pointer to topology='random'."""
-        from gossipfs_tpu.scenarios import CorrelatedOutage, Flapping
-        from gossipfs_tpu.scenarios.tensor import sends_mask
+    def test_flap_and_outage_ride_aligned_arcs_loss_rejected(self):
+        """Capability matrix (round 14): flapping is sender-global
+        (rides the aligned-arc sends_mask like slow nodes); a correlated
+        outage is separable into a sender-global mute (sends_mask) plus
+        a receiver-global zero match mask (arc_match_edges) — accepted
+        on aligned arcs with EXACT per-edge semantics and no
+        group-closure requirement; only Bernoulli loss (irreducibly
+        per-edge) stays rejected with a pointer to topology='random'."""
+        from gossipfs_tpu.scenarios import (
+            CorrelatedOutage,
+            Flapping,
+            LinkFault,
+        )
+        from gossipfs_tpu.scenarios.tensor import arc_match_edges, sends_mask
 
         n = 1024
         arc = SimConfig(n=n, topology="random_arc", fanout=16, arc_align=8,
@@ -235,9 +242,28 @@ class TestGrayFailurePrimitives:
         sm = np.asarray(sends_mask(compile_tensor(flap), n, jnp.int32(1)))
         assert not sm[:8].any() and sm[8:].all()
         out = FaultScenario(name="o", n=n, outages=(
-            CorrelatedOutage(start=0, end=8, nodes=tuple(range(8))),))
-        with pytest.raises(ValueError, match="outage"):
-            require_scenario_config(arc, out)
+            CorrelatedOutage(start=0, end=8, nodes=tuple(range(11, 19))),))
+        require_scenario_config(arc, out)  # accepted since round 14
+        tsc = compile_tensor(out)
+        # sender half: outage members' datagrams all mute...
+        sm = np.asarray(sends_mask(tsc, n, jnp.int32(3)))
+        assert not sm[11:19].any() and sm[:11].all() and sm[19:].all()
+        # ...receiver half: their in-edges all drop (zero match mask),
+        # everyone else keeps the full window
+        bases = jnp.zeros((n,), jnp.int32)
+        am = np.asarray(arc_match_edges(tsc, bases, jnp.int32(3), 16, 8))
+        full = (1 << (16 // 8)) - 1
+        assert (am[11:19, 1] == 0).all()
+        assert (am[:11, 1] == full).all() and (am[19:, 1] == full).all()
+        # ...and outside the window nobody is muted
+        am2 = np.asarray(arc_match_edges(tsc, bases, jnp.int32(9), 16, 8))
+        assert (am2[:, 1] == full).all()
+        assert np.asarray(sends_mask(tsc, n, jnp.int32(9))).all()
+        loss = FaultScenario(name="l", n=n, link_faults=(
+            LinkFault(start=0, end=8, rate=0.5, src=tuple(range(8)),
+                      dst=tuple(range(n))),))
+        with pytest.raises(ValueError, match="loss"):
+            require_scenario_config(arc, loss)
 
     def test_cosim_reachability_confined_by_outage(self):
         """The control plane's scp/RPC reachability excludes outage

@@ -558,3 +558,88 @@ def test_deploy_suspicion_lifecycle(tmp_path):
         assert any(int(ln["subject"]) == victim for ln in hits)
     finally:
         cluster.stop()
+
+
+class TestLocalHealthFusion:
+    """Round 14: the Lifeguard stretch fused into the rr/SWAR fast path
+    — flags bit 4 + the carried per-receiver suspect counts — pinned
+    bit-exact against the XLA oracle (the per-node reference semantics
+    ride the golden fuzz suite's lh config)."""
+
+    @staticmethod
+    def _rr_cfg(**over):
+        base = dict(
+            n=1024, topology="random_arc", fanout=16, arc_align=8,
+            remove_broadcast=False, fresh_cooldown=True, t_fail=3,
+            t_cooldown=12, view_dtype="int8", hb_dtype="int8",
+            merge_kernel="pallas_rr_interpret", merge_block_c=512,
+            merge_block_r=128, rr_resident="on", elementwise="swar",
+            suspicion=SuspicionParams(t_suspect=2, lh_multiplier=3,
+                                      lh_frac=0.25),
+        )
+        base.update(over)
+        return SimConfig(**base)
+
+    def test_rr_lh_no_longer_degrades_and_matches_oracle(self):
+        """lh_multiplier > 0 takes the resident-round kernel now (the
+        round-11 stripe/XLA degradation is gone) and a mass-suspicion
+        crash storm — enough simultaneous suspects to cross lh_frac and
+        fire the stretch — is bit-identical to the XLA oracle in every
+        state lane, the carry, and the per-round suspicion counters."""
+        from gossipfs_tpu.config import fallback_config
+        from gossipfs_tpu.core.rounds import _use_rr, run_rounds
+
+        cfg = self._rr_cfg()
+        n = cfg.n
+        assert _use_rr(cfg, n, n), "lh config must take the rr fast path"
+        rounds = 12
+        crash = np.zeros((rounds, n), dtype=bool)
+        crash[3, 100:500] = True  # ~39% of peers: every survivor stretches
+        z = jnp.zeros((rounds, n), dtype=bool)
+        ev = RoundEvents(crash=jnp.asarray(crash), leave=z, join=z)
+        key = jax.random.PRNGKey(7)
+        st_rr, mc_rr, pr_rr = run_rounds(init_state(cfg), cfg, rounds, key,
+                                         events=ev, crash_only_events=True)
+        oc = fallback_config(cfg)
+        assert oc.merge_kernel == "xla"
+        st_x, mc_x, pr_x = run_rounds(init_state(oc), oc, rounds, key,
+                                      events=ev, crash_only_events=True)
+        for name in ("hb", "age", "status", "alive", "hb_base"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(st_rr, name)),
+                np.asarray(getattr(st_x, name)), err_msg=name)
+        for f in mc_rr._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(mc_rr, f)),
+                np.asarray(getattr(mc_x, f)), err_msg=f"mc.{f}")
+        for f in pr_rr._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(pr_rr, f)),
+                np.asarray(getattr(pr_x, f)), err_msg=f"pr.{f}")
+        # the stretch actually FIRED: the same storm under lh-off
+        # confirms strictly earlier somewhere
+        off = self._rr_cfg(suspicion=SuspicionParams(t_suspect=2))
+        _, mc_o, _ = run_rounds(init_state(off), off, rounds, key,
+                                events=ev, crash_only_events=True)
+        assert not np.array_equal(np.asarray(mc_rr.first_detect),
+                                  np.asarray(mc_o.first_detect))
+
+    def test_packed_detector_carries_suspect_counts(self):
+        """The interactive capacity path (PackedDetector) accepts lh
+        configs now and threads the per-receiver suspect counts between
+        donated scans exactly like the member counts."""
+        from gossipfs_tpu.detector.sim import PackedDetector
+
+        det = PackedDetector(self._rr_cfg())
+        assert det._lh and int(np.asarray(det._sus_counts).sum()) == 0
+        # counters must clear the hb<=1 grace BEFORE the crash, or the
+        # victim dies permanently grace-protected (the zombie-grace
+        # rule) and never enters SUSPECT at all
+        det.advance(3)
+        det.crash(5)
+        det.advance(6)
+        # node 5 is silent: every live observer's suspect count reflects
+        # it once its staleness crosses t_fail
+        counts = np.asarray(det._sus_counts)
+        assert counts.sum() > 0
+        assert 5 not in det.alive_nodes()

@@ -14,13 +14,36 @@ streaming monitor as the oracle (gossipfs_tpu/campaigns/).
     JAX_PLATFORMS=cpu python tools/campaign.py \
         --case regressions/flap_storm_n256.json
 
+    # the SAME case over a REAL-SOCKET engine, verdict required to agree
+    # with the tensor replay (campaigns/engines.py; --scale-n re-makes
+    # campaign-family cases at a socket-budget cohort)
+    JAX_PLATFORMS=cpu python tools/campaign.py \
+        --case regressions/outage_storm_n256.json --engine udp
+    JAX_PLATFORMS=cpu python tools/campaign.py \
+        --case regressions/flap_storm_n256.json --engine deploy --scale-n 8
+
+    # map the Lifeguard local-health knob surface vs correlated outages
+    # (LOCALHEALTH_r14.json is the committed artifact of this command)
+    JAX_PLATFORMS=cpu python tools/campaign.py --surface --n 256 \
+        --t-fail 2 --t-suspect 3 --sizes 2 8 16 \
+        --lh-point 4:0.015625 --lh-point 8:0.015625 \
+        --crash-at 10 12 20 --out LOCALHEALTH_r14.json
+
+    # re-verify a committed surface's chosen absorption point
+    # (the verify_claims.py `outage_absorption` claim's command)
+    JAX_PLATFORMS=cpu python tools/campaign.py \
+        --absorption LOCALHEALTH_r14.json
+
 Families and their severity axes: ``campaigns.FAMILIES`` (flap/down,
 loss/rate_pct, partition/split_len, outage/size).  Extra fixed knobs
-ride ``--knob k=v``.  The ledger is a ``gossipfs-obs/v1`` stream
-(header + ``campaign_verdict`` rows) — ``tools/timeline.py`` ingests it
-unchanged.  Prints ONE JSON document; exit 0 iff the requested action
-succeeded (a sweep/bisect that found breaking points still exits 0 —
-finding them is the job; --case exits nonzero when NOT reproduced).
+ride ``--knob k=v``; the Lifeguard local-health knobs ride
+``--lh-multiplier`` / ``--lh-frac`` (campaign axes since round 14).
+The ledger is a ``gossipfs-obs/v1`` stream (header + ``campaign_verdict``
+rows) — ``tools/timeline.py`` ingests it unchanged.  Prints ONE JSON
+document; exit 0 iff the requested action succeeded (a sweep/bisect
+that found breaking points still exits 0 — finding them is the job;
+--case exits nonzero when NOT reproduced, --absorption when NOT
+absorbed).
 """
 
 from __future__ import annotations
@@ -34,6 +57,102 @@ import argparse
 import json
 
 
+def _parse_lh_points(specs):
+    pts = []
+    for s in specs:
+        m, _, f = s.partition(":")
+        pts.append((int(m), float(f)))
+    return pts
+
+
+def _surface(args) -> dict:
+    from gossipfs_tpu import campaigns
+
+    pts = _parse_lh_points(args.lh_point or ["4:0.015625"])
+    sizes = args.sizes or [2, 8, 16]
+    probe_models = {}
+    for ca in (args.crash_at or [10]):
+        probe_models[str(ca)] = campaigns.knob_surface(
+            args.n, sizes, pts, t_fail=args.t_fail,
+            t_suspect=args.t_suspect, seed=args.seed, track=args.track,
+            crash_at=ca,
+        )
+    # auto-pick the committed point: the smallest absorbed rack, least
+    # stretch, coarsest threshold — tie-broken toward the earliest
+    # probe model (the hardest one the point still absorbs under)
+    chosen = None
+    for ca in sorted(probe_models, key=int):
+        for r in probe_models[ca]["rows"]:
+            if not r["absorbed"]:
+                continue
+            key = (r["size"], r["lh_multiplier"], -r["lh_frac"], int(ca))
+            if chosen is None or key < chosen[0]:
+                chosen = (key, ca, r)
+    doc = {
+        "schema": "gossipfs-localhealth/v1",
+        "n": args.n, "t_fail": args.t_fail, "t_suspect": args.t_suspect,
+        "sizes": sizes,
+        "lh_points": [{"lh_multiplier": m, "lh_frac": f}
+                      for (m, f) in pts],
+        "probe_models": probe_models,
+        "chosen": None if chosen is None else {
+            "crash_at": int(chosen[1]),
+            **{k: chosen[2][k] for k in
+               ("size", "lh_multiplier", "lh_frac", "outage", "quiet",
+                "ttd_growth_outage", "ttd_growth_quiet", "absorbed")},
+        },
+        "command": ("python tools/campaign.py --surface --n %d "
+                    "--t-fail %d --t-suspect %d --seed %d --track %d "
+                    "--sizes %s %s --crash-at %s" % (
+                        args.n, args.t_fail, args.t_suspect, args.seed,
+                        args.track,
+                        " ".join(str(s) for s in sizes),
+                        " ".join(f"--lh-point {m}:{f}" for m, f in pts),
+                        " ".join(str(c) for c in (args.crash_at or [10])),
+                    )),
+    }
+    return doc
+
+
+def _absorption(path) -> dict:
+    """Re-run a committed surface's CHOSEN point (baselines included)
+    and re-evaluate the absorption predicate from fresh runs — the
+    ``outage_absorption`` claim."""
+    from gossipfs_tpu import campaigns
+
+    art = json.loads(open(path).read())
+    ch = art.get("chosen")
+    if not ch:
+        return {"absorbed": False, "error": f"{path} has no chosen point"}
+    # re-run with the COMMITTED point's full run knobs — the chosen
+    # probe model records seed/track/rounds, and defaulting them here
+    # would re-verify different experiments than the artifact's
+    probe = art["probe_models"][str(ch["crash_at"])]
+    fresh = campaigns.knob_surface(
+        art["n"], [ch["size"]],
+        [(ch["lh_multiplier"], ch["lh_frac"])],
+        t_fail=art["t_fail"], t_suspect=art["t_suspect"],
+        crash_at=ch["crash_at"], seed=probe.get("seed", 0),
+        track=probe.get("track", 4), rounds=probe.get("rounds", 35),
+        length=probe["outage"]["length"], start=probe["outage"]["start"],
+    )
+    row = fresh["rows"][0]
+    return {
+        "claim": "outage_absorption",
+        "artifact": path,
+        "absorbed": bool(row["absorbed"]),
+        "chosen": {k: ch[k] for k in ("size", "lh_multiplier", "lh_frac",
+                                      "crash_at")},
+        "outage": row["outage"],
+        "quiet": row["quiet"],
+        "ttd_growth_outage": row["ttd_growth_outage"],
+        "ttd_growth_quiet": row["ttd_growth_quiet"],
+        "fpr_floor": fresh["fpr_floor"],
+        "baseline_t5_outage": fresh["baselines"]["t5_outage"][
+            str(ch["size"])],
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--family", choices=None, default=None,
@@ -43,6 +162,14 @@ def main(argv=None) -> int:
     p.add_argument("--t-suspect", type=int, default=0,
                    help="arm the SWIM lifecycle at this suspect window "
                         "(0 = raw)")
+    p.add_argument("--lh-multiplier", type=int, default=0,
+                   help="Lifeguard local-health stretch multiplier "
+                        "(needs --t-suspect; a campaign axis since "
+                        "round 14)")
+    p.add_argument("--lh-frac", type=float, default=0.25,
+                   help="local-health degradation threshold (fraction "
+                        "of listed peers simultaneously SUSPECT; use "
+                        "exact binary fractions)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--track", type=int, default=4,
                    help="tracked crashes per run (TTD/reconvergence "
@@ -65,17 +192,74 @@ def main(argv=None) -> int:
     p.add_argument("--case", type=str, default=None,
                    help="replay a committed regression case instead of "
                         "running a campaign")
+    p.add_argument("--engine", choices=("tensor", "udp", "deploy"),
+                   default="tensor",
+                   help="engine for --case replays: tensor (default), "
+                        "udp (asyncio real sockets), deploy (one OS "
+                        "process per node) — socket verdicts must agree "
+                        "with the tensor replay")
+    p.add_argument("--scale-n", type=int, default=None,
+                   help="re-make a campaign-family case at this cohort "
+                        "size before replaying (the deploy lane's "
+                        "process budget; campaigns/engines.scale_case)")
+    p.add_argument("--period", type=float, default=None,
+                   help="socket-engine heartbeat period in seconds")
+    p.add_argument("--trace", type=str, default=None,
+                   help="keep the socket engine's recorded obs stream "
+                        "at this path")
+    p.add_argument("--surface", action="store_true",
+                   help="map the local-health knob surface vs "
+                        "correlated outages (campaigns.knob_surface)")
+    p.add_argument("--sizes", type=int, nargs="+", default=None,
+                   help="--surface: outage sizes")
+    p.add_argument("--lh-point", action="append", default=None,
+                   metavar="M:FRAC",
+                   help="--surface: a (lh_multiplier, lh_frac) point "
+                        "(repeatable)")
+    p.add_argument("--crash-at", type=int, nargs="+", default=None,
+                   help="--surface: tracked-probe crash rounds to map "
+                        "(the probe model is a load-bearing axis — see "
+                        "campaigns.knob_surface on the heal race)")
+    p.add_argument("--out", type=str, default=None,
+                   help="--surface: write the artifact here too")
+    p.add_argument("--absorption", type=str, default=None, metavar="ART",
+                   help="re-verify a committed surface artifact's "
+                        "chosen point (the outage_absorption claim)")
     args = p.parse_args(argv)
 
     from gossipfs_tpu import campaigns
 
+    if args.absorption:
+        out = _absorption(args.absorption)
+        print(json.dumps(out))
+        return 0 if out["absorbed"] else 1
+
+    if args.surface:
+        out = _surface(args)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(out, f, indent=1)
+                f.write("\n")
+        print(json.dumps(out))
+        return 0
+
     if args.case:
-        out = campaigns.run_case(args.case)
+        if args.engine == "tensor":
+            if args.scale_n:
+                p.error("--scale-n applies to socket engines "
+                        "(--engine udp|deploy)")
+            out = campaigns.run_case(args.case)
+        else:
+            out = campaigns.run_case_engine(
+                args.case, engine=args.engine, scale_n=args.scale_n,
+                period=args.period, trace=args.trace,
+            )
         print(json.dumps(out))
         return 0 if out["reproduced"] else 1
 
     if not args.family:
-        p.error("--family (or --case) is required")
+        p.error("--family (or --case / --surface / --absorption) is "
+                "required")
     if args.family not in campaigns.FAMILIES:
         p.error(f"unknown family {args.family!r}; pick from "
                 f"{sorted(campaigns.FAMILIES)}")
@@ -94,10 +278,14 @@ def main(argv=None) -> int:
     if args.ledger:
         ledger = campaigns.CampaignLedger(
             args.ledger, family=args.family, n=args.n, axis=axis,
-            t_fail=args.t_fail, t_suspect=args.t_suspect, seed=args.seed)
+            t_fail=args.t_fail, t_suspect=args.t_suspect,
+            lh_multiplier=args.lh_multiplier, lh_frac=args.lh_frac,
+            seed=args.seed)
     common = dict(fault_rounds=args.fault_rounds, t_fail=args.t_fail,
-                  t_suspect=args.t_suspect, seed=args.seed,
-                  track=args.track, ledger=ledger, **knobs)
+                  t_suspect=args.t_suspect,
+                  lh_multiplier=args.lh_multiplier, lh_frac=args.lh_frac,
+                  seed=args.seed, track=args.track, ledger=ledger,
+                  **knobs)
     if args.values is not None:
         out = campaigns.sweep_axis(args.family, args.n, args.values,
                                    **common)
@@ -119,7 +307,8 @@ def main(argv=None) -> int:
         from gossipfs_tpu.obs.monitor import MonitorParams
 
         cfg = campaigns.driver.campaign_config(
-            args.n, t_fail=args.t_fail, t_suspect=args.t_suspect)
+            args.n, t_fail=args.t_fail, t_suspect=args.t_suspect,
+            lh_multiplier=args.lh_multiplier, lh_frac=args.lh_frac)
         _, crash_rounds, _ = tracked_crash_events(
             cfg, args.fault_rounds + 1, args.track, 10)
         sc = campaigns.make_scenario(
@@ -128,7 +317,9 @@ def main(argv=None) -> int:
             **{axis: breaking}, **knobs)
         campaigns.write_case(
             args.commit, sc, t_fail=args.t_fail,
-            t_suspect=args.t_suspect, seed=args.seed, track=args.track,
+            t_suspect=args.t_suspect,
+            lh_multiplier=args.lh_multiplier, lh_frac=args.lh_frac,
+            seed=args.seed, track=args.track,
             params=MonitorParams.from_dict(row["monitor_params"]),
             expect={"verdict": "violated",
                     "invariants": sorted(

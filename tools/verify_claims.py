@@ -181,6 +181,21 @@ CLAIMS = {
         lambda d: 1.0 if (d["ok"] and d["monitor_parity"]
                           and d["monitor_violations"] == 0) else 0.0,
         1.0, 0.0),
+    # round-14 correlated-failure absorption (LOCALHEALTH_r14.json is
+    # the committed knob surface): re-runs the surface's CHOSEN point —
+    # baselines included — on the tensor engine (CPU) and requires the
+    # absorption predicate to hold from FRESH runs: the outage run's
+    # FPR within the t_fail=5-class floor (max(10x the deterministic
+    # quiet baseline, 1e-6) — the same floor suspicion_fpr uses), every
+    # monitor invariant passing, and tracked-crash median TTD at most
+    # +1 round over the lh-off quiet baseline on both the outage and
+    # the quiet run.  The udp-engine verdict evidence for the same
+    # family point is UDPCAMPAIGN_r14.json (tools/campaign.py --case
+    # ... --engine udp; slow-lane test).
+    "outage_absorption": (
+        ["env", "JAX_PLATFORMS=cpu", sys.executable, "tools/campaign.py",
+         "--absorption", "LOCALHEALTH_r14.json"],
+        lambda d: 1.0 if d["absorbed"] else 0.0, 1.0, 0.0),
     # traffic plane (TRAFFIC_r12.json is the committed artifact of the
     # full-bench form of this command): writes race a timed partition
     # that confines quorum reachability to the master's side; the claim
