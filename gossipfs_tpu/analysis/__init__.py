@@ -1,0 +1,40 @@
+"""gossipfs-lint: the repo-wide invariant analyzer.
+
+One AST-based framework (stdlib ``ast`` only for the default rules)
+absorbing the lint checks that used to live as ad-hoc greps in three
+test modules, plus the checks every review re-derived by eye:
+
+* single-ownership of owned expressions (quorum math, backoff
+  schedules, obs line parsing, quantile rollups, VMEM scratch, the
+  ``n/a`` rendering) — ``rules_ownership``
+* obs-schema coverage of every metric field and log site — ``rules_obs``
+* config capability gates documented in BASELINE.md — ``rules_config``
+* jit-hygiene for ``core/``/``ops/`` — ``rules_jit``
+* asyncio-hygiene for the socket engine — ``rules_asyncio``
+* the rr scratch-budget reconciliation (probe) — ``probes``
+
+Run it: ``python tools/lint.py`` (exit 1 on any finding), or
+``run_rules()`` from tests.  Every rule has a committed fixture under
+``tests/fixtures/lint/`` proving it fires (``tests/test_analysis.py``).
+"""
+
+from gossipfs_tpu.analysis.framework import (  # noqa: F401
+    REGISTRY,
+    Finding,
+    RepoIndex,
+    Rule,
+    rule,
+    run_rules,
+)
+
+# Importing the rule modules populates REGISTRY.
+from gossipfs_tpu.analysis import (  # noqa: E402,F401
+    probes,
+    rules_asyncio,
+    rules_config,
+    rules_jit,
+    rules_obs,
+    rules_ownership,
+)
+
+__all__ = ["REGISTRY", "Finding", "RepoIndex", "Rule", "rule", "run_rules"]

@@ -1,0 +1,223 @@
+"""gossipfs-lint core: the rule registry and the repo source index.
+
+The repo's load-bearing invariants used to be enforced by ad-hoc greps
+scattered across three test modules (the quorum regex in
+``tests/test_traffic.py``, the schema LINT maps in ``tests/test_obs.py``,
+the scratch-budget reconciliation in ``tests/test_merge_pallas.py``) —
+each new subsystem re-invented the pattern and nothing shared the file
+walking, the AST parsing, or the reporting.  This module is the ONE
+framework: declarative :class:`Rule` objects over a cached
+:class:`RepoIndex`, runnable as a library (``tests/test_analysis.py``,
+the migrated wrappers) and as a CLI (``tools/lint.py``, exit-code 1 on
+any finding).
+
+Two rule kinds:
+
+* ``"ast"`` — pure stdlib-``ast`` source analysis; no project imports,
+  no jax.  These run everywhere (the tier-1 fast lane, the bare CLI).
+* ``"probe"`` — checks that must import the package (the rr
+  scratch-budget reconciliation spies on ``pl.pallas_call``).  The CLI
+  includes them only with ``--probe``; the wrappers in the test modules
+  keep them on the fast lane.
+
+Every rule names a fixture under ``tests/fixtures/lint/`` that makes it
+fire, mounted over the index via ``overlay`` — the analyzer is itself
+tested (``tests/test_analysis.py``), not trusted.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import pathlib
+from typing import Callable, Iterable
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
+
+# Directories the AST rules walk by default.  tests/ is deliberately out:
+# fixtures must be mountable without tripping the repo-clean check, and
+# test code may quote forbidden idioms when pinning them.  The analyzer
+# itself (gossipfs_tpu/analysis/) is excluded for the same reason — its
+# rule messages and matchers quote the idioms they forbid.
+DEFAULT_SCAN = ("gossipfs_tpu", "tools")
+_SELF = "gossipfs_tpu/analysis/"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation, anchored to a source line."""
+
+    rule: str
+    path: str       # repo-relative posix path
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    name: str
+    description: str
+    check: Callable[["RepoIndex"], list[Finding]]
+    kind: str = "ast"               # "ast" | "probe"
+    fixture: str | None = None      # tests/fixtures/lint/<fixture>
+    fixture_at: str | None = None   # virtual repo path the fixture mounts at
+
+
+REGISTRY: dict[str, Rule] = {}
+
+
+def rule(name: str, description: str, *, kind: str = "ast",
+         fixture: str | None = None, fixture_at: str | None = None):
+    """Register a rule.  ``fixture``/``fixture_at`` wire the committed
+    trigger case: ``RepoIndex(overlay={fixture_at: fixtures/<fixture>})``
+    must make the rule produce at least one finding."""
+
+    def deco(fn: Callable[["RepoIndex"], list[Finding]]):
+        if name in REGISTRY:
+            raise ValueError(f"duplicate rule name: {name}")
+        REGISTRY[name] = Rule(name, description, fn, kind, fixture,
+                              fixture_at)
+        return fn
+
+    return deco
+
+
+class RepoIndex:
+    """Cached source + AST access over the repo tree, with an overlay.
+
+    ``overlay`` maps *virtual* repo-relative paths to real files on
+    disk: an overlaid path joins every :meth:`py_files` listing whose
+    prefix matches and SHADOWS a real file at the same path — the
+    mechanism the fixture tests use to inject a violating module (or a
+    violating stand-in for ``config.py``) without touching the tree.
+    """
+
+    def __init__(self, root: pathlib.Path | str = REPO_ROOT,
+                 overlay: dict[str, pathlib.Path | str] | None = None):
+        self.root = pathlib.Path(root)
+        self.overlay = {k: pathlib.Path(v) for k, v in (overlay or {}).items()}
+        self._src: dict[str, str] = {}
+        self._tree: dict[str, ast.Module] = {}
+
+    # -- file access --------------------------------------------------------
+    def _real(self, rel: str) -> pathlib.Path:
+        return self.overlay.get(rel, self.root / rel)
+
+    def exists(self, rel: str) -> bool:
+        return self._real(rel).is_file()
+
+    def source(self, rel: str) -> str:
+        if rel not in self._src:
+            self._src[rel] = self._real(rel).read_text(encoding="utf-8")
+        return self._src[rel]
+
+    def tree(self, rel: str) -> ast.Module:
+        if rel not in self._tree:
+            self._tree[rel] = ast.parse(self.source(rel), filename=rel)
+        return self._tree[rel]
+
+    def py_files(self, *prefixes: str) -> list[str]:
+        """Repo-relative posix paths of every ``.py`` file under the
+        prefixes (default scan set when none given), overlay included."""
+        prefixes = prefixes or DEFAULT_SCAN
+        out: set[str] = set()
+        for pre in prefixes:
+            base = self.root / pre
+            if base.is_dir():
+                for p in base.rglob("*.py"):
+                    if "__pycache__" in p.parts:
+                        continue
+                    rel = p.relative_to(self.root).as_posix()
+                    if rel.startswith(_SELF):
+                        continue
+                    out.add(rel)
+            elif base.is_file():
+                out.add(pre)
+            for virt in self.overlay:
+                if virt == pre or virt.startswith(pre.rstrip("/") + "/"):
+                    out.add(virt)
+        return sorted(out)
+
+
+# ---------------------------------------------------------------------------
+# Shared AST helpers (used by every rules_* module)
+# ---------------------------------------------------------------------------
+
+def dotted(node: ast.AST) -> str | None:
+    """``a.b.c`` for Attribute/Name chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def const_str(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def names_in(node: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def functions(tree: ast.AST) -> Iterable[ast.FunctionDef | ast.AsyncFunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def literal_dict(tree: ast.Module, name: str) -> dict | None:
+    """Evaluate a module-level ``NAME = {...literal...}`` assignment."""
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target,
+                                                           ast.Name):
+            targets, value = [node.target.id], node.value
+        else:
+            continue
+        if name in targets and value is not None:
+            try:
+                return ast.literal_eval(value)
+            except ValueError:
+                return None
+    return None
+
+
+def namedtuple_fields(tree: ast.Module, class_name: str) -> list[str] | None:
+    """Annotated field names of a ``class X(NamedTuple)`` definition."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == class_name:
+            return [
+                stmt.target.id
+                for stmt in node.body
+                if isinstance(stmt, ast.AnnAssign)
+                and isinstance(stmt.target, ast.Name)
+            ]
+    return None
+
+
+def run_rules(index: RepoIndex | None = None,
+              names: Iterable[str] | None = None,
+              kinds: Iterable[str] = ("ast",)) -> list[Finding]:
+    """Run the selected rules and return every finding, stably ordered."""
+    index = index or RepoIndex()
+    kinds = set(kinds)
+    findings: list[Finding] = []
+    for name, r in sorted(REGISTRY.items()):
+        if names is not None and name not in set(names):
+            continue
+        if names is None and r.kind not in kinds:
+            continue
+        findings.extend(r.check(index))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))

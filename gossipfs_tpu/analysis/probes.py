@@ -1,0 +1,181 @@
+"""Probe rules — invariant checks that must import the package.
+
+The AST rules in the sibling modules run on source alone; the checks
+here execute code (jax in interpret mode) to reconcile a *formula*
+against the *artifact it budgets*.  They register in the same registry
+(kind="probe"): ``tools/lint.py --probe`` runs them, and the thin
+test wrappers keep them on the tier-1 fast lane.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+
+from gossipfs_tpu.analysis.framework import Finding, RepoIndex, rule
+
+_MP = "gossipfs_tpu/ops/merge_pallas.py"
+
+# Each reconciliation run must actually re-enter pl.pallas_call (the spy
+# captures nothing on a jit-cache hit).  ``window`` is a STATIC argument
+# of resident_round_blocked that the scratch geometry never reads, so a
+# unique value per call scopes the cache miss to this one entry point —
+# a process-wide jax.clear_caches() here would force every other test's
+# already-traced scan to recompile.
+_CACHE_BUST = itertools.count()
+
+
+@rule(
+    "rr-scratch-budget",
+    "rr_align_scratch_bytes must equal the kernel's ACTUAL pltpu scratch "
+    "allocations (spec list verbatim in the pallas_call, byte sums "
+    "equal), the flags block must bill at rr_flags_bytes, and the "
+    "rotated row-budget acceptance shapes must hold (probe: runs the "
+    "interpret kernel)",
+    kind="probe",
+    fixture="rr_scratch_budget.py",
+    fixture_at=None,  # probe rules trigger via their _fixture_check hook
+)
+def check_rr_scratch_budget(index: RepoIndex) -> list[Finding]:
+    return _reconcile()
+
+
+def _reconcile(spec_drop: int = 0) -> list[Finding]:
+    """The round-9 scratch-budget reconciliation, as findings.
+
+    ``spec_drop`` exists for the analyzer's own fixture test: dropping
+    N trailing specs from the budget list simulates the drift this
+    probe exists to catch (a kernel allocation the budget stops
+    charging), without touching the real kernel.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    from gossipfs_tpu.config import AGE_CLAMP
+    from gossipfs_tpu.core.state import FAILED, MEMBER, UNKNOWN
+    from gossipfs_tpu.ops import merge_pallas as mp
+
+    out: list[Finding] = []
+    n, nloc, fanout, align, c_blk = 2048, 512, 16, 8, 512
+    window = 126 - next(_CACHE_BUST)  # unique static arg: see _CACHE_BUST
+
+    # random packed-lane inputs at the shard shape where the row budget
+    # binds (mirrors tests/test_merge_pallas._rr_tall_skinny_inputs)
+    nc, cs = nloc // c_blk, c_blk // mp.LANE
+    ks = jax.random.split(jax.random.PRNGKey(29), 5)
+    hb = jax.random.randint(ks[0], (nc, n, cs, mp.LANE), -128, 127,
+                            jnp.int8)
+    age = jax.random.randint(ks[1], (nc, n, cs, mp.LANE), 1, 40, jnp.int32)
+    st = jax.random.randint(ks[2], (nc, n, cs, mp.LANE), 0, 3, jnp.int32)
+    asl = mp.pack_age_status(age, st)
+    fl = jnp.where(jax.random.uniform(ks[3], (n,)) > 0.1, 5, 4).astype(
+        jnp.int8)
+    flags = fl.reshape(n // mp.LANE, mp.LANE)
+    sa = jnp.zeros((nc, cs, mp.LANE), jnp.int32)
+    sb = jnp.zeros((nc, cs, mp.LANE), jnp.int32)
+    g = jnp.full((nc, cs, mp.LANE), -120, jnp.int32)
+    bases = (jax.random.randint(ks[4], (n,), 0, n // align, jnp.int32)
+             * align).reshape(n, 1)
+
+    captured: dict = {}
+    real = pl.pallas_call
+
+    def spy(kernel, **kwargs):
+        captured["scratch"] = kwargs.get("scratch_shapes")
+        captured["in_specs"] = kwargs.get("in_specs")
+        return real(kernel, **kwargs)
+
+    mp.pl.pallas_call = spy
+    try:
+        mp.resident_round_blocked(
+            bases, hb, asl, flags, sa, sb, g,
+            fanout=fanout, member=int(MEMBER), unknown=int(UNKNOWN),
+            failed=int(FAILED), age_clamp=AGE_CLAMP, window=window,
+            t_fail=5, t_cooldown=12, block_r=128, arc_align=align,
+            resident=True, interpret=True)
+    finally:
+        mp.pl.pallas_call = real
+
+    def key(s):
+        return (tuple(s.shape), jnp.dtype(s.dtype))
+
+    ch = mp.rr_view_chunk(n, c_blk, resident=True, arc_align=align)
+    specs = mp.rr_align_scratch_specs(n, fanout, c_blk, align, chunk=ch)
+    if spec_drop:
+        specs = specs[:-spec_drop]
+    alloc = []
+    for s in captured.get("scratch") or ():
+        try:
+            alloc.append(key(s))
+        except TypeError:
+            pass  # DMA semaphore specs carry no numeric dtype
+    for s in specs:
+        if key(s) not in alloc:
+            out.append(Finding(
+                "rr-scratch-budget", _MP, 1,
+                f"budget charges scratch {key(s)} but the kernel does "
+                "not allocate it — rr_align_scratch_specs drifted from "
+                "the pallas_call",
+            ))
+    spec_bytes = sum(math.prod(s.shape) * jnp.dtype(s.dtype).itemsize
+                     for s in specs)
+    budget = mp.rr_align_scratch_bytes(n, fanout, c_blk, align, chunk=ch)
+    if spec_bytes != budget:
+        out.append(Finding(
+            "rr-scratch-budget", _MP, 1,
+            f"spec-list bytes {spec_bytes} != rr_align_scratch_bytes "
+            f"{budget} — the row budget no longer sums the kernel's "
+            "actual allocations",
+        ))
+    # ring-rotated: ONLY the int8 W buffer scales with rows — the bf16
+    # ring + head are fixed-size (chunk + halo geometry)
+    nb, nw = n // align, fanout // align
+    expect = nb * c_blk + ((ch // align) + 2 * (nw - 1)) * c_blk * 2
+    if not spec_drop and spec_bytes != expect:
+        out.append(Finding(
+            "rr-scratch-budget", _MP, 1,
+            f"rotated-layout closed form {expect} B != spec bytes "
+            f"{spec_bytes} — a new allocation started scaling with rows",
+        ))
+    # flags input block: LANE-compacted [N/LANE, LANE], billed at
+    # rr_flags_bytes
+    fspec = (captured.get("in_specs") or [None, None, None])[2]
+    if fspec is None or tuple(fspec.block_shape) != (n // mp.LANE, mp.LANE):
+        out.append(Finding(
+            "rr-scratch-budget", _MP, 1,
+            "flags input block is not the LANE-compacted [N/LANE, LANE] "
+            "layout the budget charges",
+        ))
+    if mp.rr_flags_bytes(n, c_blk, block_r=128, resident=True,
+                         arc_align=align) != n:
+        out.append(Finding(
+            "rr-scratch-budget", _MP, 1,
+            "rr_flags_bytes no longer bills the compact layout at "
+            "1 B/row",
+        ))
+    # acceptance: the rotated layouts admit the capacity-ladder shapes
+    # (>= 512k rows at c_blk=512) and still reject the round-5 layouts
+    for rows, want, kw in (
+        (524288, True, {}),
+        (786432, True, {}),
+        (393216, False, {"rotate": False}),
+        (262144, True, {"block_c": 2048}),
+    ):
+        block_c = kw.pop("block_c", 512)
+        got = mp.rr_supported(rows, 24, block_c, 16384, arc_align=8,
+                              block_r=512, **kw)
+        if got != want:
+            out.append(Finding(
+                "rr-scratch-budget", _MP, 1,
+                f"rr_supported({rows}, block_c={block_c}, "
+                f"{kw or 'rotate=True'}) = {got}, expected {want} — the "
+                "row-budget acceptance envelope moved",
+            ))
+    return out
+
+
+def fixture_findings() -> list[Finding]:
+    """The committed trigger case for the probe (tests/test_analysis.py):
+    a budget list missing the kernel's last allocation must fire."""
+    return _reconcile(spec_drop=1)

@@ -1,0 +1,141 @@
+"""Config-gate documentation consistency.
+
+``SimConfig.__post_init__`` and ``core/rounds._use_rr`` are the repo's
+capability gates: every ``raise ValueError`` / eligibility test there
+encodes a hardware or protocol constraint (VMEM budgets, dtype windows,
+dissemination-mode requirements).  BASELINE.md carries the human-facing
+capability story; this rule pins the two together — every config FIELD a
+gate tests must have a row in BASELINE.md's config-gate matrix (a table
+row starting with the backticked field name), so a new gate cannot ship
+undocumented and a renamed field cannot leave a stale row behind
+silently.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from gossipfs_tpu.analysis.framework import Finding, RepoIndex, rule
+
+_CONFIG = "gossipfs_tpu/config.py"
+_ROUNDS = "gossipfs_tpu/core/rounds.py"
+_BASELINE = "BASELINE.md"
+
+# The matrix is the region from its bold marker to the next bold
+# marker / heading — rows in OTHER tables (scenario matrix, capability
+# matrices) must not satisfy the documentation requirement, or any
+# field name mentioned anywhere would count as documented.
+_MATRIX_MARKER = "**Config-gate matrix**"
+_MATRIX_END = re.compile(r"^(\*\*|#)", re.MULTILINE)
+_DOC_ROW = re.compile(r"^\s*\|\s*`([a-z_]+)`", re.MULTILINE)
+
+
+def _documented_fields(baseline_text: str) -> set[str] | None:
+    start = baseline_text.find(_MATRIX_MARKER)
+    if start < 0:
+        return None
+    body = baseline_text[start + len(_MATRIX_MARKER):]
+    end = _MATRIX_END.search(body)
+    if end is not None:
+        body = body[:end.start()]
+    return set(_DOC_ROW.findall(body))
+
+
+def _config_fields(tree: ast.Module) -> set[str]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "SimConfig":
+            return {
+                stmt.target.id
+                for stmt in node.body
+                if isinstance(stmt, ast.AnnAssign)
+                and isinstance(stmt.target, ast.Name)
+            }
+    return set()
+
+
+def _attrs_of(node: ast.AST, base: str) -> set[str]:
+    """Attribute names read off ``<base>.<attr>`` within the node."""
+    out = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and isinstance(sub.value,
+                                                         ast.Name) \
+                and sub.value.id == base:
+            out.add(sub.attr)
+    return out
+
+
+def _gated_fields(fn: ast.AST, base: str) -> dict[str, int]:
+    """Fields referenced by an If test whose body raises (post_init
+    gates) or by a boolean-return eligibility test (_use_rr): maps
+    field -> first gating line."""
+    gated: dict[str, int] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.If) and any(
+                isinstance(s, ast.Raise) for s in ast.walk(node)):
+            for attr in _attrs_of(node.test, base):
+                gated.setdefault(attr, node.lineno)
+        if isinstance(node, (ast.Return, ast.BoolOp, ast.If)) \
+                and base == "config":
+            # _use_rr gates by returning False, not raising — every
+            # config attribute it consults is a capability input
+            for attr in _attrs_of(node, base):
+                gated.setdefault(attr, getattr(node, "lineno", 1))
+    return gated
+
+
+@rule(
+    "config-gate-docs",
+    "every config field tested by a capability gate "
+    "(SimConfig.__post_init__ raise sites, core/rounds._use_rr) has a "
+    "documented row (| `field` ...) in BASELINE.md's config-gate matrix",
+    fixture="config_gate_docs.py",
+    fixture_at="gossipfs_tpu/config.py",
+)
+def check_config_gates(index: RepoIndex) -> list[Finding]:
+    out: list[Finding] = []
+    documented = _documented_fields(index.source(_BASELINE))
+    if documented is None:
+        return [Finding(
+            "config-gate-docs", _BASELINE, 1,
+            f"BASELINE.md has no {_MATRIX_MARKER} section — the gate "
+            "documentation rule went blind",
+        )]
+
+    cfg_tree = index.tree(_CONFIG)
+    fields = _config_fields(cfg_tree)
+    if not fields:
+        return [Finding("config-gate-docs", _CONFIG, 1,
+                        "SimConfig class not found — the gate rule went "
+                        "blind")]
+    post_init = None
+    for node in ast.walk(cfg_tree):
+        if isinstance(node, ast.FunctionDef) \
+                and node.name == "__post_init__":
+            post_init = node
+            break
+    if post_init is None:
+        return [Finding("config-gate-docs", _CONFIG, 1,
+                        "SimConfig.__post_init__ not found — the gate "
+                        "rule went blind")]
+    gates = {f: (_CONFIG, ln)
+             for f, ln in _gated_fields(post_init, "self").items()
+             if f in fields}
+
+    if index.exists(_ROUNDS):
+        for node in ast.walk(index.tree(_ROUNDS)):
+            if isinstance(node, ast.FunctionDef) and node.name == "_use_rr":
+                for f, ln in _gated_fields(node, "config").items():
+                    if f in fields:
+                        gates.setdefault(f, (_ROUNDS, ln))
+
+    for f in sorted(gates):
+        if f not in documented:
+            rel, ln = gates[f]
+            out.append(Finding(
+                "config-gate-docs", rel, ln,
+                f"capability gate tests `{f}` but BASELINE.md's "
+                f"config-gate matrix has no row for `{f}` — document "
+                "the constraint (BASELINE.md, Static analysis section)",
+            ))
+    return out

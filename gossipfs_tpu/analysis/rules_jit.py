@@ -1,0 +1,159 @@
+"""jit-hygiene for ``core/`` and ``ops/`` — the traced-code floor.
+
+The tensor hot path (core/rounds.py scans, ops/ kernels) must stay a
+pure device program: a host clock call inside a jitted function silently
+freezes at trace time, a ``.item()``/``np.`` sync inside a scan body
+serializes the whole scan, and a Python ``if`` on a traced value is a
+TracerBoolConversionError at best and a trace-time constant-fold at
+worst.  These are the "Date.now-class" bugs review keeps catching by
+eye; the rules catch their shape mechanically.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from gossipfs_tpu.analysis.framework import (
+    Finding,
+    RepoIndex,
+    dotted,
+    rule,
+)
+
+_SCAN_DIRS = ("gossipfs_tpu/core", "gossipfs_tpu/ops")
+
+# Host calls that have no business anywhere in the traced modules: the
+# value they return is frozen into the jaxpr at trace time.
+_HOST_PREFIXES = ("time.", "datetime.", "random.", "np.random.",
+                  "numpy.random.")
+
+# Additionally forbidden inside scan/loop bodies: each forces a device
+# sync (or a host transfer) once per scan step.
+_SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
+_SYNC_CALLS = {"jax.device_get", "np.asarray", "np.array", "print",
+               "breakpoint"}
+
+# Attribute reads that are STATIC under tracing (shape metadata), so a
+# Python branch on them is fine even when the base object is traced.
+_STATIC_ATTRS = {"shape", "size", "ndim", "dtype", "aval", "sharding"}
+
+_LOOP_FNS = {"lax.scan", "jax.lax.scan", "lax.fori_loop",
+             "jax.lax.fori_loop", "lax.while_loop", "jax.lax.while_loop"}
+
+
+def _host_call(node: ast.Call) -> str | None:
+    name = dotted(node.func)
+    if name is None:
+        return None
+    for pre in _HOST_PREFIXES:
+        if name.startswith(pre):
+            return name
+    return None
+
+
+def _local_functions(tree: ast.Module) -> dict[str, ast.FunctionDef]:
+    return {n.name: n for n in ast.walk(tree)
+            if isinstance(n, ast.FunctionDef)}
+
+
+def _scan_bodies(tree: ast.Module) -> list[ast.FunctionDef]:
+    """FunctionDefs passed by name to lax.scan / fori_loop / while_loop."""
+    fns = _local_functions(tree)
+    bodies = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and dotted(node.func) in _LOOP_FNS:
+            for arg in node.args[:2]:
+                if isinstance(arg, ast.Name) and arg.id in fns:
+                    bodies.append(fns[arg.id])
+    return bodies
+
+
+def _traced_names(fn: ast.FunctionDef) -> set[str]:
+    """The body's parameters plus first-level tuple-unpack aliases of
+    them (``hb, age = carry``) — the names that hold tracers."""
+    params = {a.arg for a in fn.args.args + fn.args.kwonlyargs}
+    traced = set(params)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt, val = node.targets[0], node.value
+            src_names = {v.id for v in ast.walk(val)
+                         if isinstance(v, ast.Name)}
+            if src_names & params and isinstance(tgt, (ast.Tuple, ast.List)):
+                for elt in ast.walk(tgt):
+                    if isinstance(elt, ast.Name):
+                        traced.add(elt.id)
+    return traced
+
+
+def _branches_on_traced(test: ast.AST, traced: set[str]) -> bool:
+    """A traced name used in a branch test other than through static
+    shape metadata or an ``is (not) None`` identity check.  Exemptions
+    are PER OCCURRENCE, not per name or per test: in
+    ``if carry is None or carry > 0`` only the identity occurrence is
+    exempt — the ``carry > 0`` clause still flags, since that raw bool
+    conversion is exactly the TracerBoolConversionError class the rule
+    exists for."""
+    exempt_occurrences: set[int] = set()
+    for node in ast.walk(test):
+        under = None
+        if isinstance(node, ast.Compare) and any(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            under = node  # arm selection on an optional
+        elif isinstance(node, ast.Attribute) \
+                and node.attr in _STATIC_ATTRS:
+            under = node  # static-metadata subtree: x.shape[0] etc.
+        if under is not None:
+            exempt_occurrences |= {id(sub) for sub in ast.walk(under)
+                                   if isinstance(sub, ast.Name)}
+    for node in ast.walk(test):
+        if isinstance(node, ast.Name) and node.id in traced \
+                and id(node) not in exempt_occurrences:
+            return True
+    return False
+
+
+@rule(
+    "jit-hygiene",
+    "core/ and ops/ stay a pure device program: no host clock/crng "
+    "calls anywhere, and no sync calls (.item/np./print) or Python "
+    "branches on traced values inside lax.scan/fori/while bodies",
+    fixture="jit_hygiene.py",
+    fixture_at="gossipfs_tpu/core/_lint_fixture.py",
+)
+def check_jit_hygiene(index: RepoIndex) -> list[Finding]:
+    out = []
+    for rel in index.py_files(*_SCAN_DIRS):
+        tree = index.tree(rel)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                host = _host_call(node)
+                if host is not None:
+                    out.append(Finding(
+                        "jit-hygiene", rel, node.lineno,
+                        f"host call {host}() in a traced module — its "
+                        "value freezes into the jaxpr at trace time",
+                    ))
+        for body in _scan_bodies(tree):
+            traced = _traced_names(body)
+            for node in ast.walk(body):
+                if isinstance(node, ast.Call):
+                    name = dotted(node.func)
+                    is_sync = (name in _SYNC_CALLS) or (
+                        isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _SYNC_ATTRS)
+                    if is_sync:
+                        out.append(Finding(
+                            "jit-hygiene", rel, node.lineno,
+                            f"sync/host call {name or node.func.attr}() "
+                            f"inside scan body {body.name}() — one "
+                            "device round-trip per scan step",
+                        ))
+                if isinstance(node, (ast.If, ast.While)) \
+                        and _branches_on_traced(node.test, traced):
+                    out.append(Finding(
+                        "jit-hygiene", rel, node.lineno,
+                        f"Python branch on a traced value inside scan "
+                        f"body {body.name}() — use jnp.where/lax.cond "
+                        "(shape metadata like .shape/.size is fine)",
+                    ))
+    return out

@@ -1,0 +1,281 @@
+"""Single-ownership rules: owned expressions re-derived anywhere else flag.
+
+The repo's correctness story leans on a handful of formulas each having
+exactly ONE owner module (quorum arithmetic, the bounded-backoff
+schedule, obs event-line parsing, the latency quantile rollup, VMEM
+scratch specs, the ``n/a``-not-0 vitals rendering).  Review caught every
+historical drift by eye; these rules catch the *shape* of a re-derivation
+mechanically, so a new subsystem cannot quietly fork the math.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from gossipfs_tpu.analysis.framework import (
+    Finding,
+    RepoIndex,
+    const_str,
+    dotted,
+    functions,
+    names_in,
+    rule,
+)
+
+# ---------------------------------------------------------------------------
+# quorum arithmetic — owner: gossipfs_tpu/sdfs/quorum.py
+# ---------------------------------------------------------------------------
+
+_QUORUM_OWNER = "gossipfs_tpu/sdfs/quorum.py"
+
+
+def _is_const(node: ast.AST, value: int) -> bool:
+    return isinstance(node, ast.Constant) and node.value == value
+
+
+def _is_quorum_expr(node: ast.AST) -> bool:
+    """``(x + 1) // 2`` or ``x // 2 + 1`` — the idiomatic int forms of
+    floor/ceil((n+1)/2) the reference derives quorums from."""
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.FloorDiv):
+        if _is_const(node.right, 2) and isinstance(node.left, ast.BinOp) \
+                and isinstance(node.left.op, ast.Add) \
+                and (_is_const(node.left.left, 1)
+                     or _is_const(node.left.right, 1)):
+            return True
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        for half, one in ((node.left, node.right), (node.right, node.left)):
+            if _is_const(one, 1) and isinstance(half, ast.BinOp) \
+                    and isinstance(half.op, ast.FloorDiv) \
+                    and _is_const(half.right, 2):
+                return True
+    return False
+
+
+@rule(
+    "quorum-ownership",
+    "W/R quorum arithmetic ((x+1)//2, x//2+1) may appear only in "
+    "sdfs/quorum.py; every other module imports the named functions",
+    fixture="quorum_ownership.py",
+    fixture_at="gossipfs_tpu/traffic/_lint_fixture.py",
+)
+def check_quorum(index: RepoIndex) -> list[Finding]:
+    out = []
+    for rel in index.py_files():
+        if rel == _QUORUM_OWNER:
+            continue
+        for node in ast.walk(index.tree(rel)):
+            if _is_quorum_expr(node):
+                out.append(Finding(
+                    "quorum-ownership", rel, node.lineno,
+                    "quorum arithmetic re-derived here — import "
+                    "read_quorum/write_quorum from gossipfs_tpu.sdfs.quorum",
+                ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# exponential backoff — owner: gossipfs_tpu/shim/retry.py
+# ---------------------------------------------------------------------------
+
+_BACKOFF_OWNER = "gossipfs_tpu/shim/retry.py"
+_SLEEPS = {"time.sleep", "asyncio.sleep"}
+
+
+def _grows_geometrically(loop: ast.AST, name: str) -> bool:
+    """True if ``name`` GROWS geometrically inside the loop — the
+    exponential-schedule shapes ``delay *= 2``, ``delay = delay * k``
+    (self-referential growth, min/max-capped included) and
+    ``delay = base ** attempt``.  A multiplication that does not feed
+    the name back into itself (``delay = 0.05 * attempt`` — linear;
+    ``delay = 0.1 * random()`` — jitter) is NOT a backoff schedule."""
+    for node in ast.walk(loop):
+        if isinstance(node, ast.AugAssign) \
+                and isinstance(node.target, ast.Name) \
+                and node.target.id == name \
+                and isinstance(node.op, (ast.Mult, ast.Pow)):
+            return True
+        if isinstance(node, ast.Assign):
+            targets = {t.id for t in node.targets if isinstance(t, ast.Name)}
+            if name in targets:
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.BinOp) and (
+                            isinstance(sub.op, ast.Pow)
+                            or (isinstance(sub.op, ast.Mult)
+                                and name in names_in(sub))):
+                        return True
+    return False
+
+
+@rule(
+    "backoff-ownership",
+    "retry loops with a geometrically-growing sleep re-derive the "
+    "bounded-backoff schedule; call shim.retry.call_with_backoff",
+    fixture="backoff_ownership.py",
+    fixture_at="gossipfs_tpu/deploy/_lint_fixture.py",
+)
+def check_backoff(index: RepoIndex) -> list[Finding]:
+    out = []
+    for rel in index.py_files():
+        if rel == _BACKOFF_OWNER:
+            continue
+        for loop in ast.walk(index.tree(rel)):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            for node in ast.walk(loop):
+                if isinstance(node, ast.Call) \
+                        and dotted(node.func) in _SLEEPS:
+                    for name in names_in(node):
+                        if _grows_geometrically(loop, name):
+                            out.append(Finding(
+                                "backoff-ownership", rel, node.lineno,
+                                "exponential retry backoff re-derived "
+                                "here — use shim.retry.call_with_backoff "
+                                "(the one bounded-backoff discipline)",
+                            ))
+                            break
+    return out
+
+
+# ---------------------------------------------------------------------------
+# obs event-line parsing — owners: gossipfs_tpu/obs/*, tools/timeline.py
+# ---------------------------------------------------------------------------
+
+_OBS_PARSE_OWNERS = ("gossipfs_tpu/obs/", "tools/timeline.py")
+
+
+@rule(
+    "obsparse-ownership",
+    "hand-parsing obs event lines (json.loads + the \"kind\" key in one "
+    "function) outside obs/ and tools/timeline.py; use "
+    "obs.schema.Event.from_record / obs.recorder.load_stream",
+    fixture="obsparse_ownership.py",
+    fixture_at="gossipfs_tpu/campaigns/_lint_fixture.py",
+)
+def check_obsparse(index: RepoIndex) -> list[Finding]:
+    out = []
+    for rel in index.py_files():
+        if rel.startswith(_OBS_PARSE_OWNERS[0]) or rel == _OBS_PARSE_OWNERS[1]:
+            continue
+        for fn in functions(index.tree(rel)):
+            loads_line = None
+            touches_kind = False
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) \
+                        and dotted(node.func) == "json.loads":
+                    loads_line = loads_line or node.lineno
+                if const_str(node) == "kind":
+                    touches_kind = True
+            if loads_line is not None and touches_kind:
+                out.append(Finding(
+                    "obsparse-ownership", rel, loads_line,
+                    f"{fn.name}() json.loads-parses records and reads "
+                    "their \"kind\" by hand — route through "
+                    "obs.schema.Event.from_record / obs.recorder."
+                    "load_stream so schema changes stay one-owner",
+                ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# latency quantile rollup — owner: gossipfs_tpu/traffic/workload.py
+# ---------------------------------------------------------------------------
+
+_QUANTILE_OWNER = "gossipfs_tpu/traffic/workload.py"
+_QUANTILE_KEYS = {"p50_ms", "p95_ms"}
+
+
+@rule(
+    "quantile-ownership",
+    "the p50/p95 nearest-rank rollup convention has one owner "
+    "(traffic.workload.quantiles); building those keys by hand or "
+    "calling statistics.quantiles re-derives it",
+    fixture="quantile_ownership.py",
+    fixture_at="gossipfs_tpu/bench/_lint_fixture.py",
+)
+def check_quantiles(index: RepoIndex) -> list[Finding]:
+    out = []
+    for rel in index.py_files():
+        if rel == _QUANTILE_OWNER:
+            continue
+        for node in ast.walk(index.tree(rel)):
+            if isinstance(node, ast.Dict):
+                keys = {const_str(k) for k in node.keys if k is not None}
+                if keys & _QUANTILE_KEYS:
+                    out.append(Finding(
+                        "quantile-ownership", rel, node.lineno,
+                        "p50/p95 rollup keys built by hand — call "
+                        "traffic.workload.quantiles (the one "
+                        "nearest-rank convention)",
+                    ))
+            if isinstance(node, ast.Call) \
+                    and dotted(node.func) == "statistics.quantiles":
+                out.append(Finding(
+                    "quantile-ownership", rel, node.lineno,
+                    "statistics.quantiles re-derives the latency rollup "
+                    "— call traffic.workload.quantiles",
+                ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# VMEM scratch specs — owner: gossipfs_tpu/ops/merge_pallas.py
+# ---------------------------------------------------------------------------
+
+_VMEM_OWNER = "gossipfs_tpu/ops/merge_pallas.py"
+
+
+@rule(
+    "vmem-scratch-ownership",
+    "pltpu.VMEM scratch allocation outside ops/merge_pallas.py — new "
+    "kernels must extend the owned spec builders so the byte budgets "
+    "(rr_align_scratch_bytes et al.) keep covering every allocation",
+    fixture="vmem_ownership.py",
+    fixture_at="gossipfs_tpu/ops/_lint_fixture.py",
+)
+def check_vmem(index: RepoIndex) -> list[Finding]:
+    out = []
+    for rel in index.py_files("gossipfs_tpu"):
+        if rel == _VMEM_OWNER:
+            continue
+        for node in ast.walk(index.tree(rel)):
+            if isinstance(node, ast.Call) and isinstance(node.func,
+                                                         ast.Attribute) \
+                    and node.func.attr == "VMEM":
+                out.append(Finding(
+                    "vmem-scratch-ownership", rel, node.lineno,
+                    "VMEM scratch allocated outside ops/merge_pallas.py "
+                    "— the scratch-budget reconciliation "
+                    "(rr-scratch-budget probe) cannot see it",
+                ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# "n/a" vitals rendering — owner: gossipfs_tpu/obs/schema.py
+# ---------------------------------------------------------------------------
+
+_NA_OWNER = "gossipfs_tpu/obs/schema.py"
+
+
+@rule(
+    "na-render-ownership",
+    "the n/a-not-0 vitals rule has one renderer (obs.schema.render_vitals"
+    " / obs.schema.na); a literal \"n/a\" anywhere else is a re-derived "
+    "copy that can drift into fabricating clean zeros",
+    fixture="na_ownership.py",
+    fixture_at="gossipfs_tpu/shim/_lint_fixture.py",
+)
+def check_na(index: RepoIndex) -> list[Finding]:
+    out = []
+    for rel in index.py_files():
+        if rel == _NA_OWNER:
+            continue
+        for node in ast.walk(index.tree(rel)):
+            if const_str(node) == "n/a":
+                out.append(Finding(
+                    "na-render-ownership", rel, node.lineno,
+                    "literal \"n/a\" rendered outside obs/schema.py — "
+                    "use obs.schema.na(value) / render_vitals so the "
+                    "absent-not-zero convention stays one-owner",
+                ))
+    return out

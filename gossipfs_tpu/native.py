@@ -30,9 +30,15 @@ class NativeBuildError(RuntimeError):
 
 
 def _build() -> None:
+    # -B: we only get here when _stale() already decided a rebuild is
+    # due, and make's own mtime compare disagrees on ties (the
+    # fresh-checkout case) and ignores the Makefile-only edit case —
+    # an unforced `make` would exit 0 WITHOUT recompiling and the stale
+    # binary would run anyway
     try:
         proc = subprocess.run(
-            ["make", "-C", str(_NATIVE_DIR)], capture_output=True, text=True
+            ["make", "-B", "-C", str(_NATIVE_DIR)],
+            capture_output=True, text=True
         )
     except FileNotFoundError as e:  # no make on PATH
         raise NativeBuildError(f"native build needs make: {e}") from e
@@ -40,6 +46,45 @@ def _build() -> None:
         raise NativeBuildError(
             f"native build failed:\n{proc.stdout}\n{proc.stderr}"
         )
+
+
+def _sources() -> list[pathlib.Path]:
+    return [_NATIVE_DIR / "codec.cc", _NATIVE_DIR / "engine.cc",
+            _NATIVE_DIR / "codec.h", _NATIVE_DIR / "Makefile"]
+
+
+def _stale() -> bool:
+    """Whether the .so must be (re)built.  ``>=`` on purpose: a fresh
+    checkout stamps sources and a stray .so with the SAME mtime, and the
+    old ``>`` compare let tests run silently against a binary built from
+    DIFFERENT sources.  An mtime tie costs one cheap rebuild."""
+    if not _LIB_PATH.exists():
+        return True
+    lib_mtime = _LIB_PATH.stat().st_mtime
+    return any(s.stat().st_mtime >= lib_mtime for s in _sources())
+
+
+def ensure_fresh() -> pathlib.Path:
+    """Rebuild the .so if any source is at-or-newer than it; raise
+    NativeBuildError on failure.  tests/test_native.py calls this at
+    collection so a stale binary can never pass silently against old
+    engine/codec sources — and a broken rebuild is a loud failure, not
+    a skip.
+
+    Once the library is LOADED in this process a rebuild cannot take
+    effect (the CDLL handle keeps serving the old mapping, and
+    overwriting a dlopen'd .so risks corrupting in-flight native
+    calls) — that situation raises instead of claiming freshness."""
+    with _build_lock:
+        if _stale():
+            if _lib is not None:
+                raise NativeBuildError(
+                    "native sources changed AFTER the library was loaded "
+                    "in this process; restart to pick up the rebuild "
+                    "(refusing to overwrite a mapped .so)"
+                )
+            _build()
+    return _LIB_PATH
 
 
 def load_library() -> ctypes.CDLL:
@@ -50,11 +95,7 @@ def load_library() -> ctypes.CDLL:
     with _build_lock:
         if _lib is not None:
             return _lib
-        sources = [_NATIVE_DIR / "codec.cc", _NATIVE_DIR / "engine.cc",
-                   _NATIVE_DIR / "codec.h"]
-        if not _LIB_PATH.exists() or any(
-            s.stat().st_mtime > _LIB_PATH.stat().st_mtime for s in sources
-        ):
+        if _stale():
             _build()
         lib = ctypes.CDLL(str(_LIB_PATH))
         lib.gfs_cluster_create.restype = ctypes.c_void_p

@@ -197,13 +197,19 @@ VITALS_FIELDS = (
 )
 
 
+def na(value):
+    """The absent-not-zero rendering, owned HERE: an unknowable counter
+    renders as the string ``n/a`` — never as a fabricated clean 0.
+    Every surface that prints vitals-shaped values (render_vitals below,
+    the CLI ``metrics``/``traffic status``/suspicion verbs) routes
+    through this helper; gossipfs-lint's na-render-ownership rule flags
+    any other literal ``n/a`` in the tree."""
+    return "n/a" if value is None else value
+
+
 def render_vitals(doc: dict) -> str:
     """One-line uniform rendering; absent fields print as ``n/a``."""
-    parts = []
-    for f in VITALS_FIELDS:
-        v = doc.get(f)
-        parts.append(f"{f}={'n/a' if v is None else v}")
-    return " ".join(parts)
+    return " ".join(f"{f}={na(doc.get(f))}" for f in VITALS_FIELDS)
 
 
 # ---------------------------------------------------------------------------

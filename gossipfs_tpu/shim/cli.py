@@ -50,6 +50,7 @@ import sys
 
 from gossipfs_tpu.config import SimConfig
 from gossipfs_tpu.cosim import CoSim
+from gossipfs_tpu.obs import schema
 from gossipfs_tpu.sdfs.types import CONFIRM_TIMEOUT
 
 
@@ -257,7 +258,7 @@ def dispatch(
                           f"{' (' + per + ')' if per else ''}; "
                           f"refutations={st.get('refutations', 0)} "
                           f"confirms={st.get('confirms', 0)} "
-                          f"fp_suppressed={'n/a' if fps is None else fps}",
+                          f"fp_suppressed={schema.na(fps)}",
                           file=out)
             else:
                 print(f"unknown suspicion verb: {sub} (status)", file=out)
@@ -269,8 +270,7 @@ def dispatch(
                 # each renders n/a, never a measured 0 (the round-8 rule)
                 st = (sim.traffic_status()
                       if hasattr(sim, "traffic_status") else {})
-                fmt = lambda k: ("n/a" if st.get(k) is None  # noqa: E731
-                                 else st[k])
+                fmt = lambda k: schema.na(st.get(k))  # noqa: E731
                 # invariant_violations: present only when a streaming
                 # monitor (obs/monitor.py) rides the attached recorder —
                 # engines that can't know it render n/a, never 0

@@ -1,0 +1,151 @@
+// Sanitizer campaign driver for the native epoll engine.
+//
+// Drives the C ABI (the exact surface ctypes uses — see
+// gossipfs_tpu/native.py) through the committed campaign case while a
+// second thread hammers the control/observation verbs concurrently with
+// the engine's epoll loop thread: converge, crash two nodes mid-poll,
+// detect, cooldown, rejoin, graceful leave, then a codec sweep over
+// malformed wire input.  Built by `make tsan` / `make asan`
+// (tests/test_native_sanitizers.py runs both and fails on any report);
+// protocol outcomes are asserted here so a sanitizer build that
+// silently breaks semantics also fails, not just one that races.
+//
+// Usage: sanitize_{tsan,asan} [base_port] [period_s]
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* gfs_cluster_create(int n, int base_port, double period_s, int t_fail,
+                         int t_cooldown, int min_group, int fresh_cooldown,
+                         int introducer);
+int gfs_cluster_start(void* h);
+void gfs_cluster_destroy(void* h);
+void gfs_crash(void* h, int i);
+void gfs_leave(void* h, int i);
+void gfs_join(void* h, int i);
+void gfs_advance(void* h, int rounds);
+int gfs_round(void* h);
+int gfs_membership(void* h, int observer, int* out, int cap);
+int gfs_alive(void* h, int* out, int cap);
+int gfs_drain_events(void* h, int* out, int cap);
+int gfs_codec_encode(const char* lines, char* out, int cap);
+int gfs_codec_decode(const char* wire, char* out, int cap);
+}
+
+namespace {
+
+constexpr int kN = 12;
+constexpr int kTFail = 5;
+constexpr int kTCooldown = 5;
+
+bool Contains(const int* buf, int count, int idx) {
+  return std::find(buf, buf + count, idx) != buf + count;
+}
+
+int Fail(const char* what) {
+  std::fprintf(stderr, "SANITIZE_CAMPAIGN_FAIL: %s\n", what);
+  return 1;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int base_port = argc > 1 ? std::atoi(argv[1]) : 21500;
+  double period = argc > 2 ? std::atof(argv[2]) : 0.05;
+
+  void* h = gfs_cluster_create(kN, base_port, period, kTFail, kTCooldown,
+                               /*min_group=*/4, /*fresh_cooldown=*/1,
+                               /*introducer=*/0);
+  if (gfs_cluster_start(h) != 0) {
+    gfs_cluster_destroy(h);
+    return Fail("cluster failed to start (ports busy?)");
+  }
+
+  // warm convergence: everyone joined through the introducer and every
+  // counter is past the hb<=1 detection grace
+  gfs_advance(h, 6);
+  int buf[4 * kN];
+  if (gfs_alive(h, buf, kN) != kN) {
+    gfs_cluster_destroy(h);
+    return Fail("cohort did not converge to n alive");
+  }
+
+  // concurrent observation hammering: the race surface TSan exists for
+  // is the control/observation verbs (Python-thread side) against the
+  // epoll loop thread holding the protocol state
+  std::atomic<bool> stop{false};
+  std::thread poller([&] {
+    int pbuf[4 * kN];
+    while (!stop.load()) {
+      gfs_alive(h, pbuf, kN);
+      gfs_membership(h, 0, pbuf, kN);
+      gfs_round(h);
+      gfs_drain_events(h, pbuf, 4 * kN);
+      std::this_thread::sleep_for(std::chrono::milliseconds(2));
+    }
+  });
+
+  // the campaign: crash two nodes mid-poll, detect, rejoin one
+  gfs_crash(h, 5);
+  gfs_crash(h, 9);
+  gfs_advance(h, kTFail + 7);  // t_fail periods + dissemination slack
+  stop.store(true);
+  poller.join();
+
+  int rc = 0;
+  int alive = gfs_alive(h, buf, kN);
+  if (Contains(buf, alive, 5) || Contains(buf, alive, 9))
+    rc = Fail("crashed nodes still alive after t_fail + slack");
+
+  // rejoin 5 after the cooldown window; the poller already drained some
+  // events, which is fine — the membership views are the outcome checked
+  gfs_advance(h, kTCooldown + 3);
+  gfs_join(h, 5);
+  gfs_advance(h, 8);
+  alive = gfs_alive(h, buf, kN);
+  if (!Contains(buf, alive, 5)) rc = Fail("rejoined node 5 not alive");
+  int members = gfs_membership(h, 0, buf, kN);
+  if (!Contains(buf, members, 5))
+    rc = Fail("introducer view missing rejoined node 5");
+
+  // graceful leave disseminates without a detection
+  gfs_leave(h, 3);
+  gfs_advance(h, 4);
+  members = gfs_membership(h, 0, buf, kN);
+  if (Contains(buf, members, 3)) rc = Fail("LEAVE did not disseminate");
+
+  gfs_cluster_destroy(h);
+
+  // codec sweep: round-trip plus the malformed chunks DecodeMembers must
+  // skip (strtoll/strtod edge input — the UBSan half of the build)
+  {
+    char wire[4096], back[4096];
+    int wn = gfs_codec_encode(
+        "10.0.0.1:8000 42 1785344960.123456\n10.0.0.2:8000 7 1.5\n", wire,
+        sizeof wire);
+    if (wn <= 0 || wn >= static_cast<int>(sizeof wire))
+      rc = Fail("codec encode sizing");
+    if (gfs_codec_decode(wire, back, sizeof back) <= 0)
+      rc = Fail("codec decode of own encoding");
+    static const char* kMalformed[] = {
+        "", "<#ENTRY#>", "bad-no-fields", "x<#INFO#>NaNish",
+        "a<#INFO#>99999999999999999999999999<#INFO#>1e999",
+        "ok<#INFO#>5<#INFO#>1.0<#ENTRY#>trunc<#INFO#>",
+    };
+    for (const char* m : kMalformed) gfs_codec_decode(m, back, sizeof back);
+    // snprintf-style truncation path: tiny caps must stay in bounds
+    char tiny[4];
+    gfs_codec_decode(wire, tiny, sizeof tiny);
+    gfs_codec_encode("10.0.0.1:8000 1 2.0\n", tiny, sizeof tiny);
+  }
+
+  if (rc == 0) std::printf("SANITIZE_CAMPAIGN_OK\n");
+  return rc;
+}

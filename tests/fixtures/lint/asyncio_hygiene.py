@@ -1,0 +1,11 @@
+"""Trigger fixture for the asyncio-hygiene rule: a blocking sleep inside
+a coroutine and a dropped create_task handle.  Mounted under detector/
+by tests/test_analysis.py only — never imported."""
+
+import asyncio
+import time
+
+
+async def bad_loop():
+    asyncio.create_task(asyncio.sleep(1))  # handle dropped: GC can kill it
+    time.sleep(0.1)  # blocks every node's heartbeat task

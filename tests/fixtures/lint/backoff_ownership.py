@@ -1,0 +1,15 @@
+"""Trigger fixture for the backoff-ownership rule: a retry loop with a
+geometrically-growing sleep — the bounded-backoff schedule re-derived
+outside shim/retry.py.  Mounted by tests/test_analysis.py only."""
+
+import time
+
+
+def bad_retry(fn):
+    delay = 0.1
+    while True:
+        try:
+            return fn()
+        except Exception:
+            time.sleep(delay)
+            delay *= 2  # the exponential schedule, re-derived

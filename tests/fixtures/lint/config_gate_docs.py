@@ -1,0 +1,15 @@
+"""Trigger fixture for the config-gate-docs rule: a stand-in for
+config.py whose SimConfig grew a capability gate on a field BASELINE.md
+documents nowhere.  Mounted (shadowing config.py) by
+tests/test_analysis.py only — never imported."""
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class SimConfig:
+    frobnicate_level: int = 0  # no BASELINE.md config-gate matrix row
+
+    def __post_init__(self) -> None:
+        if self.frobnicate_level > 3:
+            raise ValueError("frobnicate_level must be <= 3")

@@ -1,0 +1,20 @@
+"""Trigger fixture for the jit-hygiene rule: a host clock call in a
+traced module, plus a sync call and a Python branch on a traced value
+inside a lax.scan body.  Mounted under core/ by tests/test_analysis.py
+only — never imported."""
+
+import time
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def bad_scan(xs):
+    def step(carry, x):
+        stamp = time.time()  # host clock: freezes at trace time
+        if carry > 0:  # Python branch on a traced value
+            x = x + 1
+        host = x.item()  # device sync, once per scan step
+        return carry + x, host + stamp
+
+    return lax.scan(step, jnp.int32(0), xs)

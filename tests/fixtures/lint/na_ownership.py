@@ -1,0 +1,7 @@
+"""Trigger fixture for the na-render-ownership rule: re-derives the
+absent-not-zero "n/a" rendering instead of calling obs.schema.na.
+Mounted by tests/test_analysis.py only."""
+
+
+def bad_render(value):
+    return "n/a" if value is None else str(value)

@@ -1,0 +1,7 @@
+"""Trigger fixture for the obs-logsite-coverage rule: a stand-in for
+cosim.py with a kind="..." log site the schema maps don't know.
+Mounted (shadowing cosim.py) by tests/test_analysis.py only."""
+
+
+def emit(log) -> None:
+    log.append(round=0, kind="totally_new_kind")  # bypasses the schema

@@ -1,0 +1,15 @@
+"""Trigger fixture for the obs-scan-coverage rule: a stand-in for
+core/rounds.py whose RoundMetrics grew a field that is neither mapped
+to a schema kind nor explicitly unexported.  Mounted (shadowing
+core/rounds.py) by tests/test_analysis.py only — never imported."""
+
+from typing import NamedTuple
+
+
+class RoundMetrics(NamedTuple):
+    true_detections: object
+    unmapped_new_metric: object  # no SCAN_FIELD_MAP / SCAN_UNEXPORTED row
+
+
+class MetricsCarry(NamedTuple):
+    first_detect: object

@@ -1,0 +1,11 @@
+"""Trigger fixture for the obsparse-ownership rule: hand-parses an obs
+event line (json.loads + the "kind" key in one function) instead of
+going through obs.schema.Event.from_record.  Mounted by
+tests/test_analysis.py only."""
+
+import json
+
+
+def bad_parse(line: str) -> bool:
+    rec = json.loads(line)
+    return rec.get("kind") == "confirm"  # schema knowledge, re-derived

@@ -1,0 +1,8 @@
+"""Trigger fixture for the quantile-ownership rule: builds the
+p50/p95 rollup keys by hand instead of calling
+traffic.workload.quantiles.  Mounted by tests/test_analysis.py only."""
+
+
+def bad_rollup(vals):
+    s = sorted(vals)
+    return {"p50_ms": s[len(s) // 2], "p95_ms": s[-1]}

@@ -1,0 +1,12 @@
+"""Trigger fixture for the quorum-ownership rule: re-derives the
+W=floor((n+1)/2) arithmetic instead of importing sdfs/quorum.py.
+Mounted over gossipfs_tpu/traffic/ by tests/test_analysis.py only —
+never imported."""
+
+
+def bad_write_quorum(n: int) -> int:
+    return (n + 1) // 2  # the owned expression, re-derived
+
+
+def bad_claimed_quorum(n: int) -> int:
+    return n // 2 + 1  # the ceil form, re-derived

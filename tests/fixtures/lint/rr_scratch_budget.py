@@ -1,0 +1,9 @@
+"""Trigger fixture for the rr-scratch-budget probe rule: the drift it
+exists to catch is a kernel allocation the budget list stops charging.
+The probe cannot be triggered by mounting a source file (it reconciles
+RUNTIME allocations), so this fixture carries the injection knob:
+tests/test_analysis.py calls ``probes._reconcile(spec_drop=SPEC_DROP)``,
+simulating a budget list missing the kernel's last spec, and asserts
+the byte-sum reconciliation fires."""
+
+SPEC_DROP = 1

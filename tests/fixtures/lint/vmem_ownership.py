@@ -1,0 +1,10 @@
+"""Trigger fixture for the vmem-scratch-ownership rule: allocates VMEM
+scratch outside ops/merge_pallas.py, where the scratch-budget
+reconciliation cannot see it.  Mounted by tests/test_analysis.py only —
+never imported (the import below is AST surface, not runtime)."""
+
+from jax.experimental.pallas import tpu as pltpu
+
+
+def bad_scratch():
+    return pltpu.VMEM((8, 128), "int8")  # unbudgeted allocation

@@ -1,0 +1,147 @@
+"""gossipfs-lint (gossipfs_tpu/analysis/ + tools/lint.py).
+
+The analyzer is itself tested, not trusted:
+  * every registered rule has a committed fixture under
+    tests/fixtures/lint/ that makes it FIRE (mounted over the repo via
+    the overlay index — nothing in the tree changes);
+  * the repo itself is CLEAN under every rule (the tier-1 enforcement
+    that replaced the scattered ad-hoc lint tests);
+  * the CLI exits 0 on clean, 1 on findings, 2 on usage errors — the
+    contract CI hooks rely on;
+  * the native sanitizer/lint targets the round-15 satellite added stay
+    present in native/Makefile (cheap fast-lane guard; the sanitizer
+    RUNS ride the slow lane in tests/test_native_sanitizers.py).
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from gossipfs_tpu.analysis import REGISTRY, RepoIndex, run_rules
+from gossipfs_tpu.analysis import probes
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+FIXTURES = REPO / "tests" / "fixtures" / "lint"
+
+_AST_RULES = sorted(n for n, r in REGISTRY.items() if r.kind == "ast")
+
+
+# ---------------------------------------------------------------------------
+# registry completeness: every rule ships its trigger fixture
+# ---------------------------------------------------------------------------
+
+
+def test_every_rule_has_a_committed_fixture():
+    for name, r in REGISTRY.items():
+        assert r.fixture, f"rule {name} ships no fixture"
+        assert (FIXTURES / r.fixture).is_file(), (name, r.fixture)
+        if r.kind == "ast":
+            assert r.fixture_at, f"ast rule {name} has no mount point"
+
+
+# ---------------------------------------------------------------------------
+# each rule fires on its fixture, and ONLY via its own name
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("name", _AST_RULES)
+def test_rule_fires_on_fixture(name):
+    r = REGISTRY[name]
+    idx = RepoIndex(overlay={r.fixture_at: FIXTURES / r.fixture})
+    findings = r.check(idx)
+    assert findings, f"rule {name} did not fire on its fixture"
+    assert all(f.rule == name for f in findings)
+    # the finding anchors to the mounted file (shadow mounts report the
+    # virtual path), so a CI consumer can jump to the line
+    assert any(f.path == r.fixture_at for f in findings), findings
+
+
+def test_probe_rule_fires_on_injected_budget_drift():
+    """The rr-scratch-budget probe reconciles RUNTIME allocations, so
+    its committed fixture carries an injection knob instead of a mount:
+    dropping the budget's last spec must break the byte-sum
+    reconciliation."""
+    ns: dict = {}
+    exec((FIXTURES / "rr_scratch_budget.py").read_text(), ns)
+    findings = probes._reconcile(spec_drop=ns["SPEC_DROP"])
+    assert findings and all(f.rule == "rr-scratch-budget"
+                            for f in findings)
+    assert any("!= rr_align_scratch_bytes" in f.message for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# the repo runs clean — the actual enforcement
+# ---------------------------------------------------------------------------
+
+
+def test_repo_clean_under_all_ast_rules():
+    # (the rr-scratch-budget probe's clean run stays where it always
+    # lived — tests/test_merge_pallas.py::test_rr_scratch_budget_lint,
+    # now a thin wrapper over analysis.probes)
+    findings = run_rules(RepoIndex())
+    assert not findings, "\n".join(str(f) for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# CLI contract (the tier-1 fast-lane invocation of tools/lint.py)
+# ---------------------------------------------------------------------------
+
+
+def _cli(*args):
+    return subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint.py"), *args],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+
+
+def test_cli_clean_repo_exits_zero():
+    out = _cli()
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_cli_lists_every_rule():
+    out = _cli("--list")
+    assert out.returncode == 0
+    for name in REGISTRY:
+        assert name in out.stdout, name
+
+
+def test_cli_exits_nonzero_on_findings_and_emits_json():
+    overlay = ("gossipfs_tpu/traffic/_lint_fixture.py="
+               "tests/fixtures/lint/quorum_ownership.py")
+    out = _cli("--overlay", overlay, "--json")
+    assert out.returncode == 1, out.stdout + out.stderr
+    findings = json.loads(out.stdout)
+    assert any(f["rule"] == "quorum-ownership" for f in findings)
+    # rule subsetting keeps the exit-code contract
+    out = _cli("--overlay", overlay, "--rule", "quorum-ownership")
+    assert out.returncode == 1
+    out = _cli("--overlay", overlay, "--rule", "backoff-ownership")
+    assert out.returncode == 0
+
+
+def test_cli_usage_errors_exit_two():
+    assert _cli("--rule", "no-such-rule").returncode == 2
+    assert _cli("--overlay", "missing-equals").returncode == 2
+    # internal errors (unreadable/unparseable overlay) are 2 as well —
+    # never 1, which a CI hook would read as "findings exist"
+    assert _cli("--overlay",
+                "gossipfs_tpu/traffic/_x.py=/nonexistent.py",
+                "--rule", "quorum-ownership").returncode == 2
+
+
+# ---------------------------------------------------------------------------
+# native satellite: the sanitizer/lint targets stay wired
+# ---------------------------------------------------------------------------
+
+
+def test_native_makefile_has_sanitizer_targets():
+    mk = (REPO / "native" / "Makefile").read_text()
+    for target in ("tsan:", "asan:", "lint-native:"):
+        assert target in mk, f"native/Makefile lost the {target} target"
+    assert (REPO / "native" / ".clang-tidy").is_file()
+    assert (REPO / "native" / "sanitize_main.cc").is_file()

@@ -709,77 +709,20 @@ def test_rr_scratch_budget_lint():
     """Reconcile rr_align_scratch_bytes against the kernel's ACTUAL pltpu
     scratch allocations (and the flags input block against the bytes
     rr_flags_bytes charges), so the budget math can never silently drift
-    from the kernel again: the spec list the wrapper allocates from must
-    appear verbatim in the pallas_call, and its byte sum must equal the
-    budget formula's.  Also pins the headline acceptance: the rotated
-    layouts admit >= 512k rows at c_blk=512 (the old ~367k ceiling), and
-    the budget still rejects the shapes the round-5 reviews caught."""
-    import math
+    from the kernel again — plus the rotated row-budget acceptance
+    shapes (>= 512k rows at c_blk=512; the round-5 layouts still
+    rejected).
 
-    from gossipfs_tpu.config import AGE_CLAMP
-    from gossipfs_tpu.core.state import FAILED, MEMBER, UNKNOWN
-    from gossipfs_tpu.ops import merge_pallas as mp
-    from jax.experimental import pallas as pl
+    Round 15: the reconciliation itself migrated to the gossipfs-lint
+    registry (gossipfs_tpu/analysis/probes.py, the rr-scratch-budget
+    probe rule — ``tools/lint.py --probe`` runs it outside pytest, and
+    its drift-injection fixture lives in tests/fixtures/lint/).  This
+    wrapper keeps the enforcement at its historical home on the fast
+    lane; every assertion above survives as a probe finding."""
+    from gossipfs_tpu.analysis import probes
 
-    n, nloc, fanout, align, c_blk = 2048, 512, 16, 8, 512
-    hb, asl, flags, sa, sb, g, bases = _rr_tall_skinny_inputs(
-        n, nloc, fanout, align)
-    captured = {}
-    real = pl.pallas_call
-
-    def spy(kernel, **kwargs):
-        captured["scratch"] = kwargs.get("scratch_shapes")
-        captured["in_specs"] = kwargs.get("in_specs")
-        return real(kernel, **kwargs)
-
-    mp.pl.pallas_call = spy
-    try:
-        mp.resident_round_blocked(
-            bases, hb, asl, flags, sa, sb, g,
-            fanout=fanout, member=int(MEMBER), unknown=int(UNKNOWN),
-            failed=int(FAILED), age_clamp=AGE_CLAMP, window=126,
-            t_fail=5, t_cooldown=12, block_r=128, arc_align=align,
-            resident=True, interpret=True)
-    finally:
-        mp.pl.pallas_call = real
-
-    def key(s):
-        return (tuple(s.shape), jnp.dtype(s.dtype))
-
-    ch = mp.rr_view_chunk(n, c_blk, resident=True, arc_align=align)
-    specs = mp.rr_align_scratch_specs(n, fanout, c_blk, align, chunk=ch)
-    alloc = []
-    for s in captured["scratch"]:
-        try:
-            alloc.append(key(s))
-        except TypeError:
-            pass  # DMA semaphore specs carry no numeric dtype
-    for s in specs:
-        assert key(s) in alloc, f"budget charges {key(s)}, kernel lacks it"
-    spec_bytes = sum(math.prod(s.shape) * jnp.dtype(s.dtype).itemsize
-                     for s in specs)
-    assert spec_bytes == mp.rr_align_scratch_bytes(
-        n, fanout, c_blk, align, chunk=ch)
-    # ring-rotated: ONLY the int8 W buffer scales with rows — the bf16
-    # ring + head are fixed-size (chunk + halo geometry)
-    nb, nw = n // align, fanout // align
-    assert spec_bytes == (nb * c_blk                      # W
-                          + ((ch // align) + 2 * (nw - 1)) * c_blk * 2)
-    # flags input block: the LANE-compacted [N/LANE, LANE] layout, at the
-    # bytes rr_flags_bytes charges
-    fspec = captured["in_specs"][2]
-    assert tuple(fspec.block_shape) == (n // mp.LANE, mp.LANE)
-    assert mp.rr_flags_bytes(n, c_blk, block_r=128, resident=True,
-                             arc_align=align) == n
-    # acceptance: the rotated layouts lift the sharded aligned rr row
-    # ceiling past 512k rows at c_blk=512 (16,384 local columns — the
-    # 16-chip anchor shard width); the round-5 layouts cap out below 393k
-    assert mp.rr_supported(524288, 24, 512, 16384, arc_align=8, block_r=512)
-    assert mp.rr_supported(786432, 24, 512, 16384, arc_align=8, block_r=512)
-    assert not mp.rr_supported(393216, 24, 512, 16384, arc_align=8,
-                               block_r=512, rotate=False)
-    # wider stripes at existing anchors: N=262,144 now admits c_blk=2048
-    assert mp.rr_supported(262144, 24, 2048, 16384, arc_align=8, block_r=512)
+    findings = probes.check_rr_scratch_budget(None)
+    assert not findings, "\n".join(str(f) for f in findings)
 
 
 @pytest.mark.parametrize("topology,rr_resident,arc_align", [

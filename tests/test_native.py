@@ -16,6 +16,20 @@ if shutil.which("g++") is None or shutil.which("make") is None:
     pytest.skip("no native toolchain", allow_module_level=True)
 
 from gossipfs_tpu import native
+
+# Round 15: force the staleness check BEFORE anything loads the library.
+# The old flow only rebuilt on strictly-newer source mtimes, so a fresh
+# checkout (every file stamped alike) or a stray committed .so ran the
+# whole module silently against a binary built from DIFFERENT sources.
+# ensure_fresh() rebuilds on at-or-newer sources (Makefile included),
+# and a broken rebuild is a loud collection failure — never a skip that
+# hides a compile error in engine.cc.
+try:
+    native.ensure_fresh()
+except native.NativeBuildError as e:
+    pytest.fail(f"native sources changed but the rebuild failed:\n{e}",
+                pytrace=False)
+
 from gossipfs_tpu.detector.udp import ENTRY_SEP, FIELD_SEP, UdpNode
 
 

@@ -1,0 +1,97 @@
+#!/usr/bin/env python3
+"""gossipfs-lint CLI — run the repo-wide invariant analyzer.
+
+Usage::
+
+    python tools/lint.py                 # all AST rules, exit 1 on findings
+    python tools/lint.py --list          # rule table
+    python tools/lint.py --rule NAME     # a subset (repeatable)
+    python tools/lint.py --probe         # include probe rules (imports jax)
+    python tools/lint.py --json          # machine-readable findings
+    python tools/lint.py --overlay gossipfs_tpu/x.py=tests/fixtures/lint/y.py
+                                         # mount a file over the scanned
+                                         # tree (fixture/exit-code testing)
+
+Exit codes: 0 clean, 1 findings, 2 usage/internal error.  The rule
+registry lives in ``gossipfs_tpu/analysis/`` — see its module docstring
+and BASELINE.md's "Static analysis" section.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gossipfs_tpu.analysis import REGISTRY, RepoIndex, run_rules  # noqa: E402
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="gossipfs-lint",
+        description="repo-wide invariant analyzer "
+                    "(gossipfs_tpu/analysis/)")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered rules and exit")
+    ap.add_argument("--rule", action="append", default=None,
+                    help="run only this rule (repeatable)")
+    ap.add_argument("--probe", action="store_true",
+                    help="include probe rules (import jax; slower)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as JSON")
+    ap.add_argument("--overlay", action="append", default=[],
+                    metavar="VIRTUAL=REAL",
+                    help="mount REAL file at repo-relative VIRTUAL path")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for name, r in sorted(REGISTRY.items()):
+            print(f"{name} [{r.kind}]: {r.description}")
+        return 0
+
+    if args.rule:
+        unknown = set(args.rule) - set(REGISTRY)
+        if unknown:
+            print(f"unknown rule(s): {sorted(unknown)} "
+                  f"(see --list)", file=sys.stderr)
+            return 2
+
+    overlay = {}
+    for spec in args.overlay:
+        if "=" not in spec:
+            print(f"bad --overlay (want VIRTUAL=REAL): {spec}",
+                  file=sys.stderr)
+            return 2
+        virt, real = spec.split("=", 1)
+        overlay[virt] = real
+
+    kinds = ("ast", "probe") if args.probe else ("ast",)
+    try:
+        # internal errors must land on the documented exit-code contract
+        # (2), never on a traceback that exits 1 — a CI hook keying on
+        # "1 = findings" would report findings that do not exist.
+        # ImportError: a probe rule's heavy dependency is missing
+        # (naming one with --rule is explicit consent to try);
+        # SyntaxError/OSError: an unparseable or unreadable file (a
+        # broken --overlay path, or a syntactically invalid source)
+        findings = run_rules(RepoIndex(overlay=overlay), names=args.rule,
+                             kinds=kinds)
+    except (ImportError, SyntaxError, OSError) as e:
+        print(f"lint internal error: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+    if args.as_json:
+        print(json.dumps([f.__dict__ for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f)
+        if findings:
+            print(f"\n{len(findings)} finding(s)", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
