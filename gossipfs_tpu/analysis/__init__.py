@@ -8,6 +8,8 @@ test modules, plus the checks every review re-derived by eye:
   schedules, obs line parsing, quantile rollups, VMEM scratch, the
   ``n/a`` rendering) — ``rules_ownership``
 * obs-schema coverage of every metric field and log site — ``rules_obs``
+* the native engine's event kinds / vitals fields vs the schema, across
+  the language boundary — ``rules_native``
 * config capability gates documented in BASELINE.md — ``rules_config``
 * jit-hygiene for ``core/``/``ops/`` — ``rules_jit``
 * asyncio-hygiene for the socket engine — ``rules_asyncio``
@@ -33,6 +35,7 @@ from gossipfs_tpu.analysis import (  # noqa: E402,F401
     rules_asyncio,
     rules_config,
     rules_jit,
+    rules_native,
     rules_obs,
     rules_ownership,
 )

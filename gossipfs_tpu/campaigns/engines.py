@@ -16,7 +16,14 @@ and drives them through
   * the per-process deployment (``deploy/launcher.py`` — one OS process
     per node, the rule table pushed over the control plane with the
     round-14 bounded-backoff RPC discipline, crashes as ``kill -9``,
-    events tailed from the per-node ``node<i>.log`` schema streams),
+    events tailed from the per-node ``node<i>.log`` schema streams), or
+  * the native C++ epoll engine (``native/engine.cc`` via
+    ``gossipfs_tpu/native.py`` — the sanitizer-certified runtime; the
+    scenario compiled to the in-engine send-gate table, suspicion + the
+    Lifeguard stretch running inside the engine, events drained over
+    ``gfs_obs_drain`` and rendered through the same ``FlightRecorder``
+    — the COHORT-EXACT lane: committed n=256+ cases run at their
+    committed n, which the asyncio loop cannot sustain),
 
 then feeds the recorded stream through ``StreamMonitor.feed_jsonl`` —
 the SAME file-attachment seam, the SAME invariant table, the SAME
@@ -57,7 +64,7 @@ from gossipfs_tpu.campaigns.driver import (
 from gossipfs_tpu.obs.monitor import MonitorParams, StreamMonitor
 from gossipfs_tpu.scenarios.schedule import FaultScenario
 
-ENGINES = ("tensor", "udp", "deploy")
+ENGINES = ("tensor", "udp", "deploy", "native")
 
 
 def scale_case(doc: dict, n: int) -> dict:
@@ -112,6 +119,24 @@ def scale_case(doc: dict, n: int) -> dict:
         c["lh_frac"] = min(float(c["lh_frac"]) * n_old / n, 0.5)
     out["scaled_from"] = n_old
     return out
+
+
+def _case_plan(doc: dict):
+    """The run plan every socket engine derives from a case doc —
+    ``(n, scenario, crash_at, rounds, victims)`` with ONE owner, so a
+    change to the bound/rounds derivation cannot silently
+    desynchronize the engines' run lengths (``campaign_rounds``'
+    single-owner rationale, extended to the whole scaffold)."""
+    from gossipfs_tpu.bench.run import tracked_victims
+
+    c = doc["config"]
+    n = int(c["n"])
+    sc = FaultScenario.from_json(json.dumps(doc["scenario"]))
+    crash_at = int(c.get("crash_at", 10))
+    bound = doc["monitor"].get("reconverge_bound") or (int(c["t_fail"]) + 6)
+    rounds = campaign_rounds(sc.horizon, crash_at, bound)
+    victims = tracked_victims(n, int(c["track"]))
+    return n, sc, crash_at, rounds, victims
 
 
 def _suspicion_params(c: dict):
@@ -204,17 +229,11 @@ async def _udp_case(doc: dict, trace: str, period: float,
                     warmup_timeout: float) -> dict[int, int]:
     """Drive one case on an in-process UdpCluster; returns the crash
     schedule ({victim: round}) for the monitor's TTD accounting."""
-    from gossipfs_tpu.bench.run import tracked_victims
     from gossipfs_tpu.detector.udp import UdpCluster
     from gossipfs_tpu.obs.recorder import FlightRecorder
 
     c = doc["config"]
-    n = int(c["n"])
-    sc = FaultScenario.from_json(json.dumps(doc["scenario"]))
-    crash_at = int(c.get("crash_at", 10))
-    bound = doc["monitor"].get("reconverge_bound") or (int(c["t_fail"]) + 6)
-    rounds = campaign_rounds(sc.horizon, crash_at, bound)
-    victims = tracked_victims(n, int(c["track"]))
+    n, sc, crash_at, rounds, victims = _case_plan(doc)
 
     from gossipfs_tpu.config import SimConfig
 
@@ -273,12 +292,24 @@ async def _udp_case(doc: dict, trace: str, period: float,
         cluster.stop_all()
 
 
-def run_case_udp(doc: dict, *, period: float = 0.05,
+def udp_period(n: int) -> float:
+    """The asyncio lane's default heartbeat period: one python event
+    loop parses n full-list datagram fan-outs per period, and the
+    engine is documented load-sensitive (UDPCAMPAIGN_r14) — n=64 runs
+    all ride 0.1 s in the committed evidence while the n=24 tier-1
+    smoke keeps 0.05 s.  ~1.5 ms of loop budget per node, floored at
+    the small-lane 0.05 s."""
+    return max(0.05, n / 640.0)
+
+
+def run_case_udp(doc: dict, *, period: float | None = None,
                  trace: str | None = None,
                  warmup_timeout: float = 60.0) -> dict:
     """One case on the asyncio UDP engine; returns the ledger-row shape
     plus the written trace path (re-feed it through
     ``StreamMonitor.feed_jsonl`` to re-derive the verdict)."""
+    if period is None:
+        period = udp_period(int(doc["config"]["n"]))
     if trace is None:
         trace = tempfile.mktemp(prefix="udp_case_", suffix=".jsonl")
     crash_rounds = asyncio.run(
@@ -287,6 +318,108 @@ def run_case_udp(doc: dict, *, period: float = 0.05,
                        int(doc["config"]["n"]),
                        crash_rounds=crash_rounds)
     row.update(engine="udp", trace=str(trace), period=period)
+    return row
+
+
+# ---------------------------------------------------------------------------
+# native engine (C++ epoll — campaigns/engines' third real transport)
+# ---------------------------------------------------------------------------
+
+
+def native_period(n: int) -> float:
+    """The native lane's default heartbeat period: the engine is one
+    epoll thread doing all N nodes' protocol work, and detection clocks
+    are WALL time — a period the tick+merge pass can't keep costs
+    false positives by PHYSICS (rounds lag, entries look stale), not
+    protocol.  ~2 ms of budget per node: at n=256 the full-list merge
+    pass costs ~60-100 ms/round on the 1-core box (measured via the
+    round_tick ``tick_ms`` samples — n/1024 s was observably too tight:
+    warmup churned with view-shrink storms), so n/512 leaves the round
+    ~5x of headroom.  The floor is 0.1 s — 2x the asyncio lane's small-n
+    floor on purpose: the native engine ticks EVERY node at the same
+    instant (one loop), so entry ages are quantized to whole periods
+    and the t_fail staleness edge is one scheduling hiccup wide, where
+    the asyncio engine's per-node tasks stagger their phases across
+    the period."""
+    return max(0.1, n / 512.0)
+
+
+def run_case_native(doc: dict, *, period: float | None = None,
+                    trace: str | None = None,
+                    warmup_timeout: float = 120.0) -> dict:
+    """One case on the native C++ epoll engine (real localhost
+    datagrams, one OS thread) — the cohort-exact lane: the asyncio
+    engine honestly melts past n~64 (UDPCAMPAIGN_r14), so committed
+    n=256+ cases run here at their COMMITTED n instead of rescaled.
+
+    Same contract as :func:`run_case_udp`: campaign protocol profile
+    (random log-fanout push, gossip-only removal), seeded steady-state
+    start, the scenario armed as the engine's send-gate table, the
+    recorded ``gossipfs-obs/v1`` stream fed back through
+    ``StreamMonitor.feed_jsonl``.  The native round_ticks carry
+    in-process ground truth, so the full invariant table (fpr_storm
+    included) evaluates; ``tick_ms`` rides every round_tick and the
+    returned row carries the per-round latency histogram (the 'did the
+    engine keep its period' evidence a real-time verdict rests on).
+    """
+    import time as _time
+
+    from gossipfs_tpu.config import SimConfig
+    from gossipfs_tpu.native import NativeUdpDetector, latency_histogram
+    from gossipfs_tpu.obs.recorder import FlightRecorder, load_stream
+
+    c = doc["config"]
+    n, sc, crash_at, rounds, victims = _case_plan(doc)
+    if period is None:
+        period = native_period(n)
+    if trace is None:
+        trace = tempfile.mktemp(prefix="native_case_", suffix=".jsonl")
+
+    det = NativeUdpDetector(
+        n, base_port=_free_udp_base(n), period=period,
+        t_fail=int(c["t_fail"]),
+        t_cooldown=max(12, int(c["t_fail"]) + 4), fresh_cooldown=True,
+        push="random", fanout=SimConfig.log_fanout(n),
+        remove_broadcast=False, suspicion=_suspicion_params(c),
+    )
+    try:
+        det.seed_full_membership()
+        deadline = _time.monotonic() + warmup_timeout
+        while not det.warm():
+            if _time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"native cluster (n={n}) did not converge within "
+                    f"{warmup_timeout}s of warmup")
+            _time.sleep(period)
+        rec = FlightRecorder(trace, source="native-campaign", n=n,
+                             case=doc.get("family", "case"),
+                             crash_rounds={str(v): crash_at
+                                           for v in victims})
+        # one relative clock for the stream AND the gate windows: the
+        # absolute round attach_recorder rebased to anchors both
+        r0 = det.attach_recorder(rec)
+        det.load_scenario(sc, round0=r0)
+        det.advance((r0 + crash_at) - det.round)
+        for v in victims:
+            det.crash(v)
+        remaining = (r0 + rounds) - det.round
+        if remaining > 0:
+            det.advance(remaining)
+        # stop the loop BEFORE draining: the drain's host-side parse is
+        # seconds of CPU the 1-core epoll thread would otherwise lose —
+        # enough wall time to stale entries and cascade manufactured
+        # FPs into the recorded tail (gfs_stop's raison d'etre)
+        det.stop()
+        det.pump_obs()
+        rec.close()
+    finally:
+        det.close()
+
+    row = _monitor_row(trace, MonitorParams.from_dict(doc["monitor"]), n,
+                       crash_rounds={v: crash_at for v in victims})
+    _, events = load_stream(trace)
+    row.update(engine="native", trace=str(trace), period=period,
+               tick_ms=latency_histogram(events))
     return row
 
 
@@ -328,16 +461,10 @@ def run_case_deploy(doc: dict, *, period: float = 0.1,
     invariants their streams can carry (``verdict_agreement`` compares
     only those against the tensor run).
     """
-    from gossipfs_tpu.bench.run import tracked_victims
     from gossipfs_tpu.deploy.launcher import Cluster
 
     c = doc["config"]
-    n = int(c["n"])
-    sc = FaultScenario.from_json(json.dumps(doc["scenario"]))
-    crash_at = int(c.get("crash_at", 10))
-    bound = doc["monitor"].get("reconverge_bound") or (int(c["t_fail"]) + 6)
-    rounds = campaign_rounds(sc.horizon, crash_at, bound)
-    victims = tracked_victims(n, int(c["track"]))
+    n, sc, crash_at, rounds, victims = _case_plan(doc)
 
     cluster = Cluster(n, period=period, t_fail=int(c["t_fail"]))
     try:
@@ -404,6 +531,9 @@ def run_case_engine(path, engine: str = "udp", *, scale_n: int | None = None,
     if engine == "udp":
         row = run_case_udp(doc, **({"period": period} if period else {}),
                            trace=trace)
+    elif engine == "native":
+        row = run_case_native(doc, **({"period": period} if period else {}),
+                              trace=trace)
     else:
         row = run_case_deploy(doc, **({"period": period} if period else {}),
                               trace=trace)

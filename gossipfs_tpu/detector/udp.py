@@ -338,6 +338,18 @@ class UdpNode:
                     continue
                 window = rt.t_suspect_window(c.period, len(self.members))
                 if not rt.expired(addr, now, window):
+                    # periodic re-notification (round 16, shared with the
+                    # native engine): the original SUSPECT broadcast may
+                    # have been sent into a fault window — a rack outage
+                    # drops it, so the subject never learns and the
+                    # post-heal refute wave rides passive list gossip
+                    # alone, leaking a bi-modal heal-race FP burst.  One
+                    # subject-only datagram per suspect per tick triggers
+                    # the active incarnation-bump refute the moment the
+                    # subject is reachable again; the REFUTE broadcast is
+                    # rate-limited on the subject's side, so k
+                    # re-notifiers cost one bump per period.
+                    self._send(addr, f"{addr}{CMD_SEP}SUSPECT")
                     continue
                 rt.confirm(addr)
             # detection first, then the removal it causes — the same
@@ -579,7 +591,17 @@ class UdpCluster:
         return doc
 
     def record_detection(self, observer: int, subject_addr: str) -> None:
-        subject = self._addr_to_idx[subject_addr]
+        subject = self._addr_to_idx.get(subject_addr)
+        if subject is None:
+            # a wire-learned address outside the cluster (a stray
+            # datagram from a port-space neighbour merged a ghost
+            # member): the removal already happened at the caller —
+            # nothing to account.  Raising here aborted the observer's
+            # tick at the detection step EVERY period (the ghost stays
+            # stale), which froze its pushes and stormed the cluster
+            # with real FPs; the native engine's IdxOf guard is the
+            # same contract.
+            return
         fp = self.nodes[subject].alive
         self._det_total += 1
         self._fp_total += int(fp)

@@ -125,6 +125,23 @@ def load_library() -> ctypes.CDLL:
         for fn in (lib.gfs_codec_encode, lib.gfs_codec_decode):
             fn.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
             fn.restype = ctypes.c_int
+        # round-16 observability + campaign surface
+        lib.gfs_configure.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.gfs_configure.restype = ctypes.c_int
+        lib.gfs_obs_enable.argtypes = [ctypes.c_void_p]
+        lib.gfs_obs_enable.restype = ctypes.c_int
+        for fn in (lib.gfs_obs_drain, lib.gfs_vitals):
+            fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+            fn.restype = ctypes.c_int
+        lib.gfs_scenario_load.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int
+        ]
+        lib.gfs_scenario_load.restype = ctypes.c_int
+        lib.gfs_scenario_clear.argtypes = [ctypes.c_void_p]
+        lib.gfs_stop.argtypes = [ctypes.c_void_p]
+        lib.gfs_seed_full.argtypes = [ctypes.c_void_p]
+        lib.gfs_warm.argtypes = [ctypes.c_void_p]
+        lib.gfs_warm.restype = ctypes.c_int
         _lib = lib
         return _lib
 
@@ -163,6 +180,117 @@ def codec_decode(wire: str) -> list[tuple[str, int, float]]:
     return entries
 
 
+# -- observability plane (obs/) ---------------------------------------------
+
+# detail values the C++ engine emits as 0/1 ints but the schema carries
+# as booleans (the json the other recorders write)
+_BOOL_DETAIL = frozenset({"false_positive", "scheduled"})
+
+
+def _parse_obs_lines(text: str):
+    """``gfs_obs_drain`` text -> schema Events.
+
+    Line form (one writer, ``Cluster::ObsEmit`` in native/engine.cc):
+    ``kind round observer subject k=v k=v ...`` — kinds are
+    ``obs/schema.py`` EVENT_KINDS members (the native-obs-kinds lint
+    rule enforces it), so the rendered stream is a plain
+    ``gossipfs-obs/v1`` stream and ``obs.recorder.load_stream`` stays
+    the one reader.
+    """
+    from gossipfs_tpu.obs.schema import Event
+
+    events = []
+    for line in text.splitlines():
+        parts = line.split(" ")
+        if len(parts) < 4:
+            continue
+        detail = {}
+        for kv in parts[4:]:
+            k, _, v = kv.partition("=")
+            if k in _BOOL_DETAIL:
+                detail[k] = v not in ("0", "")
+            else:
+                try:
+                    detail[k] = int(v)
+                except ValueError:
+                    try:
+                        detail[k] = float(v)
+                    except ValueError:
+                        detail[k] = v
+        events.append(Event(round=int(parts[1]), observer=int(parts[2]),
+                            subject=int(parts[3]), kind=parts[0],
+                            detail=detail))
+    return events
+
+
+# log-spaced tick_ms histogram buckets (upper bounds, ms); the last
+# bucket is open-ended
+_HIST_EDGES_MS = (0.1, 0.3, 1.0, 3.0, 10.0, 30.0, 100.0, 300.0)
+
+
+def latency_histogram(events) -> dict:
+    """Per-round wall-clock latency histogram from a native stream's
+    ``round_tick.tick_ms`` samples (the epoll tick pass's cost — the
+    real-time engine's 'did we fall behind the period' evidence).
+
+    Returns ``{"count", "p50_ms", "max_ms", "buckets": {"<=0.1": k, ...,
+    ">300.0": k}}``; zero samples -> count 0 and no quantiles (absent,
+    not 0 — the n/a rule).
+    """
+    import bisect
+    import statistics
+
+    samples = sorted(
+        e.detail["tick_ms"] for e in events
+        if e.kind == "round_tick" and "tick_ms" in e.detail)
+    doc: dict = {"count": len(samples)}
+    if not samples:
+        return doc
+    doc["p50_ms"] = round(statistics.median(samples), 3)
+    doc["max_ms"] = round(samples[-1], 3)
+    buckets: dict[str, int] = {}
+    lo = 0
+    for edge in _HIST_EDGES_MS:
+        hi = bisect.bisect_right(samples, edge)
+        buckets[f"<={edge}"] = hi - lo
+        lo = hi
+    buckets[f">{_HIST_EDGES_MS[-1]}"] = len(samples) - lo
+    doc["buckets"] = buckets
+    return doc
+
+
+def compile_native_scenario(scenario) -> str:
+    """``scenarios.FaultScenario`` -> the native engine's fault-gate
+    table (the text ``gfs_scenario_load`` parses).
+
+    Covers the gate primitives the committed campaign cases use —
+    flapping duty-cycle blackout, correlated-outage rack darkness,
+    timed partition, lagging senders — with ``ScenarioRuntime.drops``
+    semantics applied at ``Node::Send``.  Bernoulli link loss is
+    rejected (an RNG-stream parity question the gate table deliberately
+    does not take on; run those cases on the udp engine).
+    """
+    if scenario.link_faults:
+        raise ValueError(
+            "Bernoulli link loss is not expressible on the native gate "
+            "table — the drop draw would need an RNG-stream parity "
+            "decision; drive loss cases through the udp engine")
+    lines = [f"name {scenario.name.replace(' ', '_')}"]
+    for f in scenario.flapping:
+        ids = " ".join(str(i) for i in f.nodes)
+        lines.append(f"flap {f.start} {f.end} {f.up} {f.down} {ids}")
+    for o in scenario.outages:
+        ids = " ".join(str(i) for i in o.nodes)
+        lines.append(f"outage {o.start} {o.end} {ids}")
+    for p in scenario.partitions:
+        pid = " ".join(str(int(x)) for x in p.pid(scenario.n))
+        lines.append(f"partition {p.start} {p.end} {pid}")
+    for s in scenario.slow_nodes:
+        ids = " ".join(str(i) for i in s.nodes)
+        lines.append(f"slow {s.start} {s.end} {s.stride} {ids}")
+    return "\n".join(lines) + "\n"
+
+
 # -- the engine behind the FailureDetector seam -----------------------------
 
 class NativeUdpDetector:
@@ -172,6 +300,15 @@ class NativeUdpDetector:
     ``detector.udp.UdpDetector`` — the config-1 parity path at native speed.
     ``advance(r)`` blocks for r heartbeat periods of wall time (the native
     engine, like the reference, runs in real time).
+
+    Round 16 — the obs-plane + campaign surface (mirroring UdpCluster's
+    round-14 knobs): ``push="random"``/``fanout``/``remove_broadcast``
+    select the campaign protocol profile, ``suspicion`` arms the SWIM
+    lifecycle (+ Lifeguard local health) inside the engine, and
+    ``attach_recorder`` turns on structured event buffering that
+    ``pump_obs`` drains through the ONE schema (``obs/schema.py``) into
+    the attached ``FlightRecorder`` — so a native trace is a plain
+    ``gossipfs-obs/v1`` stream every existing reader ingests unchanged.
     """
 
     def __init__(
@@ -184,13 +321,37 @@ class NativeUdpDetector:
         min_group: int = 4,
         fresh_cooldown: bool = False,
         introducer: int = 0,
+        push: str = "ring",
+        fanout: int | None = None,
+        remove_broadcast: bool = True,
+        suspicion=None,
     ):
         self._lib = load_library()
         self.n = n
+        self.period = period
+        self.suspicion = suspicion
+        self._recorder = None
+        self._obs_round0 = 0
         self._h = self._lib.gfs_cluster_create(
             n, base_port, period, t_fail, t_cooldown, min_group,
             int(fresh_cooldown), introducer,
         )
+        knobs = []
+        if push != "ring":
+            knobs.append(f"push={push}")
+        if fanout is not None:
+            knobs.append(f"fanout={fanout}")
+        if not remove_broadcast:
+            knobs.append("remove_broadcast=0")
+        if suspicion is not None:
+            knobs.append(f"t_suspect={suspicion.t_suspect}")
+            knobs.append(f"lh_multiplier={suspicion.lh_multiplier}")
+            knobs.append(f"lh_frac={suspicion.lh_frac!r}")
+        if knobs and self._lib.gfs_configure(
+                self._h, " ".join(knobs).encode()) != 0:
+            self._lib.gfs_cluster_destroy(self._h)
+            self._h = None
+            raise ValueError(f"native engine rejected knobs: {knobs}")
         if self._lib.gfs_cluster_start(self._h) != 0:
             self._lib.gfs_cluster_destroy(self._h)
             self._h = None
@@ -224,6 +385,91 @@ class NativeUdpDetector:
         buf = (ctypes.c_int * self.n)()
         count = self._lib.gfs_alive(self._h, buf, self.n)
         return list(buf[:count])
+
+    # -- obs plane (round 16) ----------------------------------------------
+    def attach_recorder(self, recorder) -> int:
+        """Arm an ``obs.FlightRecorder`` (or MonitorRecorder) and enable
+        event buffering in the engine.  Returns the ABSOLUTE engine
+        round the recorded stream's round 0 maps to (the rebased,
+        arming-relative frame the udp campaign streams use)."""
+        self._recorder = recorder
+        self._obs_round0 = self._lib.gfs_obs_enable(self._h)
+        return self._obs_round0
+
+    def pump_obs(self) -> int:
+        """Drain buffered engine events into the attached recorder;
+        returns the event count.  Call after (or periodically during)
+        ``advance`` — the engine buffers until drained."""
+        if self._recorder is None:
+            return 0
+        total = 0
+        cap = 1 << 20
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            got = self._lib.gfs_obs_drain(self._h, buf, cap)
+            if got == -1:
+                cap *= 2
+                continue
+            if got == 0:
+                return total
+            events = _parse_obs_lines(buf.raw[:got].decode())
+            self._recorder.extend(events)
+            total += len(events)
+
+    def vitals(self) -> dict:
+        """The uniform counter set (obs.schema.VITALS_FIELDS).  This
+        engine knows ground-truth aliveness (in-process), so
+        ``false_positives`` is live; suspicion counters appear only when
+        the lifecycle is armed, and ``fp_suppressed`` stays absent — the
+        per-refute ground truth only the sim has (rendered n/a)."""
+        raw = _call_sized(self._lib.gfs_vitals, self._h, 512).decode()
+        doc: dict = {"engine": "native"}
+        for kv in raw.split():
+            k, _, v = kv.partition("=")
+            doc[k] = int(v)
+        mon = getattr(self._recorder, "monitor", None)
+        if mon is not None:
+            doc["invariant_violations"] = len(mon.violations)
+        return doc
+
+    # -- campaign surface (round 16) ---------------------------------------
+    def seed_full_membership(self) -> None:
+        """Start from the fully-joined steady state (the udp engine's
+        ``seed_full_membership``): every node lists everyone at hb 0
+        with a fresh local stamp — inside the hb<=1 detection grace."""
+        self._lib.gfs_seed_full(self._h)
+
+    def warm(self) -> bool:
+        """Whether every live view is full with every counter past the
+        hb<=1 detection grace (the campaign runners' readiness gate)."""
+        return bool(self._lib.gfs_warm(self._h))
+
+    def load_scenario(self, scenario, round0: int | None = None) -> None:
+        """Arm a ``scenarios.FaultScenario`` as the engine's send-gate
+        table.  Windows are anchored at absolute engine round
+        ``round0`` (default: the current round) — pass the round
+        ``attach_recorder`` returned so the gate windows and the
+        recorded stream share one relative clock."""
+        if scenario.n != self.n:
+            raise ValueError(
+                f"scenario is for n={scenario.n}, cluster has n={self.n}")
+        table = compile_native_scenario(scenario)
+        if round0 is None:
+            round0 = self.round
+        if self._lib.gfs_scenario_load(
+                self._h, table.encode(), int(round0)) != 0:
+            raise ValueError(
+                f"native engine rejected the gate table for {scenario.name}")
+
+    def clear_scenario(self) -> None:
+        self._lib.gfs_scenario_clear(self._h)
+
+    def stop(self) -> None:
+        """Halt the epoll loop + sockets, keeping state drainable: call
+        before a big ``pump_obs`` — on a 1-core host a long drain parse
+        starves a still-running loop (rounds lag, entries look stale)
+        and manufactures an FP cascade in the stream's tail."""
+        self._lib.gfs_stop(self._h)
 
     def drain_events(self) -> list[DetectionEvent]:
         cap = 4096 * 4

@@ -6,23 +6,6 @@
 #include <sstream>
 
 namespace gossipfs {
-namespace {
-
-std::vector<std::string> Split(const std::string& s, const std::string& sep) {
-  std::vector<std::string> out;
-  size_t start = 0;
-  while (true) {
-    size_t pos = s.find(sep, start);
-    if (pos == std::string::npos) {
-      out.push_back(s.substr(start));
-      return out;
-    }
-    out.push_back(s.substr(start, pos - start));
-    start = pos + sep.size();
-  }
-}
-
-}  // namespace
 
 std::string EncodeMembers(const std::vector<MemberEntry>& members) {
   std::ostringstream out;
@@ -40,20 +23,42 @@ std::string EncodeMembers(const std::vector<MemberEntry>& members) {
 }
 
 std::vector<MemberEntry> DecodeMembers(const std::string& payload) {
+  // allocation-free scan (round 16): the campaign-cohort merge path
+  // decodes fanout*N lists of N entries per round — the old
+  // Split-into-strings walk allocated ~6 strings per entry and was the
+  // n=256 engine's hottest loop by far.  strtod reads directly into the
+  // payload and stops at the next separator's '<'; the NUL terminating
+  // the std::string bounds the final field.
   std::vector<MemberEntry> out;
   if (payload.empty()) return out;
-  for (const auto& chunk : Split(payload, kEntrySep)) {
-    auto fields = Split(chunk, kFieldSep);
-    if (fields.size() < 2 || fields[0].empty()) continue;
-    char* end = nullptr;
-    double hb = std::strtod(fields[1].c_str(), &end);
-    // skip non-numeric hb; NaN/inf would make the long long cast UB
-    if (end == fields[1].c_str() || !std::isfinite(hb)) continue;
-    MemberEntry m;
-    m.addr = fields[0];
-    m.hb = static_cast<long long>(hb);
-    m.ts = fields.size() >= 3 ? std::strtod(fields[2].c_str(), nullptr) : 0.0;
-    out.push_back(std::move(m));
+  constexpr size_t esz = sizeof(kEntrySep) - 1;
+  constexpr size_t fsz = sizeof(kFieldSep) - 1;
+  const char* base = payload.c_str();
+  size_t pos = 0;
+  for (;;) {
+    size_t end = payload.find(kEntrySep, pos);
+    if (end == std::string::npos) end = payload.size();
+    size_t f1 = payload.find(kFieldSep, pos);
+    if (f1 != std::string::npos && f1 < end && f1 > pos) {
+      size_t hb_off = f1 + fsz;
+      char* endp = nullptr;
+      double hb = std::strtod(base + hb_off, &endp);
+      // skip non-numeric hb; NaN/inf (and counters past the long long
+      // range) would make the cast UB — same silent-skip semantics as
+      // the reference's parse
+      if (endp != base + hb_off && std::isfinite(hb) &&
+          std::fabs(hb) < 9.0e18) {
+        MemberEntry m;
+        m.addr.assign(payload, pos, f1 - pos);
+        m.hb = static_cast<long long>(hb);
+        size_t f2 = payload.find(kFieldSep, hb_off);
+        if (f2 != std::string::npos && f2 < end)
+          m.ts = std::strtod(base + f2 + fsz, nullptr);
+        out.push_back(std::move(m));
+      }
+    }
+    if (end >= payload.size()) break;
+    pos = end + esz;
   }
   return out;
 }

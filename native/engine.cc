@@ -26,6 +26,8 @@
 #include <algorithm>
 #include <atomic>
 #include <chrono>
+#include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <iomanip>
 #include <map>
@@ -45,6 +47,17 @@ double MonotonicNow() {
   return std::chrono::duration<double>(
              std::chrono::steady_clock::now().time_since_epoch())
       .count();
+}
+
+// Uniform-vitals field writer: every field name is a string literal at
+// its call site, and gossipfs-lint's native-obs-kinds rule requires
+// each to appear in obs/schema.py VITALS_FIELDS — single ownership of
+// the counter names across the language boundary (the n/a-not-0 rule:
+// a field this engine cannot know is simply never appended, so the
+// Python surface renders it n/a, never a fabricated 0).
+void AppendVital(std::ostringstream& os, const char* key, long long v) {
+  if (os.tellp() > 0) os << ' ';
+  os << key << '=' << v;
 }
 
 struct Member {
@@ -68,6 +81,53 @@ struct Config {
   int min_group = 4;     // below this size: refresh-only
   bool fresh_cooldown = false;  // stamp fail-list entries at removal time
   int introducer = 0;
+  // campaign protocol profile (gfs_configure, round 16) — the same knobs
+  // the asyncio engine grew in round 14 (detector/udp.py UdpCluster):
+  // push_random = fanout random listed peers per tick instead of the
+  // reference's ring positions; remove_broadcast=false = removal by
+  // local timeout only (the north-star gossip-only dissemination).
+  bool push_random = false;
+  int fanout = 3;
+  bool remove_broadcast = true;
+  // SWIM suspicion + Lifeguard local health (suspicion/params.py is the
+  // schema; suspicion/runtime.py the per-node reference semantics the
+  // Tick/Merge paths below mirror).  t_suspect == 0 disarms.
+  int t_suspect = 0;
+  int lh_multiplier = 0;
+  double lh_frac = 0.25;
+};
+
+// -- fault gates (scenarios/schedule.py primitives, compiled to a text
+// table by gossipfs_tpu/native.py::compile_native_scenario and pushed
+// over gfs_scenario_load).  Semantics mirror ScenarioRuntime.drops:
+// a src -> dst datagram at armed-relative round r is dropped iff any
+// active rule says so.  Bernoulli link loss is deliberately NOT in the
+// table (it needs an RNG-stream parity decision; the Python compiler
+// rejects it, like the aligned-arc tensor path does).
+struct GateFlap {
+  int start, end, up, down;
+  std::vector<char> mask;  // [n] sender membership
+};
+struct GateOutage {
+  int start, end;
+  std::vector<char> mask;  // [n] group membership (src OR dst drops)
+};
+struct GatePartition {
+  int start, end;
+  std::vector<int> pid;  // [n] group id; cross-pid drops
+};
+struct GateSlow {
+  int start, end, stride;
+  std::vector<char> mask;  // [n] lagging senders
+};
+
+struct GateTable {
+  std::vector<GateFlap> flaps;
+  std::vector<GateOutage> outages;
+  std::vector<GatePartition> partitions;
+  std::vector<GateSlow> slows;
+  std::string name;
+  int horizon = 0;
 };
 
 class Cluster;
@@ -85,6 +145,7 @@ class Node {
   void StopGraceful();  // LEAVE broadcast then die
   void StopCrash();     // silent death (CTRL+C)
   void ResetState();    // fresh process state for a rejoin
+  void SeedMembers(const std::vector<std::string>& addrs, double now);
 
   int fd() const { return fd_; }
   int idx() const { return idx_; }
@@ -97,7 +158,11 @@ class Node {
   void AddMember(const std::string& addr, double now);   // introducer path
   void RemoveMember(const std::string& addr, double now);
   void Merge(const std::vector<MemberEntry>& remote, double now);
+  void OnSuspect(const std::string& addr, double now);
+  void OnRefute(const std::string& arg, double now);
+  bool Degraded() const;  // Lifeguard local health (runtime.py::degraded)
   std::string EncodeSelf() const;
+  uint32_t NextRand();  // per-node stream for the random-push draw
 
   Cluster* cluster_;
   int idx_;
@@ -107,6 +172,14 @@ class Node {
   bool alive_ = false;
   std::map<std::string, Member> members_;     // sorted: ring order by address
   std::map<std::string, double> fail_list_;   // addr -> cooldown-start ts
+  // suspicion (armed iff cfg.t_suspect > 0): addr -> suspect-start ts,
+  // plus cumulative lifecycle counters (the vitals/round_tick surface)
+  std::map<std::string, double> suspects_;
+  long long sus_entered_ = 0;
+  long long sus_refutations_ = 0;
+  long long sus_confirms_ = 0;
+  double last_refute_t_ = -1e18;  // rate-limits REFUTE broadcasts
+  uint32_t rng_state_;
 
   friend class Cluster;
 };
@@ -141,20 +214,47 @@ class Cluster {
   int AliveNodes(int* out, int cap);
   int DrainEvents(int* out, int cap);  // quadruples per event
 
+  // -- round-16 control/observation surface (all thread-safe)
+  int Configure(const std::string& kv);  // pre-Start knob table
+  int ObsEnable();                       // arm event buffering; returns base round
+  int ObsDrain(char* out, int cap);      // whole-line sized drain
+  std::string VitalsText();              // uniform k=v counter text
+  int ScenarioLoad(const std::string& table, int round0);
+  void ScenarioClear();
+  void SeedFull();  // fully-joined steady state (udp seed_full_membership)
+  int Warm();       // 1 iff every alive view is full with every hb > 1
+
   const Config& cfg() const { return cfg_; }
   void RecordDetection(int observer, const std::string& subject_addr) {
     auto it = addr_to_idx_.find(subject_addr);
     if (it == addr_to_idx_.end()) return;
-    events_.push_back(DetectionEvent{round_, observer, it->second,
-                                     nodes_[it->second]->alive() ? 1 : 0});
+    int fp = nodes_[it->second]->alive() ? 1 : 0;
+    events_.push_back(DetectionEvent{round_, observer, it->second, fp});
+    det_total_ += 1;
+    fp_total_ += fp;
+    ObsEmit("confirm", observer, it->second,
+            fp ? "false_positive=1" : "false_positive=0");
   }
   int IdxOf(const std::string& addr) const {
     auto it = addr_to_idx_.find(addr);
     return it == addr_to_idx_.end() ? -1 : it->second;
   }
+  // obs emission (single writer of the event lines; the Python side
+  // renders them through obs.recorder.FlightRecorder so the stream's
+  // reader stays obs.recorder.load_stream).  Kind strings are literals
+  // at every call site: gossipfs-lint's native-obs-kinds rule requires
+  // each to appear in obs/schema.py EVENT_KINDS (single ownership
+  // across the language boundary).
+  void ObsEmit(const char* kind, int observer, int subject,
+               const std::string& detail);
+  void ObsEmit(const char* kind, int observer,
+               const std::string& subject_addr, const std::string& detail);
+  bool ScenarioDrops(int src, const std::string& dst_addr) const;
+  void CountSend() { sends_total_ += 1; }
 
  private:
   void LoopBody();
+  void EmitRoundTick(double tick_ms);
 
   Config cfg_;
   std::vector<std::unique_ptr<Node>> nodes_;
@@ -167,14 +267,45 @@ class Cluster {
   int epoll_fd_ = -1;
   int round_ = 0;
   double next_tick_ = 0.0;
+  // -- cumulative counters (vitals; events_ drains, so the `metrics`
+  // surface needs its own accounting — the udp engine's convention)
+  long long det_total_ = 0;
+  long long fp_total_ = 0;
+  long long sends_total_ = 0;
+  // -- obs plane: rendered event lines awaiting ObsDrain.  OFF until
+  // gfs_obs_enable so detectors without a recorder never grow the
+  // buffer; enabling rebases the stamped round clock to 0 (the
+  // arming-relative frame the udp campaign streams use).
+  bool obs_enabled_ = false;
+  int obs_round0_ = 0;
+  std::string obs_buf_;
+  long long obs_det0_ = 0, obs_fp0_ = 0, obs_sends0_ = 0;
+  long long obs_sus_entered0_ = 0, obs_refut0_ = 0;
+  // -- armed fault gates (ScenarioLoad); windows are round0-relative
+  GateTable gates_;
+  bool gates_armed_ = false;
+  int scn_round0_ = 0;
 };
 
 // ---------------------------------------------------------------------------
 // Node
 
 Node::Node(Cluster* cluster, int idx, int port)
-    : cluster_(cluster), idx_(idx), port_(port) {
+    : cluster_(cluster), idx_(idx), port_(port),
+      rng_state_(0x5EEDu ^ (static_cast<uint32_t>(idx) * 2654435761u)) {
   addr_ = "127.0.0.1:" + std::to_string(port);
+}
+
+uint32_t Node::NextRand() {
+  // xorshift32 — a per-node stream for the random-push draw (no parity
+  // contract with the Python engines' streams; real-socket runs are
+  // verdict-compared, never bit-compared)
+  uint32_t x = rng_state_;
+  x ^= x << 13;
+  x ^= x >> 17;
+  x ^= x << 5;
+  rng_state_ = x ? x : 0x5EEDu;
+  return rng_state_;
 }
 
 bool Node::Open() {
@@ -204,13 +335,29 @@ void Node::Close() {
 void Node::ResetState() {
   members_.clear();
   fail_list_.clear();
+  // a fresh process forgets its suspicions with the rest of its state;
+  // the cumulative lifecycle counters survive (vitals are per-run)
+  suspects_.clear();
   // a fresh process knows only itself (InitMembership, slave.go:161-167)
   members_[addr_] = Member{0, MonotonicNow()};
   alive_ = true;
 }
 
+void Node::SeedMembers(const std::vector<std::string>& addrs, double now) {
+  // the fully-joined steady state the tensor engine's init_state models
+  // (udp.py seed_full_membership): everyone listed at hb 0 with a fresh
+  // local stamp — inside the hb<=1 detection grace
+  members_.clear();
+  for (const auto& a : addrs) members_[a] = Member{0, now};
+}
+
 void Node::Send(const std::string& peer_addr, const std::string& msg) {
   if (fd_ < 0) return;
+  // fault-gate hook (the UdpNode._send seam): an armed scenario rule —
+  // flapping dark phase, rack outage, partition, lagging sender —
+  // drops the datagram HERE, so heartbeat pushes, control verbs and
+  // SUSPECT/REFUTE broadcasts are all affected alike
+  if (cluster_->ScenarioDrops(idx_, peer_addr)) return;
   size_t colon = peer_addr.rfind(':');
   if (colon == std::string::npos) return;
   // wire-derived addresses are untrusted: validate the port and IP parses
@@ -229,6 +376,7 @@ void Node::Send(const std::string& peer_addr, const std::string& msg) {
     return;
   ::sendto(fd_, msg.data(), msg.size(), 0, reinterpret_cast<sockaddr*>(&sa),
            sizeof(sa));
+  cluster_->CountSend();
 }
 
 std::string Node::EncodeSelf() const {
@@ -247,10 +395,73 @@ void Node::HandleDatagram(const std::string& payload) {
       AddMember(ctrl->arg, now);
     } else if (ctrl->verb == "LEAVE" || ctrl->verb == "REMOVE") {
       RemoveMember(ctrl->arg, now);
+    } else if (ctrl->verb == "SUSPECT") {
+      OnSuspect(ctrl->arg, now);
+    } else if (ctrl->verb == "REFUTE") {
+      OnRefute(ctrl->arg, now);
     }
     return;
   }
   Merge(DecodeMembers(payload), now);
+}
+
+// -- suspicion wire verbs (SWIM suspect/refute; the same protocol the
+// asyncio engine speaks — detector/udp.py _on_suspect/_on_refute) ------------
+
+bool Node::Degraded() const {
+  const Config& cfg = cluster_->cfg();
+  return cfg.lh_multiplier > 0 &&
+         static_cast<double>(suspects_.size()) >
+             cfg.lh_frac * static_cast<double>(members_.size());
+}
+
+void Node::OnSuspect(const std::string& addr, double now) {
+  const Config& cfg = cluster_->cfg();
+  if (cfg.t_suspect <= 0) return;
+  if (addr == addr_) {
+    // the suspect is ME: refute by INCARNATION BUMP — advance my own
+    // counter past whatever the suspicion was based on and broadcast a
+    // REFUTE carrying it.  One bump + one broadcast per period answers
+    // the whole episode (k suspectors each broadcast to everyone, so
+    // k*(N-1) copies land here).
+    auto me = members_.find(addr_);
+    if (me == members_.end()) return;
+    if (now - last_refute_t_ < cfg.period) return;
+    last_refute_t_ = now;
+    me->second.hb += 1;
+    me->second.ts = now;
+    std::string msg = EncodeControl(
+        addr_ + kFieldSep + std::to_string(me->second.hb), "REFUTE");
+    for (const auto& [peer, m] : members_)
+      if (peer != addr_) Send(peer, msg);
+  } else if (members_.find(addr) != members_.end()) {
+    // adopt a peer-disseminated suspicion: start the timer, uncounted
+    // (runtime.py::adopt — local freshness discards it at the next tick)
+    suspects_.emplace(addr, now);
+  }
+}
+
+void Node::OnRefute(const std::string& arg, double now) {
+  // "addr<#INFO#>hb<CMD>REFUTE": the suspect's alive message.  Adopt the
+  // bumped incarnation, stamp fresh, cancel any pending suspicion; a
+  // fail-listed entry is NOT resurrected (cooldown suppression wins).
+  size_t pos = arg.find(kFieldSep);
+  std::string addr = pos == std::string::npos ? arg : arg.substr(0, pos);
+  long long hb = 0;
+  if (pos != std::string::npos) {
+    const std::string hb_text = arg.substr(pos + sizeof(kFieldSep) - 1);
+    char* end = nullptr;
+    hb = std::strtoll(hb_text.c_str(), &end, 10);
+    if (end == hb_text.c_str()) hb = 0;
+  }
+  auto it = members_.find(addr);
+  if (it == members_.end()) return;
+  if (hb > it->second.hb) it->second.hb = hb;
+  it->second.ts = now;
+  if (suspects_.erase(addr)) {
+    sus_refutations_ += 1;
+    cluster_->ObsEmit("refute", idx_, addr, "");
+  }
 }
 
 void Node::AddMember(const std::string& addr, double now) {
@@ -270,8 +481,12 @@ void Node::RemoveMember(const std::string& addr, double now) {
     // (removeMember appends the live struct, slave.go:276-286);
     // fresh_cooldown stamps removal time for a real suppression window
     fail_list_[addr] = cluster_->cfg().fresh_cooldown ? now : it->second.ts;
+    cluster_->ObsEmit("remove", idx_, addr, "");
   }
   members_.erase(it);
+  // removed for any reason (LEAVE, a peer's REMOVE, a confirm): forget
+  // the pending suspicion uncounted (runtime.py::drop)
+  suspects_.erase(addr);
 }
 
 void Node::Merge(const std::vector<MemberEntry>& remote, double now) {
@@ -282,6 +497,12 @@ void Node::Merge(const std::vector<MemberEntry>& remote, double now) {
       if (entry.hb > it->second.hb) {
         it->second.hb = entry.hb;
         it->second.ts = now;
+        if (suspects_.erase(entry.addr)) {
+          // refute-by-advance: a fresher counter observed while SUSPECT
+          // cancels the pending failure (runtime.py::refute)
+          sus_refutations_ += 1;
+          cluster_->ObsEmit("refute", idx_, entry.addr, "");
+        }
       }
     } else if (fail_list_.find(entry.addr) == fail_list_.end()) {
       members_[entry.addr] = Member{entry.hb, now};
@@ -301,19 +522,103 @@ void Node::Tick(double now) {
     self->second.hb += 1;
     self->second.ts = now;
   }
-  // failure detection (slave.go:460-476)
+  // failure detection (slave.go:460-482).  With suspicion armed
+  // (cfg.t_suspect > 0) a stale member passes through SUSPECT first:
+  // the first stale tick broadcasts SUSPECT (so the subject can
+  // actively refute by incarnation bump — OnSuspect), and only the
+  // SUSPECT->FAILED window — t_suspect periods, stretched by the
+  // Lifeguard local-health multiplier while this observer is degraded —
+  // confirms the removal.  Mirrors detector/udp.py UdpNode.tick /
+  // suspicion/runtime.py exactly.
   double t_fail = cfg.t_fail * cfg.period;
+  bool sus = cfg.t_suspect > 0;
+  std::vector<std::string> newly_suspect;
   std::vector<std::string> failed;
   for (const auto& [addr, m] : members_) {
     if (addr == addr_) continue;
-    if (m.hb > 1 && m.ts < now - t_fail) failed.push_back(addr);
+    bool stale = m.hb > 1 && m.ts < now - t_fail;
+    if (!stale) {
+      // a genuinely-refuted suspicion was already popped (and counted)
+      // by Merge/OnRefute when the fresh evidence arrived; anything
+      // left here is a peer-disseminated adoption for an entry that
+      // was never stale locally — clear it WITHOUT counting
+      if (sus) suspects_.erase(addr);
+      continue;
+    }
+    if (sus) {
+      auto it = suspects_.find(addr);
+      if (it == suspects_.end()) {
+        suspects_[addr] = now;
+        sus_entered_ += 1;
+        newly_suspect.push_back(addr);
+        continue;
+      }
+      // the stretched window is recomputed PER MEMBER, like the udp
+      // engine's rt.t_suspect_window call: suspicions entered earlier
+      // in this same tick count toward this member's degraded bit, so
+      // a mass-suspicion tick stretches the window for the members
+      // examined after the lh_frac crossing
+      int mult = 1 + (Degraded() ? cfg.lh_multiplier : 0);
+      double window = cfg.t_suspect * mult * cfg.period;
+      if (!(now - it->second > window)) {
+        // periodic re-notification (SWIM re-gossips suspicion): the
+        // original SUSPECT may have been sent into a fault window — a
+        // rack outage drops it, so the subject never learns and the
+        // post-heal refute wave would ride passive list gossip alone,
+        // leaking a heal-race FP tail (~100 FPs at n=256, measured).
+        // One subject-only datagram per suspect per tick triggers the
+        // active incarnation-bump refute the moment the subject is
+        // reachable again; the REFUTE broadcast is rate-limited on the
+        // subject's side, so k re-notifiers cost one bump per period.
+        Send(addr, EncodeControl(addr, "SUSPECT"));
+        continue;
+      }
+      suspects_.erase(it);
+      sus_confirms_ += 1;
+    }
+    failed.push_back(addr);
+  }
+  for (const auto& addr : newly_suspect) {
+    cluster_->ObsEmit("suspect", idx_, addr, "");
+    std::string msg = EncodeControl(addr, "SUSPECT");
+    if (cfg.push_random) {
+      // campaign profile: bounded dissemination — the SUBJECT always
+      // hears (its active incarnation-bump refute is the point) plus
+      // fanout random peers, O(fanout) per new suspicion like every
+      // other push in this mode.  The reference-faithful all-peers
+      // broadcast below is O(suspects x N) per round: at n=256 a rack
+      // outage makes ~250 observers suspect 8 nodes in ONE tick —
+      // ~500k synchronous sendtos that stall the epoll thread for
+      // seconds, go-stale everything, and storm the cluster by
+      // ENGINE physics, not protocol (measured: 26 s tick, 73k FPs).
+      Send(addr, msg);
+      std::vector<const std::string*> peers;
+      peers.reserve(members_.size());
+      for (const auto& [peer, m] : members_)
+        if (peer != addr_ && peer != addr) peers.push_back(&peer);
+      int k = std::min<int>(cfg.fanout, static_cast<int>(peers.size()));
+      for (int i = 0; i < k; ++i) {
+        int j = i + static_cast<int>(NextRand() % (peers.size() - i));
+        std::swap(peers[i], peers[j]);
+        Send(*peers[i], msg);
+      }
+    } else {
+      // ring mode: the asyncio engine's wire behavior verbatim (the
+      // small-n udp-parity lane compares event sequences)
+      for (const auto& [peer, m] : members_)
+        if (peer != addr_) Send(peer, msg);
+    }
   }
   for (const auto& addr : failed) {
-    RemoveMember(addr, now);
+    // detection first, then the removal it causes — the same
+    // confirm -> remove causal order every engine's events carry
     cluster_->RecordDetection(idx_, addr);
-    std::string msg = EncodeControl(addr, "REMOVE");
-    for (const auto& [peer, m] : members_)
-      if (peer != addr_) Send(peer, msg);
+    RemoveMember(addr, now);
+    if (cfg.remove_broadcast) {
+      std::string msg = EncodeControl(addr, "REMOVE");
+      for (const auto& [peer, m] : members_)
+        if (peer != addr_) Send(peer, msg);
+    }
   }
   // fail-list cooldown expiry (slave.go:484-497)
   double t_cool = cfg.t_cooldown * cfg.period;
@@ -323,9 +628,27 @@ void Node::Tick(double now) {
     else
       ++it;
   }
+  if (members_.find(addr_) == members_.end()) return;  // removed-self
+  std::string msg = EncodeSelf();
+  if (cfg.push_random) {
+    // campaign/north-star push topology: fanout random listed peers per
+    // tick (the tensor engine's topology='random' — event propagation
+    // in O(log N) rounds instead of the ring's O(N) position walk)
+    std::vector<const std::string*> peers;
+    peers.reserve(members_.size());
+    for (const auto& [addr, m] : members_)
+      if (addr != addr_) peers.push_back(&addr);
+    int k = std::min<int>(cfg.fanout, static_cast<int>(peers.size()));
+    // partial Fisher-Yates: first k entries are a uniform sample
+    for (int i = 0; i < k; ++i) {
+      int j = i + static_cast<int>(NextRand() % (peers.size() - i));
+      std::swap(peers[i], peers[j]);
+      Send(*peers[i], msg);
+    }
+    return;
+  }
   // ring push to sorted list positions self-1, self+1, self+2
   // (slave.go:515-542); std::map iteration order == sorted addresses
-  if (members_.find(addr_) == members_.end()) return;  // removed-self
   std::vector<const std::string*> ordered;
   ordered.reserve(members_.size());
   for (const auto& [addr, m] : members_) ordered.push_back(&addr);
@@ -333,7 +656,6 @@ void Node::Tick(double now) {
   int self_i = 0;
   for (int i = 0; i < n; ++i)
     if (*ordered[i] == addr_) self_i = i;
-  std::string msg = EncodeSelf();
   for (int off : {-1, 1, 2}) {
     const std::string& peer = *ordered[((self_i + off) % n + n) % n];
     if (peer != addr_) Send(peer, msg);
@@ -406,11 +728,57 @@ void Cluster::LoopBody() {
   }
   now = MonotonicNow();
   if (now >= next_tick_) {
+    double t0 = MonotonicNow();
     for (auto& node : nodes_) node->Tick(now);
+    double tick_ms = (MonotonicNow() - t0) * 1000.0;
+    if (obs_enabled_) EmitRoundTick(tick_ms);
     round_ += 1;
     next_tick_ += cfg_.period;
     if (next_tick_ < now) next_tick_ = now + cfg_.period;  // fell behind
   }
+}
+
+void Cluster::EmitRoundTick(double tick_ms) {
+  // one round_tick per completed protocol round — the ground truth this
+  // in-process engine KNOWS (nodes_[i]->alive()): n_alive plus the
+  // round's detection/false-positive deltas, so a recorded native
+  // stream feeds the streaming monitor's rolling-FPR invariant exactly
+  // like a tensor or udp trace.  Native extras ride the same detail:
+  // members_listed (sum of live view sizes), sends (datagrams that
+  // left a socket this round) and tick_ms (wall-clock cost of the tick
+  // pass — the per-round latency histogram's sample).  The suspicion
+  // counters appear only when armed (the n/a-not-0 inference rule);
+  // fp_suppressed stays absent (per-refute ground truth is sim-only).
+  int n_alive = 0;
+  long long members_listed = 0;
+  long long sus_entered = 0, sus_refut = 0, sus_now = 0;
+  for (const auto& node : nodes_) {
+    if (node->alive()) {
+      n_alive += 1;
+      members_listed += static_cast<long long>(node->members_.size());
+      sus_now += static_cast<long long>(node->suspects_.size());
+    }
+    sus_entered += node->sus_entered_;
+    sus_refut += node->sus_refutations_;
+  }
+  long long det_d = det_total_ - obs_det0_;
+  long long fp_d = fp_total_ - obs_fp0_;
+  std::ostringstream d;
+  d << "n_alive=" << n_alive << " true_detections=" << (det_d - fp_d)
+    << " false_positives=" << fp_d << " members_listed=" << members_listed
+    << " sends=" << (sends_total_ - obs_sends0_) << " tick_ms="
+    << std::fixed << std::setprecision(3) << tick_ms;
+  if (cfg_.t_suspect > 0) {
+    d << " suspects_entered=" << (sus_entered - obs_sus_entered0_)
+      << " refutations=" << (sus_refut - obs_refut0_)
+      << " suspects_now=" << sus_now;
+  }
+  obs_det0_ = det_total_;
+  obs_fp0_ = fp_total_;
+  obs_sends0_ = sends_total_;
+  obs_sus_entered0_ = sus_entered;
+  obs_refut0_ = sus_refut;
+  ObsEmit("round_tick", -1, -1, d.str());
 }
 
 void Cluster::Stop() {
@@ -425,11 +793,16 @@ void Cluster::Stop() {
 void Cluster::Crash(int i) {
   std::lock_guard<std::mutex> lk(mu_);
   nodes_[i]->StopCrash();
+  // ground truth stamped at the injection seam: a dead process bumps
+  // nothing, so the hb_freeze rides along (the tensor decode's pairing)
+  ObsEmit("crash", -1, i, "scheduled=1");
+  ObsEmit("hb_freeze", -1, i, "");
 }
 
 void Cluster::Leave(int i) {
   std::lock_guard<std::mutex> lk(mu_);
   nodes_[i]->StopGraceful();
+  ObsEmit("leave", -1, i, "");
 }
 
 void Cluster::Join(int i) {
@@ -440,6 +813,7 @@ void Cluster::Join(int i) {
   // slave.go:22)
   node->Send(nodes_[cfg_.introducer]->addr(),
              EncodeControl(node->addr(), "JOIN"));
+  ObsEmit("join", -1, i, "");
 }
 
 void Cluster::Advance(int rounds) {
@@ -490,6 +864,264 @@ int Cluster::DrainEvents(int* out, int cap) {
   }
   events_.erase(events_.begin(), events_.begin() + n);
   return n;
+}
+
+// ---------------------------------------------------------------------------
+// round-16 control/observation surface
+
+int Cluster::Configure(const std::string& kv) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (running_) return -1;  // protocol knobs are fixed once the loop runs
+  std::istringstream in(kv);
+  std::string tok;
+  while (in >> tok) {
+    size_t eq = tok.find('=');
+    if (eq == std::string::npos) return -1;
+    const std::string key = tok.substr(0, eq);
+    const std::string val = tok.substr(eq + 1);
+    char* end = nullptr;
+    if (key == "push") {
+      if (val != "ring" && val != "random") return -1;
+      cfg_.push_random = (val == "random");
+    } else if (key == "fanout") {
+      long v = std::strtol(val.c_str(), &end, 10);
+      if (end == val.c_str() || *end != '\0' || v < 1) return -1;
+      cfg_.fanout = static_cast<int>(v);
+    } else if (key == "remove_broadcast") {
+      cfg_.remove_broadcast = val != "0";
+    } else if (key == "t_suspect") {
+      long v = std::strtol(val.c_str(), &end, 10);
+      if (end == val.c_str() || *end != '\0' || v < 0) return -1;
+      cfg_.t_suspect = static_cast<int>(v);
+    } else if (key == "lh_multiplier") {
+      long v = std::strtol(val.c_str(), &end, 10);
+      if (end == val.c_str() || *end != '\0' || v < 0) return -1;
+      cfg_.lh_multiplier = static_cast<int>(v);
+    } else if (key == "lh_frac") {
+      double v = std::strtod(val.c_str(), &end);
+      if (end == val.c_str() || *end != '\0' || !(v > 0.0 && v < 1.0))
+        return -1;
+      cfg_.lh_frac = v;
+    } else {
+      return -1;
+    }
+  }
+  return 0;
+}
+
+void Cluster::ObsEmit(const char* kind, int observer, int subject,
+                      const std::string& detail) {
+  if (!obs_enabled_) return;
+  std::ostringstream line;
+  line << kind << ' ' << (round_ - obs_round0_) << ' ' << observer << ' '
+       << subject;
+  if (!detail.empty()) line << ' ' << detail;
+  line << '\n';
+  obs_buf_ += line.str();
+}
+
+void Cluster::ObsEmit(const char* kind, int observer,
+                      const std::string& subject_addr,
+                      const std::string& detail) {
+  if (!obs_enabled_) return;
+  ObsEmit(kind, observer, IdxOf(subject_addr), detail);
+}
+
+int Cluster::ObsEnable() {
+  std::lock_guard<std::mutex> lk(mu_);
+  obs_enabled_ = true;
+  // rebase the stamped round clock to 0 and zero the per-round deltas:
+  // the recorded stream lives in the arming-relative frame the udp
+  // campaign runner's streams use (its cluster clock starts at 0)
+  obs_round0_ = round_;
+  obs_det0_ = det_total_;
+  obs_fp0_ = fp_total_;
+  obs_sends0_ = sends_total_;
+  long long e = 0, r = 0;
+  for (const auto& node : nodes_) {
+    e += node->sus_entered_;
+    r += node->sus_refutations_;
+  }
+  obs_sus_entered0_ = e;
+  obs_refut0_ = r;
+  return round_;
+}
+
+int Cluster::ObsDrain(char* out, int cap) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (obs_buf_.empty() || cap <= 1) return 0;
+  size_t take = obs_buf_.size();
+  if (take > static_cast<size_t>(cap - 1)) {
+    // drain whole lines only: find the last newline that fits
+    size_t nl = obs_buf_.rfind('\n', static_cast<size_t>(cap - 2));
+    if (nl == std::string::npos) return -1;  // one line > cap: grow buffer
+    take = nl + 1;
+  }
+  std::memcpy(out, obs_buf_.data(), take);
+  out[take] = '\0';
+  obs_buf_.erase(0, take);
+  return static_cast<int>(take);
+}
+
+std::string Cluster::VitalsText() {
+  std::lock_guard<std::mutex> lk(mu_);
+  int n_alive = 0;
+  long long sus_now = 0, entered = 0, refut = 0, confirms = 0;
+  for (const auto& node : nodes_) {
+    if (node->alive()) {
+      n_alive += 1;
+      sus_now += static_cast<long long>(node->suspects_.size());
+    }
+    entered += node->sus_entered_;
+    refut += node->sus_refutations_;
+    confirms += node->sus_confirms_;
+  }
+  std::ostringstream os;
+  AppendVital(os, "round", round_);
+  AppendVital(os, "n_alive", n_alive);
+  AppendVital(os, "detections", det_total_);
+  AppendVital(os, "false_positives", fp_total_);
+  if (cfg_.t_suspect > 0) {
+    AppendVital(os, "suspects_now", sus_now);
+    AppendVital(os, "suspects_entered", entered);
+    AppendVital(os, "refutations", refut);
+    AppendVital(os, "confirms", confirms);
+  }
+  return os.str();
+}
+
+int Cluster::ScenarioLoad(const std::string& table, int round0) {
+  GateTable g;
+  std::istringstream in(table);
+  std::string line;
+  while (std::getline(in, line)) {
+    if (line.empty()) continue;
+    std::istringstream ls(line);
+    std::string kind;
+    ls >> kind;
+    if (kind == "name") {
+      ls >> g.name;
+      continue;
+    }
+    int start = 0, end = 0;
+    if (!(ls >> start >> end) || start < 0 || end <= start) return -1;
+    g.horizon = std::max(g.horizon, end);
+    auto read_mask = [&](std::vector<char>& mask) -> bool {
+      mask.assign(cfg_.n, 0);
+      int id = 0;
+      bool any = false;
+      while (ls >> id) {
+        if (id < 0 || id >= cfg_.n) return false;
+        mask[id] = 1;
+        any = true;
+      }
+      return any;
+    };
+    if (kind == "flap") {
+      GateFlap f;
+      f.start = start;
+      f.end = end;
+      if (!(ls >> f.up >> f.down) || f.up < 1 || f.down < 1) return -1;
+      if (!read_mask(f.mask)) return -1;
+      g.flaps.push_back(std::move(f));
+    } else if (kind == "outage") {
+      GateOutage o;
+      o.start = start;
+      o.end = end;
+      if (!read_mask(o.mask)) return -1;
+      g.outages.push_back(std::move(o));
+    } else if (kind == "slow") {
+      GateSlow s;
+      s.start = start;
+      s.end = end;
+      if (!(ls >> s.stride) || s.stride < 2) return -1;
+      if (!read_mask(s.mask)) return -1;
+      g.slows.push_back(std::move(s));
+    } else if (kind == "partition") {
+      GatePartition p;
+      p.start = start;
+      p.end = end;
+      p.pid.reserve(cfg_.n);
+      int pid = 0;
+      while (ls >> pid) p.pid.push_back(pid);
+      if (static_cast<int>(p.pid.size()) != cfg_.n) return -1;
+      g.partitions.push_back(std::move(p));
+    } else {
+      return -1;
+    }
+  }
+  std::lock_guard<std::mutex> lk(mu_);
+  gates_ = std::move(g);
+  gates_armed_ = true;
+  scn_round0_ = round0;
+  ObsEmit("scenario_arm", -1, -1,
+          "name=" + (gates_.name.empty() ? std::string("scenario")
+                                         : gates_.name) +
+              " horizon=" + std::to_string(gates_.horizon));
+  return 0;
+}
+
+void Cluster::ScenarioClear() {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (gates_armed_) ObsEmit("scenario_clear", -1, -1, "");
+  gates_armed_ = false;
+}
+
+bool Cluster::ScenarioDrops(int src, const std::string& dst_addr) const {
+  // ScenarioRuntime.drops, minus Bernoulli loss (rejected at compile
+  // time by native.py): called from Node::Send with mu_ held
+  if (!gates_armed_) return false;
+  int r = round_ - scn_round0_;
+  for (const auto& f : gates_.flaps) {
+    if (f.mask[src] && f.start <= r && r < f.end &&
+        (r - f.start) % (f.up + f.down) >= f.up)
+      return true;
+  }
+  auto dst_it = addr_to_idx_.find(dst_addr);
+  int dst = dst_it == addr_to_idx_.end() ? -1 : dst_it->second;
+  for (const auto& o : gates_.outages) {
+    if (o.start <= r && r < o.end &&
+        (o.mask[src] || (dst >= 0 && o.mask[dst])))
+      return true;
+  }
+  for (const auto& p : gates_.partitions) {
+    if (p.start <= r && r < p.end && dst >= 0 && p.pid[src] != p.pid[dst])
+      return true;
+  }
+  for (const auto& s : gates_.slows) {
+    if (s.mask[src] && s.start <= r && r < s.end && r % s.stride != 0)
+      return true;
+  }
+  return false;
+}
+
+void Cluster::SeedFull() {
+  std::lock_guard<std::mutex> lk(mu_);
+  double now = MonotonicNow();
+  std::vector<std::string> addrs;
+  addrs.reserve(nodes_.size());
+  for (const auto& node : nodes_) addrs.push_back(node->addr());
+  for (auto& node : nodes_)
+    if (node->alive()) node->SeedMembers(addrs, now);
+}
+
+int Cluster::Warm() {
+  std::lock_guard<std::mutex> lk(mu_);
+  for (const auto& node : nodes_) {
+    if (!node->alive()) continue;
+    // full view with every counter past the hb<=1 grace — and NO churn
+    // residue: a pending suspicion means some entry is already past
+    // t_fail silent (it would confirm right after the caller starts
+    // its run — observed as a warm-gate FP burst in the stream's first
+    // rounds), and a non-empty fail list means a detection fired within
+    // the cooldown window (the view only LOOKS full because the entry
+    // was just re-added at a stale-prone counter)
+    if (static_cast<int>(node->members_.size()) != cfg_.n) return 0;
+    if (!node->suspects_.empty() || !node->fail_list_.empty()) return 0;
+    for (const auto& [addr, m] : node->members_)
+      if (m.hb <= 1) return 0;
+  }
+  return 1;
 }
 
 }  // namespace
@@ -545,6 +1177,53 @@ int gfs_drain_events(void* h, int* out, int cap) {
   return static_cast<gossipfs::Cluster*>(h)->DrainEvents(out, cap);
 }
 
+// -- round-16 observability + campaign surface ------------------------------
+
+// Pre-start protocol knobs ("k=v k=v ..."): push=ring|random, fanout,
+// remove_broadcast, t_suspect, lh_multiplier, lh_frac.  0 ok, -1 on a
+// bad table or a started cluster.
+int gfs_configure(void* h, const char* kv) {
+  return static_cast<gossipfs::Cluster*>(h)->Configure(kv ? kv : "");
+}
+
+// Arm event buffering and rebase the stamped round clock; returns the
+// absolute engine round the stream's round 0 maps to.
+int gfs_obs_enable(void* h) {
+  return static_cast<gossipfs::Cluster*>(h)->ObsEnable();
+}
+
+// Drain buffered event lines ("kind round observer subject k=v ...").
+// Returns bytes written (whole lines only, NUL-terminated), 0 when the
+// buffer is empty, -1 when a single line exceeds cap (grow and retry).
+int gfs_obs_drain(void* h, char* out, int cap) {
+  return static_cast<gossipfs::Cluster*>(h)->ObsDrain(out, cap);
+}
+
+// Load the fault-gate table (text form; see Cluster::ScenarioLoad),
+// windows anchored at absolute round `round0`.  0 ok, -1 on parse error.
+int gfs_scenario_load(void* h, const char* table, int round0) {
+  return static_cast<gossipfs::Cluster*>(h)->ScenarioLoad(table ? table : "",
+                                                          round0);
+}
+
+void gfs_scenario_clear(void* h) {
+  static_cast<gossipfs::Cluster*>(h)->ScenarioClear();
+}
+
+void gfs_seed_full(void* h) {
+  static_cast<gossipfs::Cluster*>(h)->SeedFull();
+}
+
+// Halt the epoll loop + close sockets WITHOUT destroying state: the
+// buffered obs events stay drainable.  On a 1-core host a big
+// gfs_obs_drain parse while the loop still runs starves the protocol
+// (rounds lag -> wall-clock staleness -> a manufactured FP cascade in
+// the stream's tail — observed at n=256); runners stop first, then
+// drain at leisure.
+void gfs_stop(void* h) { static_cast<gossipfs::Cluster*>(h)->Stop(); }
+
+int gfs_warm(void* h) { return static_cast<gossipfs::Cluster*>(h)->Warm(); }
+
 // Codec surface for parity tests: input lines "addr hb ts\n", output the
 // wire string (and the reverse).  snprintf semantics: writes at most cap-1
 // bytes + NUL and returns the FULL required length, so callers can detect
@@ -554,6 +1233,13 @@ static int CopyOut(const std::string& text, char* out, int cap) {
   if (n > 0) std::memcpy(out, text.data(), static_cast<size_t>(n));
   if (cap > 0) out[n] = '\0';
   return static_cast<int>(text.size());
+}
+
+// Uniform vitals ("k=v k=v ..." — obs.schema.VITALS_FIELDS names only;
+// unknowable fields are ABSENT, rendered n/a by the Python surface).
+// snprintf sizing semantics, like the codec calls below.
+int gfs_vitals(void* h, char* out, int cap) {
+  return CopyOut(static_cast<gossipfs::Cluster*>(h)->VitalsText(), out, cap);
 }
 
 int gfs_codec_encode(const char* lines, char* out, int cap) {

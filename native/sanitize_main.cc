@@ -3,9 +3,13 @@
 // Drives the C ABI (the exact surface ctypes uses — see
 // gossipfs_tpu/native.py) through the committed campaign case while a
 // second thread hammers the control/observation verbs concurrently with
-// the engine's epoll loop thread: converge, crash two nodes mid-poll,
-// detect, cooldown, rejoin, graceful leave, then a codec sweep over
-// malformed wire input.  Built by `make tsan` / `make asan`
+// the engine's epoll loop thread: configure the suspicion + campaign
+// knobs, seed + warm, arm a fault-gate table, converge, crash two nodes
+// mid-poll, detect, cooldown, rejoin, graceful leave, then a codec +
+// gate-table sweep over malformed input.  The round-16 observation
+// surface (gfs_obs_drain / gfs_vitals) is hammered CONCURRENTLY with
+// the epoll loop — the new buffers get the same TSan/ASan certification
+// as the rest of the ABI.  Built by `make tsan` / `make asan`
 // (tests/test_native_sanitizers.py runs both and fails on any report);
 // protocol outcomes are asserted here so a sanitizer build that
 // silently breaks semantics also fails, not just one that races.
@@ -18,6 +22,7 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <string>
 #include <thread>
 #include <vector>
 
@@ -37,12 +42,23 @@ int gfs_alive(void* h, int* out, int cap);
 int gfs_drain_events(void* h, int* out, int cap);
 int gfs_codec_encode(const char* lines, char* out, int cap);
 int gfs_codec_decode(const char* wire, char* out, int cap);
+// round-16 observability + campaign surface
+int gfs_configure(void* h, const char* kv);
+int gfs_obs_enable(void* h);
+int gfs_obs_drain(void* h, char* out, int cap);
+int gfs_vitals(void* h, char* out, int cap);
+int gfs_scenario_load(void* h, const char* table, int round0);
+void gfs_scenario_clear(void* h);
+void gfs_seed_full(void* h);
+int gfs_warm(void* h);
+void gfs_stop(void* h);
 }
 
 namespace {
 
 constexpr int kN = 12;
 constexpr int kTFail = 5;
+constexpr int kTSuspect = 2;  // armed via gfs_configure below
 constexpr int kTCooldown = 5;
 
 bool Contains(const int* buf, int count, int idx) {
@@ -63,41 +79,88 @@ int main(int argc, char** argv) {
   void* h = gfs_cluster_create(kN, base_port, period, kTFail, kTCooldown,
                                /*min_group=*/4, /*fresh_cooldown=*/1,
                                /*introducer=*/0);
+  // round-16 knob table: the campaign protocol profile + an armed SWIM
+  // lifecycle, so the suspicion paths run under the sanitizers too
+  if (gfs_configure(h, "push=random fanout=4 remove_broadcast=0 "
+                       "t_suspect=2 lh_multiplier=2 lh_frac=0.25") != 0) {
+    gfs_cluster_destroy(h);
+    return Fail("gfs_configure rejected a valid knob table");
+  }
+  if (gfs_configure(h, "nonsense=1") == 0 ||
+      gfs_configure(h, "lh_frac=2.0") == 0) {
+    gfs_cluster_destroy(h);
+    return Fail("gfs_configure accepted a malformed knob table");
+  }
   if (gfs_cluster_start(h) != 0) {
     gfs_cluster_destroy(h);
     return Fail("cluster failed to start (ports busy?)");
   }
+  if (gfs_configure(h, "fanout=3") == 0) {
+    gfs_cluster_destroy(h);
+    return Fail("gfs_configure accepted knobs after start");
+  }
 
-  // warm convergence: everyone joined through the introducer and every
-  // counter is past the hb<=1 detection grace
-  gfs_advance(h, 6);
+  // seeded steady-state start (the campaign runners' boot), then warm
+  gfs_seed_full(h);
+  for (int i = 0; i < 100 && !gfs_warm(h); ++i)
+    std::this_thread::sleep_for(
+        std::chrono::duration<double>(period / 2));
   int buf[4 * kN];
   if (gfs_alive(h, buf, kN) != kN) {
     gfs_cluster_destroy(h);
     return Fail("cohort did not converge to n alive");
   }
 
+  // arm the obs plane + a fault-gate table (flap node 7 dark 2-of-3
+  // rounds for a stretch); malformed tables must be rejected whole
+  int r0 = gfs_obs_enable(h);
+  if (gfs_scenario_load(h, "flap 1 9 1 2 7\noutage 2 4 3\n", r0) != 0) {
+    gfs_cluster_destroy(h);
+    return Fail("gfs_scenario_load rejected a valid gate table");
+  }
+  if (gfs_scenario_load(h, "flap 1 9 0 0 7\n", r0) == 0 ||
+      gfs_scenario_load(h, "partition 1 4 0 1\n", r0) == 0 ||
+      gfs_scenario_load(h, "wat 1 2 3\n", r0) == 0) {
+    gfs_cluster_destroy(h);
+    return Fail("gfs_scenario_load accepted a malformed gate table");
+  }
+
   // concurrent observation hammering: the race surface TSan exists for
   // is the control/observation verbs (Python-thread side) against the
-  // epoll loop thread holding the protocol state
+  // epoll loop thread holding the protocol state — the round-16 obs
+  // drain + vitals buffers included
   std::atomic<bool> stop{false};
+  std::atomic<long> obs_bytes{0};
   std::thread poller([&] {
     int pbuf[4 * kN];
+    char obs[8192];
+    char vit[512];
     while (!stop.load()) {
       gfs_alive(h, pbuf, kN);
       gfs_membership(h, 0, pbuf, kN);
       gfs_round(h);
       gfs_drain_events(h, pbuf, 4 * kN);
+      int got = gfs_obs_drain(h, obs, sizeof obs);
+      if (got > 0) obs_bytes += got;
+      gfs_vitals(h, vit, sizeof vit);
+      // tiny-cap calls exercise the line-boundary / snprintf sizing
+      gfs_obs_drain(h, obs, 8);
+      gfs_vitals(h, vit, 4);
       std::this_thread::sleep_for(std::chrono::milliseconds(2));
     }
   });
 
-  // the campaign: crash two nodes mid-poll, detect, rejoin one
+  // the campaign: crash two nodes mid-poll, detect (t_fail + t_suspect
+  // with the lifecycle armed), rejoin one
   gfs_crash(h, 5);
   gfs_crash(h, 9);
-  gfs_advance(h, kTFail + 7);  // t_fail periods + dissemination slack
+  gfs_advance(h, kTFail + kTSuspect + 7);
   stop.store(true);
   poller.join();
+  if (obs_bytes.load() <= 0) {
+    gfs_cluster_destroy(h);
+    return Fail("obs drain never produced event lines");
+  }
 
   int rc = 0;
   int alive = gfs_alive(h, buf, kN);
@@ -120,6 +183,21 @@ int main(int argc, char** argv) {
   gfs_advance(h, 4);
   members = gfs_membership(h, 0, buf, kN);
   if (Contains(buf, members, 3)) rc = Fail("LEAVE did not disseminate");
+
+  // stop-then-drain: the loop halts, the buffered events stay readable
+  // (the campaign runners' shutdown order), and the stream carries the
+  // lifecycle the campaign just ran
+  gfs_scenario_clear(h);
+  gfs_stop(h);
+  {
+    std::string all;
+    char obs[8192];
+    int got;
+    while ((got = gfs_obs_drain(h, obs, sizeof obs)) > 0)
+      all.append(obs, static_cast<size_t>(got));
+    if (all.find("round_tick") == std::string::npos)
+      rc = Fail("post-stop drain carried no round_tick rows");
+  }
 
   gfs_cluster_destroy(h);
 

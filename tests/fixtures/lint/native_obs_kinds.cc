@@ -1,0 +1,15 @@
+// Trigger fixture for the native-obs-kinds rule: a stand-in engine.cc
+// that mints an event kind the schema does not own and serves a vitals
+// field outside VITALS_FIELDS.  Mounted over native/engine.cc via the
+// RepoIndex overlay by tests/test_analysis.py — never compiled.
+
+void Fixture() {
+  // a schema-owned kind: fine
+  ObsEmit("round_tick", -1, -1, "n_alive=4");
+  // a kind EVENT_KINDS does not know: load_stream would drop the rows
+  ObsEmit("bogus_native_kind", -1, 3, "");
+  // a schema-owned vitals field: fine
+  AppendVital(os, "round", 7);
+  // a field outside VITALS_FIELDS: the uniform surface would drift
+  AppendVital(os, "not_a_vitals_field", 1);
+}

@@ -8,7 +8,10 @@ periods for the 1-core box.
 
 from __future__ import annotations
 
+import pathlib
 import shutil
+import sys
+import time
 
 import pytest
 
@@ -177,6 +180,236 @@ class TestNativeEngine:
             assert 2 not in det.membership(0)
             # a voluntary leave is not a failure detection
             assert all(e.subject != 2 for e in det.drain_events())
+
+
+class TestNativeObs:
+    """Round 16: the epoll engine as an obs-plane producer — events
+    drained over ``gfs_obs_drain`` and rendered through the ONE schema,
+    vitals under the n/a-not-0 rule, fault gates at the send seam, and
+    the SWIM lifecycle running inside the engine."""
+
+    def _run_crash(self, base_port, n=10, victims=(4, 7), rounds=12,
+                   path=None, recorder=None):
+        """One seeded crash run; returns (recorder, drain_events, r0)."""
+        from gossipfs_tpu.obs.recorder import FlightRecorder
+
+        det = native.NativeUdpDetector(
+            n=n, base_port=base_port, period=0.05, fresh_cooldown=True)
+        try:
+            det.seed_full_membership()
+            deadline = time.monotonic() + 30
+            while not det.warm():
+                assert time.monotonic() < deadline, "warmup stalled"
+                time.sleep(0.05)
+            rec = recorder if recorder is not None else FlightRecorder(
+                path, source="native", n=n,
+                crash_rounds={str(v): 0 for v in victims})
+            r0 = det.attach_recorder(rec)
+            for v in victims:
+                det.crash(v)
+            det.advance(rounds)
+            det.stop()
+            det.pump_obs()
+            events = det.drain_events()
+            rec.close()
+            return rec, events, r0
+        finally:
+            det.close()
+
+    def test_monitor_matches_drain_events(self):
+        """THE standing oracle, extended to the third engine: the
+        StreamMonitor's estimators derived from the recorded native
+        stream must equal the ``drain_events``-derived ground truth
+        EXACTLY — detections, false positives, per-victim first-detect
+        TTD — on a seeded crash run."""
+        from gossipfs_tpu.obs.monitor import StreamMonitor
+
+        victims = (4, 7)
+        rec, devents, r0 = self._run_crash(22100, victims=victims)
+        mon = StreamMonitor(n=10)
+        mon.observe_header(rec.header)
+        mon.feed(rec.events)
+        mon.finish()
+        s = mon.summary()
+
+        # ground truth from the int-buffer drain (absolute rounds ->
+        # the stream's rebased frame via r0)
+        fp_truth = sum(1 for e in devents if e.false_positive)
+        assert s["false_positives"] == fp_truth
+        first = {}
+        for e in devents:
+            if e.subject in victims:
+                first.setdefault(e.subject, e.round - r0)
+                first[e.subject] = min(first[e.subject], e.round - r0)
+        assert s["detected"] == len(first) == len(victims)
+        for v in victims:
+            # header crash_rounds stamp the crash at stream round 0
+            assert s["ttd_first"][v] == first[v]
+        # the round_tick deltas and the drain buffer count the SAME
+        # RecordDetection increments
+        assert s["true_detections"] + s["false_positives"] == len(devents)
+
+    def test_native_tensor_lifecycle_parity(self):
+        """Three-engine trace parity, native vs tensor: the same seeded
+        crash produces the same per-subject lifecycle kind-sequence
+        [crash, hb_freeze, confirm, remove] through tools/timeline.py's
+        canonical ordering."""
+        import jax
+        import jax.numpy as jnp
+
+        from gossipfs_tpu.config import SimConfig
+        from gossipfs_tpu.core.rounds import run_rounds
+        from gossipfs_tpu.core.state import RoundEvents, init_state
+        from gossipfs_tpu.obs.recorder import decode_scan
+
+        sys.path.insert(0, str(
+            pathlib.Path(__file__).resolve().parents[1] / "tools"))
+        import timeline as tl
+
+        rec, _, _ = self._run_crash(22200, victims=(4,), rounds=14)
+        native_seq = tl.kind_sequence(rec.events, 4)
+
+        # crash past the hb<=1 grace (a round-0 victim is permanently
+        # grace-protected in the tensor engine; the native run seeds +
+        # warms past the grace before crashing, so both are warm kills)
+        n, rounds, crash_at = 10, 16, 4
+        cfg = SimConfig(n=n, t_fail=5, fresh_cooldown=True)
+        crash = jnp.zeros((rounds, n), dtype=bool).at[crash_at, 4].set(True)
+        zeros = jnp.zeros((rounds, n), dtype=bool)
+        _, carry, per_round = run_rounds(
+            init_state(cfg), cfg, rounds, jax.random.PRNGKey(0),
+            events=RoundEvents(crash=crash, leave=zeros, join=zeros))
+        tensor_events = decode_scan(per_round, carry, n=n,
+                                    crash_rounds={4: crash_at})
+        tensor_seq = tl.kind_sequence(tensor_events, 4)
+        assert native_seq == tensor_seq == [
+            "crash", "hb_freeze", "confirm", "remove"]
+
+    def test_timeline_ingests_native_stream_unchanged(self, tmp_path):
+        """A native trace is a plain gossipfs-obs/v1 stream: timeline's
+        analyze re-derives the run's metrics from the file alone."""
+        sys.path.insert(0, str(
+            pathlib.Path(__file__).resolve().parents[1] / "tools"))
+        import timeline as tl
+
+        path = tmp_path / "native.jsonl"
+        rec, devents, _ = self._run_crash(22300, path=str(path))
+        header, events = tl.load_stream(str(path))
+        assert header["schema"] == "gossipfs-obs/v1"
+        assert header["source"] == "native"
+        doc = tl.analyze([header], events)
+        assert doc["rounds"] > 0
+        assert doc["detected"] == 2
+        assert doc["false_positives"] == sum(
+            1 for e in devents if e.false_positive)
+        assert set(doc["ttd_first"]) == {4, 7}
+
+    def test_feed_jsonl_refeed_never_double_counts(self, tmp_path):
+        """A MonitorRecorder-written native stream re-fed through a
+        fresh StreamMonitor re-derives, never double-counts: estimator
+        parity field-for-field, violations re-derived not appended."""
+        from gossipfs_tpu.obs.monitor import (
+            MonitorRecorder,
+            StreamMonitor,
+            estimator_parity,
+        )
+
+        path = tmp_path / "monitored.jsonl"
+        inline = MonitorRecorder(str(path), source="native", n=10,
+                                 crash_rounds={"4": 0, "7": 0})
+        self._run_crash(22400, recorder=inline)
+        fresh = StreamMonitor(n=10)
+        fresh.feed_jsonl(str(path))
+        fresh.finish()
+        parity = estimator_parity(inline.monitor.summary(),
+                                  fresh.summary())
+        assert parity["ok"], parity["mismatches"]
+        assert len(fresh.violations) == len(inline.monitor.violations)
+
+    def test_vitals_na_not_zero(self):
+        """The uniform-vitals surface: fields the engine cannot know (or
+        hasn't armed) are ABSENT and render n/a — never a fabricated 0;
+        arming suspicion makes its counters appear."""
+        from gossipfs_tpu.obs.schema import render_vitals
+        from gossipfs_tpu.suspicion import SuspicionParams
+
+        with native.NativeUdpDetector(n=6, base_port=22500,
+                                      period=0.05) as det:
+            det.advance(2)
+            doc = det.vitals()
+            assert doc["engine"] == "native"
+            assert doc["round"] >= 1 and doc["n_alive"] == 6
+            assert "suspects_now" not in doc  # suspicion off -> absent
+            assert "fp_suppressed" not in doc  # sim-only ground truth
+            rendered = render_vitals(doc)
+            assert "fp_suppressed=n/a" in rendered
+            assert "suspects_now=n/a" in rendered
+            assert "ops_issued=n/a" in rendered
+        with native.NativeUdpDetector(
+                n=6, base_port=22600, period=0.05,
+                suspicion=SuspicionParams(t_suspect=2)) as det:
+            det.advance(2)
+            doc = det.vitals()
+            for field in ("suspects_now", "suspects_entered",
+                          "refutations", "confirms"):
+                assert field in doc, field
+
+    def test_scenario_gate_and_suspicion_refute(self):
+        """The fault-gate table at the send seam + the in-engine SWIM
+        lifecycle: a flapped (alive!) node is confirmed as a false
+        positive by the raw detector, and with a wide-enough suspect
+        window the same flap is SUSPECTED then REFUTED — no confirm."""
+        from gossipfs_tpu.obs.recorder import FlightRecorder
+        from gossipfs_tpu.scenarios.schedule import FaultScenario, Flapping
+        from gossipfs_tpu.suspicion import SuspicionParams
+
+        def run(base_port, suspicion, down, rounds):
+            sc = FaultScenario(
+                name="flap-gate", n=8,
+                flapping=(Flapping(start=2, end=2 + down + 4, up=1,
+                                   down=down, nodes=(6,)),))
+            det = native.NativeUdpDetector(
+                n=8, base_port=base_port, period=0.05,
+                fresh_cooldown=True, suspicion=suspicion)
+            try:
+                det.seed_full_membership()
+                deadline = time.monotonic() + 30
+                while not det.warm():
+                    assert time.monotonic() < deadline
+                    time.sleep(0.05)
+                rec = FlightRecorder(None, source="native", n=8)
+                r0 = det.attach_recorder(rec)
+                det.load_scenario(sc, round0=r0)
+                det.advance(rounds)
+                det.stop()
+                det.pump_obs()
+                return rec, det.vitals()
+            finally:
+                det.close()
+
+        # raw: the dark span outlives t_fail -> false-positive confirm
+        rec, _ = run(22700, None, down=10, rounds=18)
+        fp6 = [e for e in rec.events
+               if e.kind == "confirm" and e.subject == 6]
+        assert fp6 and all(e.detail["false_positive"] for e in fp6)
+        # armed: suspect -> refute on recovery, never confirmed
+        rec, vit = run(22800, SuspicionParams(t_suspect=20), down=8,
+                       rounds=22)
+        kinds6 = [e.kind for e in rec.events if e.subject == 6]
+        assert "suspect" in kinds6 and "refute" in kinds6
+        assert "confirm" not in kinds6
+        assert vit["suspects_entered"] > 0 and vit["refutations"] > 0
+
+    def test_latency_histogram(self):
+        """Every round_tick carries the tick pass's wall-clock cost; the
+        histogram helper rolls them up (absent quantiles on an empty
+        stream — the n/a rule)."""
+        rec, _, _ = self._run_crash(22900, rounds=8)
+        hist = native.latency_histogram(rec.events)
+        assert hist["count"] >= 8
+        assert hist["p50_ms"] > 0
+        assert sum(hist["buckets"].values()) == hist["count"]
+        assert native.latency_histogram([]) == {"count": 0}
 
 
 def test_native_rt_bench_smoke():

@@ -16,11 +16,27 @@ streaming monitor as the oracle (gossipfs_tpu/campaigns/).
 
     # the SAME case over a REAL-SOCKET engine, verdict required to agree
     # with the tensor replay (campaigns/engines.py; --scale-n re-makes
-    # campaign-family cases at a socket-budget cohort)
+    # campaign-family cases at a socket-budget cohort).  The native
+    # C++ epoll engine is the COHORT-EXACT lane: committed n=256 cases
+    # run at their committed n (the asyncio loop melts past n~64)
     JAX_PLATFORMS=cpu python tools/campaign.py \
         --case regressions/outage_storm_n256.json --engine udp
     JAX_PLATFORMS=cpu python tools/campaign.py \
+        --case regressions/outage_storm_n256.json --engine native
+    JAX_PLATFORMS=cpu python tools/campaign.py \
         --case regressions/flap_storm_n256.json --engine deploy --scale-n 8
+
+    # a case pair over one engine (the verify_claims `native_cohort`
+    # claim: the committed storm + its absorption twin must reproduce
+    # their pre/post-fix verdicts over the native transport)
+    JAX_PLATFORMS=cpu python tools/campaign.py --engine native \
+        --pair regressions/outage_storm_n256.json \
+               regressions/outage_absorbed_n256.json
+
+    # the three-engine verdict matrix over every committed case
+    # (NATIVECAMPAIGN_r16.json is the committed artifact of this)
+    JAX_PLATFORMS=cpu python tools/campaign.py --matrix \
+        --out NATIVECAMPAIGN_r16.json
 
     # map the Lifeguard local-health knob surface vs correlated outages
     # (LOCALHEALTH_r14.json is the committed artifact of this command)
@@ -153,6 +169,182 @@ def _absorption(path) -> dict:
     }
 
 
+def _engine_cell(out: dict) -> dict:
+    """One verdict-matrix cell from a run_case_engine result."""
+    cell = {
+        "n": out["n"],
+        "scaled_from": out.get("scaled_from"),
+        "verdict": out["engine_verdict"],
+        # the tensor replay this row's agreement was judged against —
+        # for a rescaled row that is the SCALED doc's replay, not the
+        # committed-cohort one in the case's `tensor` column
+        "tensor_reference_verdict": out["tensor_verdict"],
+        "reproduced": out["reproduced"],
+        "agreement": out["agreement"],
+    }
+    row = out.get("engine_row") or {}
+    if "period" in row:
+        cell["period"] = row["period"]
+    if "tick_ms" in row:
+        cell["tick_ms"] = row["tick_ms"]
+    return cell
+
+
+def _pair(args) -> dict:
+    """Two committed cases through ONE engine — the storm/absorption
+    pre/post-fix pair the `native_cohort` claim re-runs: both must
+    reproduce their committed verdicts AND agree with the tensor
+    replay per invariant."""
+    from gossipfs_tpu.campaigns.engines import run_case_engine
+
+    cases = {}
+    ok = True
+    for path in args.pair:
+        out = run_case_engine(path, engine=args.engine,
+                              scale_n=args.scale_n, period=args.period)
+        cases[os.path.basename(path)] = {
+            "expect": out["expect"],
+            "tensor_verdict": out["tensor_verdict"],
+            "engine": _engine_cell(out),
+        }
+        ok = ok and out["reproduced"]
+    return {"claim": "case_pair", "engine": args.engine,
+            "reproduced": ok, "cases": cases}
+
+
+def _case_subprocess(path, engine: str, scale_n: int | None,
+                     period: float | None) -> dict:
+    """One engine row in a FRESH interpreter.  The real-time lanes are
+    load-sensitive by physics (wall-clock staleness), and a matrix run
+    accumulates in-process state — jax arrays from the tensor replays,
+    GC pressure, event-loop residue — that measurably starves a
+    subsequent socket run (observed: the committed udp twin flipping
+    violated inside a long matrix process, passing standalone).
+    Subprocess isolation makes every cell the same experiment the
+    standalone `--case` command runs."""
+    import subprocess
+
+    cmd = [sys.executable, os.path.abspath(__file__), "--case", str(path),
+           "--engine", engine]
+    if scale_n is not None:
+        cmd += ["--scale-n", str(scale_n)]
+    if period is not None:
+        cmd += ["--period", str(period)]
+    out = subprocess.run(cmd, capture_output=True, text=True,
+                         timeout=1800)
+    for line in reversed(out.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError(f"no JSON from {cmd}: {out.stdout[-300:]}\n"
+                       f"{out.stderr[-300:]}")
+
+
+def _matrix(args) -> dict:
+    """The three-engine verdict matrix over every committed regression
+    case (the NATIVECAMPAIGN_r16.json artifact): tensor at the
+    committed n (the reference), native COHORT-EXACT at the committed
+    n, udp at the committed n when it fits the asyncio cohort budget
+    and scale_case-rescaled otherwise.  Every engine cell runs in its
+    own subprocess (see _case_subprocess).  Agreement is required per
+    invariant both engines checked; `all_agree` summarizes the matrix.
+    """
+    import pathlib
+
+    from gossipfs_tpu import campaigns
+
+    repo = pathlib.Path(__file__).resolve().parents[1]
+    paths = sorted((repo / "regressions").glob("*.json"))
+    cases = {}
+    all_agree = True
+    native_cohort_max = 0
+    rescale_boundaries = []
+    for path in paths:
+        doc = campaigns.load_case(path)
+        n = int(doc["config"]["n"])
+        nat = _case_subprocess(path, "native", None, args.period)
+        scale = None if n <= args.udp_budget else args.udp_budget
+        udp = _case_subprocess(path, "udp", scale, args.period)
+        row = {
+            "n": n,
+            "family": doc.get("family"),
+            "expect": doc["expect"],
+            # the tensor replay of the committed doc (deterministic —
+            # the native lane runs it cohort-exact)
+            "tensor": {"verdict": nat["tensor_verdict"],
+                       "reproduced": nat["tensor_verdict"]
+                       == doc["expect"]["verdict"]},
+            "native": _engine_cell(nat),
+            "udp": _engine_cell(udp),
+        }
+        cases[path.name] = row
+        udp_ok = udp["reproduced"]
+        if not udp_ok and udp.get("scaled_from") is not None:
+            # rescale boundaries, caught in-matrix — the reason the
+            # cohort-exact native lane exists.  Two known classes:
+            # (a) scaled_reference_flips (the round-14 knife-edge): the
+            #     SCALED tensor replay flips its verdict while the
+            #     socket engine still shows the committed-cohort
+            #     behavior ("the absorption knife-edge is cohort-sized
+            #     — the case does not simply rescale"; the committed
+            #     engine-calibrated twin outage_absorbed_udp_n64.json
+            #     exists for exactly this);
+            # (b) knee_at_boundary: a BISECTED breaking point rescaled
+            #     onto a jittered real-time transport sits at the
+            #     boundary by construction (the knee is the MINIMUM
+            #     violating severity on synchronous tensor rounds;
+            #     receipt-stamping slack is ~one FP per window —
+            #     measured worst windows 0.7-1.3x threshold across
+            #     runs of the scaled flap knee).
+            # Both are recorded findings, not matrix failures: the
+            # binding all-invariant agreement for these cases is their
+            # COHORT-EXACT native row.  Anything else (e.g. a scaled
+            # mild case storming) still fails the matrix.
+            reason = None
+            if udp["engine_verdict"] == doc["expect"]["verdict"]:
+                reason = "scaled_reference_flips"
+            elif (doc.get("axis_value") is not None
+                  and doc["expect"]["verdict"] == "violated"
+                  and set(udp["agreement"]["mismatched"])
+                  <= set(doc["expect"].get("invariants", []))):
+                reason = "knee_at_boundary"
+            if reason is not None:
+                rescale_boundaries.append({
+                    "case": path.name,
+                    "reason": reason,
+                    "scaled_to": udp["n"],
+                    "mismatched": udp["agreement"]["mismatched"],
+                    "engine_verdict": udp["engine_verdict"],
+                    "scaled_tensor_verdict": udp["tensor_verdict"],
+                    "committed_expect": doc["expect"]["verdict"],
+                })
+                udp_ok = True
+        all_agree = all_agree and nat["reproduced"] and udp_ok
+        if nat["reproduced"]:
+            native_cohort_max = max(native_cohort_max, n)
+    return {
+        "schema": "gossipfs-nativecampaign/v1",
+        "metric": "three-engine (tensor/udp/native) campaign verdict "
+                  "matrix over every committed regression case; native "
+                  "runs are cohort-exact at the committed n, udp rows "
+                  "above the asyncio budget are scale_case-rescaled "
+                  "(agreement judged vs the scaled tensor replay; "
+                  "known rescale-boundary classes — a scaled reference "
+                  "that itself flips verdict, a bisected knee sitting "
+                  "at the threshold on a jittered transport — land in "
+                  "rescale_boundaries with the cohort-exact native row "
+                  "as the binding agreement)",
+        "engines": ["tensor", "udp", "native"],
+        "udp_budget": args.udp_budget,
+        "native_cohort_max_n": native_cohort_max,
+        "all_agree": all_agree,
+        "rescale_boundaries": rescale_boundaries,
+        "cases": cases,
+        "command": "python tools/campaign.py --matrix --udp-budget %d"
+                   % args.udp_budget,
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--family", choices=None, default=None,
@@ -192,12 +384,28 @@ def main(argv=None) -> int:
     p.add_argument("--case", type=str, default=None,
                    help="replay a committed regression case instead of "
                         "running a campaign")
-    p.add_argument("--engine", choices=("tensor", "udp", "deploy"),
+    p.add_argument("--engine", choices=("tensor", "udp", "deploy",
+                                        "native"),
                    default="tensor",
-                   help="engine for --case replays: tensor (default), "
-                        "udp (asyncio real sockets), deploy (one OS "
-                        "process per node) — socket verdicts must agree "
-                        "with the tensor replay")
+                   help="engine for --case/--pair replays: tensor "
+                        "(default), udp (asyncio real sockets), deploy "
+                        "(one OS process per node), native (C++ epoll — "
+                        "the cohort-exact lane) — socket verdicts must "
+                        "agree with the tensor replay")
+    p.add_argument("--pair", type=str, nargs=2, default=None,
+                   metavar=("CASE_A", "CASE_B"),
+                   help="replay TWO committed cases through --engine "
+                        "(the storm/absorption pre/post-fix pair; exit "
+                        "0 iff both reproduce)")
+    p.add_argument("--matrix", action="store_true",
+                   help="run every committed regressions/ case through "
+                        "tensor+udp+native and emit the verdict-matrix "
+                        "artifact (NATIVECAMPAIGN_r16.json)")
+    p.add_argument("--udp-budget", type=int, default=64,
+                   help="--matrix: cohort budget for the asyncio lane — "
+                        "bigger committed cases are scale_case-rescaled "
+                        "to it (the native lane always runs cohort-"
+                        "exact)")
     p.add_argument("--scale-n", type=int, default=None,
                    help="re-make a campaign-family case at this cohort "
                         "size before replaying (the deploy lane's "
@@ -221,7 +429,7 @@ def main(argv=None) -> int:
                         "(the probe model is a load-bearing axis — see "
                         "campaigns.knob_surface on the heal race)")
     p.add_argument("--out", type=str, default=None,
-                   help="--surface: write the artifact here too")
+                   help="--surface/--matrix: write the artifact here too")
     p.add_argument("--absorption", type=str, default=None, metavar="ART",
                    help="re-verify a committed surface artifact's "
                         "chosen point (the outage_absorption claim)")
@@ -243,6 +451,23 @@ def main(argv=None) -> int:
         print(json.dumps(out))
         return 0
 
+    if args.matrix:
+        out = _matrix(args)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(out, f, indent=1)
+                f.write("\n")
+        print(json.dumps(out))
+        return 0 if out["all_agree"] else 1
+
+    if args.pair:
+        if args.engine == "tensor":
+            p.error("--pair compares a SOCKET engine against the tensor "
+                    "replay; pick --engine udp|deploy|native")
+        out = _pair(args)
+        print(json.dumps(out))
+        return 0 if out["reproduced"] else 1
+
     if args.case:
         if args.engine == "tensor":
             if args.scale_n:
@@ -258,8 +483,8 @@ def main(argv=None) -> int:
         return 0 if out["reproduced"] else 1
 
     if not args.family:
-        p.error("--family (or --case / --surface / --absorption) is "
-                "required")
+        p.error("--family (or --case / --pair / --matrix / --surface / "
+                "--absorption) is required")
     if args.family not in campaigns.FAMILIES:
         p.error(f"unknown family {args.family!r}; pick from "
                 f"{sorted(campaigns.FAMILIES)}")

@@ -196,6 +196,19 @@ CLAIMS = {
         ["env", "JAX_PLATFORMS=cpu", sys.executable, "tools/campaign.py",
          "--absorption", "LOCALHEALTH_r14.json"],
         lambda d: 1.0 if d["absorbed"] else 0.0, 1.0, 0.0),
+    # round-16 native cohort campaigns (NATIVECAMPAIGN_r16.json is the
+    # committed matrix): the storm/absorption pre/post-fix pair re-runs
+    # COHORT-EXACT at n=256 over the native C++ epoll engine — the
+    # committed 2-node outage storms (fpr_storm) and the LOCALHEALTH_r14
+    # chosen-knob twin absorbs (verdict pass, all four invariants), each
+    # agreeing with the tensor replay per invariant.  Needs the native
+    # toolchain (g++/make); wall-clock ~2 min on a 1-core host.
+    "native_cohort": (
+        ["env", "JAX_PLATFORMS=cpu", sys.executable, "tools/campaign.py",
+         "--engine", "native",
+         "--pair", "regressions/outage_storm_n256.json",
+         "regressions/outage_absorbed_n256.json"],
+        lambda d: 1.0 if d["reproduced"] else 0.0, 1.0, 0.0),
     # traffic plane (TRAFFIC_r12.json is the committed artifact of the
     # full-bench form of this command): writes race a timed partition
     # that confines quorum reachability to the master's side; the claim
