@@ -14,6 +14,10 @@ test modules, plus the checks every review re-derived by eye:
 * jit-hygiene for ``core/``/``ops/`` — ``rules_jit``
 * asyncio-hygiene for the socket engine — ``rules_asyncio``
 * the rr scratch-budget reconciliation (probe) — ``probes``
+* gossipfs-spec: the machine-readable protocol contract
+  (``protocol_spec``) statically diffed against all three engines —
+  transitions, rate limits, dissemination bounds, @gfs annotations in
+  the native engine, and the scan-carry arity seam — ``rules_spec``
 
 Run it: ``python tools/lint.py`` (exit 1 on any finding), or
 ``run_rules()`` from tests.  Every rule has a committed fixture under
@@ -38,6 +42,7 @@ from gossipfs_tpu.analysis import (  # noqa: E402,F401
     rules_native,
     rules_obs,
     rules_ownership,
+    rules_spec,
 )
 
 __all__ = ["REGISTRY", "Finding", "RepoIndex", "Rule", "rule", "run_rules"]

@@ -1,0 +1,765 @@
+"""gossipfs-spec extractors: statically recover each engine's
+implemented protocol and diff it against ``protocol_spec``.
+
+One rule per drift class, each with a committed seeded-drift fixture
+(tests/fixtures/lint/spec_*) asserted to fire it:
+
+* ``spec-dissemination`` — the new-suspicion SUSPECT push must honor
+  the contract's dissemination bound: under the campaign profile
+  (``push == "random"``) subject + fanout sample, never an
+  unconditional all-peers broadcast.  This is the rule that flagged
+  the ENTRY-broadcast asymmetry at head (detector/udp.py broadcast to
+  all peers where native bounded it — the red half of this PR's
+  red->green evidence).
+* ``spec-refute-rate-limit`` — both socket engines must rate-limit the
+  REFUTE broadcast to once per period (compare-then-stamp on the
+  last-refute clock).
+* ``spec-transition-order`` — the tensor ``_tick`` must compute the
+  SUSPECT->FAILED confirm mask from PRE-WRITE status, then write
+  SUSPECT, then FAILED: an entry always spends >= 1 round SUSPECT
+  before it can confirm.  Also holds the confirm-window formula to the
+  contract's names (t_fail / t_suspect / lh_multiplier).
+* ``spec-runtime-protocol`` — ``suspicion/runtime.py`` (the per-node
+  reference semantics the socket engines mirror) must carry the full
+  lifecycle verb set and the degraded / stretched-window formulas.
+* ``spec-native-annotations`` — the C++ side, built from ``// @gfs:``
+  annotations in engine.cc, cross-checked BOTH ways: every annotation
+  must match a contract row, and every lifecycle ``ObsEmit`` kind must
+  be dominated by a matching annotation — the round-11
+  ``native-obs-kinds`` ownership pattern extended across semantics,
+  not just names.
+* ``spec-obs-kind-coverage`` — obs/schema.py ``LIFECYCLE_KINDS`` and
+  the contract's emit kinds must be the SAME set (and every emit kind
+  an ``EVENT_KINDS`` entry): a new lifecycle state cannot ship without
+  a contract row.
+* ``scan-carry-arity`` — the rr scan carry tuple, ``parallel/mesh.py``
+  out_specs and the PackedDetector threading must agree in arity and
+  field order (the seam-bug class the round-9 suspect-count side
+  output had to hand-patch).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from . import protocol_spec as spec
+from .framework import Finding, dotted, namedtuple_fields, rule
+
+_UDP = "gossipfs_tpu/detector/udp.py"
+_RUNTIME = "gossipfs_tpu/suspicion/runtime.py"
+_ROUNDS = "gossipfs_tpu/core/rounds.py"
+_MESH = "gossipfs_tpu/parallel/mesh.py"
+_SIM = "gossipfs_tpu/detector/sim.py"
+_ENGINE = "native/engine.cc"
+_SCHEMA = "gossipfs_tpu/obs/schema.py"
+
+
+# ---------------------------------------------------------------------------
+# shared helpers
+# ---------------------------------------------------------------------------
+
+def _func(tree: ast.AST, name: str):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == name:
+            return node
+    return None
+
+
+def _attrs_in(node: ast.AST) -> set[str]:
+    return {n.attr for n in ast.walk(node) if isinstance(n, ast.Attribute)}
+
+
+def _line_of(src: str, pos: int) -> int:
+    return src.count("\n", 0, pos) + 1
+
+
+def _assign_line(tree: ast.Module, name: str) -> int:
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target,
+                                                           ast.Name):
+            targets = [node.target.id]
+        if name in targets:
+            return node.lineno
+    return 1
+
+
+def _literal_tuple(tree: ast.Module, name: str):
+    """Module-level ``NAME = (...)`` literal, or None."""
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target,
+                                                           ast.Name):
+            targets, value = [node.target.id], node.value
+        else:
+            continue
+        if name in targets and value is not None:
+            try:
+                return ast.literal_eval(value)
+            except ValueError:
+                return None
+    return None
+
+
+def _compares_push_random(test: ast.AST) -> bool:
+    """True for a ``<x>.push == "random"`` (or reversed) comparison."""
+    for node in ast.walk(test):
+        if not isinstance(node, ast.Compare):
+            continue
+        sides = [node.left] + list(node.comparators)
+        names = set()
+        consts = set()
+        for s in sides:
+            if isinstance(s, ast.Attribute):
+                names.add(s.attr)
+            elif isinstance(s, ast.Name):
+                names.add(s.id)
+            elif isinstance(s, ast.Constant) and isinstance(s.value, str):
+                consts.add(s.value)
+        if "push" in names and "random" in consts:
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# spec-dissemination
+# ---------------------------------------------------------------------------
+
+@rule(
+    "spec-dissemination",
+    "new-suspicion SUSPECT dissemination must honor the contract bound: "
+    "campaign profile (push=random) = subject + fanout sample, never an "
+    "unconditional all-peers broadcast (protocol_spec.DISSEMINATION)",
+    fixture="spec_udp_widened.py",
+    fixture_at=_UDP,
+)
+def spec_dissemination(index) -> list[Finding]:
+    findings: list[Finding] = []
+    row = spec.dissemination_row("new_suspect", "campaign")
+    # -- udp engine: the rt.suspect(...) branch of UdpNode.tick is the
+    # one place a NEW suspicion is disseminated
+    tree = index.tree(_UDP)
+    fn = _func(tree, "tick")
+    branch = None
+    if fn is not None:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.If):
+                continue
+            for call in ast.walk(node.test):
+                if isinstance(call, ast.Call):
+                    d = dotted(call.func)
+                    if d is not None and d.endswith(".suspect"):
+                        branch = node
+                        break
+            if branch is not None:
+                break
+    if branch is None:
+        findings.append(Finding(
+            "spec-dissemination", _UDP, 1,
+            "extractor went blind: UdpNode.tick's rt.suspect(...) branch "
+            "not found — the analyzer cannot see the new-suspicion "
+            "dissemination it exists to bound",
+        ))
+    else:
+        bounded = False
+        for sub in ast.walk(branch):
+            if not isinstance(sub, ast.If) \
+                    or not _compares_push_random(sub.test):
+                continue
+            gated_attrs: set[str] = set()
+            gated_calls: set[str] = set()
+            for stmt in sub.body:
+                gated_attrs |= _attrs_in(stmt)
+                for c in ast.walk(stmt):
+                    if isinstance(c, ast.Call):
+                        d = dotted(c.func)
+                        if d is not None:
+                            gated_calls.add(d.rsplit(".", 1)[-1])
+            if "fanout" in gated_attrs and "sample" in gated_calls:
+                bounded = True
+        if not bounded:
+            findings.append(Finding(
+                "spec-dissemination", _UDP, branch.lineno,
+                "new-suspicion SUSPECT dissemination is not bounded under "
+                f"the campaign profile: the contract row requires "
+                f"'{row.bound}' there (a push == \"random\" gate sending "
+                "to the subject plus an rng.sample(..., fanout) draw) — "
+                "found an unconditional broadcast, O(suspects x N) per "
+                "round at cohort sizes",
+            ))
+    # -- native engine: the newly_suspect loop must carry the same gate
+    src = index.source(_ENGINE)
+    pos = src.find("newly_suspect)")
+    if pos < 0:
+        findings.append(Finding(
+            "spec-dissemination", _ENGINE, 1,
+            "extractor went blind: the newly_suspect dissemination loop "
+            "was not found in the native Tick",
+        ))
+    else:
+        window = src[pos:pos + 2500]
+        if "push_random" not in window or "fanout" not in window:
+            findings.append(Finding(
+                "spec-dissemination", _ENGINE, _line_of(src, pos),
+                "native newly-suspect dissemination lost its campaign "
+                f"bound: the contract row requires '{row.bound}' behind "
+                "a push_random gate with a fanout-sized sample",
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# spec-refute-rate-limit
+# ---------------------------------------------------------------------------
+
+@rule(
+    "spec-refute-rate-limit",
+    "the REFUTE broadcast must be rate-limited to once per heartbeat "
+    "period in both socket engines (protocol_spec.RATE_LIMITS "
+    "refute_broadcast: compare-then-stamp on the last-refute clock)",
+    fixture="spec_refute_rate_limit.py",
+    fixture_at=_UDP,
+)
+def spec_refute_rate_limit(index) -> list[Finding]:
+    findings: list[Finding] = []
+    limit = spec.rate_limit("refute_broadcast")
+    # -- udp engine: _on_suspect must early-return inside the period and
+    # stamp the clock before bumping/broadcasting
+    tree = index.tree(_UDP)
+    fn = _func(tree, "_on_suspect")
+    if fn is None:
+        findings.append(Finding(
+            "spec-refute-rate-limit", _UDP, 1,
+            "extractor went blind: UdpNode._on_suspect not found — the "
+            "analyzer cannot see the refute path it rate-limits",
+        ))
+    else:
+        guarded = any(
+            isinstance(sub, ast.If)
+            and "_last_refute_t" in _attrs_in(sub.test)
+            and "period" in _attrs_in(sub.test)
+            and any(isinstance(s, ast.Return) for s in sub.body)
+            for sub in ast.walk(fn)
+        )
+        stamped = any(
+            isinstance(sub, ast.Assign)
+            and any(
+                isinstance(t, ast.Attribute) and t.attr == "_last_refute_t"
+                for t in sub.targets
+            )
+            for sub in ast.walk(fn)
+        )
+        if not (guarded and stamped):
+            findings.append(Finding(
+                "spec-refute-rate-limit", _UDP, fn.lineno,
+                f"udp _on_suspect dropped the refute rate limit "
+                f"({limit.window}): it must compare now against "
+                "self._last_refute_t (early return inside the period) "
+                "and stamp it before bumping — without it, k suspectors "
+                "amplify one episode to O(k x N) REFUTE datagrams",
+            ))
+    # -- native engine: OnSuspect carries the same compare-then-stamp
+    src = index.source(_ENGINE)
+    if "last_refute_t_" not in src:
+        findings.append(Finding(
+            "spec-refute-rate-limit", _ENGINE, 1,
+            "extractor went blind: last_refute_t_ not found in the "
+            "native engine — the refute rate-limit clock is gone",
+        ))
+    else:
+        compared = re.search(r"last_refute_t_\s*<", src)
+        stamped = re.search(r"last_refute_t_\s*=\s*now", src)
+        if not (compared and stamped):
+            miss = compared or stamped
+            findings.append(Finding(
+                "spec-refute-rate-limit", _ENGINE,
+                _line_of(src, miss.start()) if miss else 1,
+                f"native OnSuspect dropped the refute rate limit "
+                f"({limit.window}): the last_refute_t_ clock must be "
+                "compared against cfg.period AND stamped",
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# spec-transition-order
+# ---------------------------------------------------------------------------
+
+@rule(
+    "spec-transition-order",
+    "the tensor _tick must compute the confirm mask from PRE-WRITE "
+    "status, then write SUSPECT, then FAILED (an entry always spends "
+    ">= 1 round SUSPECT before it can confirm), with the confirm "
+    "window built from the contract's t_fail/t_suspect/lh_multiplier",
+    fixture="spec_transition_order.py",
+    fixture_at=_ROUNDS,
+)
+def spec_transition_order(index) -> list[Finding]:
+    findings: list[Finding] = []
+    tree = index.tree(_ROUNDS)
+    fn = _func(tree, "_tick")
+    if fn is None:
+        return [Finding(
+            "spec-transition-order", _ROUNDS, 1,
+            "extractor went blind: _tick not found — the analyzer "
+            "cannot see the tensor transition ordering it pins",
+        )]
+
+    def _where_write(node, arg0: str, arg1: str) -> bool:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            return False
+        t = node.targets[0]
+        if not (isinstance(t, ast.Name) and t.id == "status"):
+            return False
+        v = node.value
+        return (
+            isinstance(v, ast.Call)
+            and dotted(v.func) == "jnp.where"
+            and len(v.args) >= 2
+            and isinstance(v.args[0], ast.Name) and v.args[0].id == arg0
+            and isinstance(v.args[1], ast.Name) and v.args[1].id == arg1
+        )
+
+    confirm_line = suspect_line = failed_line = None
+    formula_ok = False
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            if node.targets[0].id == "confirm" and confirm_line is None:
+                confirm_line = node.lineno
+            attrs = _attrs_in(node.value)
+            if {"t_fail", "t_suspect", "lh_multiplier"} <= attrs:
+                formula_ok = True
+        if _where_write(node, "suspect_new", "SUSPECT") \
+                and suspect_line is None:
+            suspect_line = node.lineno
+        if _where_write(node, "confirm", "FAILED") and failed_line is None:
+            failed_line = node.lineno
+    if None in (confirm_line, suspect_line, failed_line):
+        findings.append(Finding(
+            "spec-transition-order", _ROUNDS, fn.lineno,
+            "extractor went blind: _tick no longer carries the "
+            "recognizable confirm-mask / SUSPECT-write / FAILED-write "
+            "statements the contract orders",
+        ))
+    elif not (confirm_line < suspect_line < failed_line):
+        findings.append(Finding(
+            "spec-transition-order", _ROUNDS, suspect_line,
+            "reordered transition guard: _tick must compute `confirm` "
+            "from PRE-WRITE status BEFORE writing SUSPECT and FAILED "
+            f"(found confirm@{confirm_line}, SUSPECT-write@"
+            f"{suspect_line}, FAILED-write@{failed_line}) — writing "
+            "SUSPECT first lets a same-round entry satisfy the confirm "
+            "compare and skip its suspect window entirely",
+        ))
+    if not formula_ok and not findings:
+        findings.append(Finding(
+            "spec-transition-order", _ROUNDS, confirm_line or fn.lineno,
+            "the confirm window no longer references the contract "
+            "formula names (t_fail + t_suspect stretched by "
+            "lh_multiplier while degraded): "
+            + spec.THRESHOLDS["confirm_window"],
+        ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# spec-runtime-protocol
+# ---------------------------------------------------------------------------
+
+# The per-node lifecycle verb set SuspicionRuntime must expose: the
+# socket engines mirror these semantics method-for-method.
+_RUNTIME_VERBS = ("suspect", "adopt", "expired", "refute", "confirm",
+                  "drop", "degraded", "t_suspect_window")
+
+
+@rule(
+    "spec-runtime-protocol",
+    "suspicion/runtime.py must carry the full contract lifecycle verb "
+    "set plus the degraded and Lifeguard-stretched-window formulas "
+    "(protocol_spec.THRESHOLDS degraded / confirm_window)",
+    fixture="spec_runtime_drift.py",
+    fixture_at=_RUNTIME,
+)
+def spec_runtime_protocol(index) -> list[Finding]:
+    findings: list[Finding] = []
+    tree = index.tree(_RUNTIME)
+    cls = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "SuspicionRuntime":
+            cls = node
+            break
+    if cls is None:
+        return [Finding(
+            "spec-runtime-protocol", _RUNTIME, 1,
+            "extractor went blind: SuspicionRuntime not found — the "
+            "reference lifecycle semantics the socket engines mirror "
+            "are gone",
+        )]
+    methods = {
+        n.name: n for n in cls.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    for verb in _RUNTIME_VERBS:
+        if verb not in methods:
+            findings.append(Finding(
+                "spec-runtime-protocol", _RUNTIME, cls.lineno,
+                f"SuspicionRuntime lost lifecycle verb `{verb}` — every "
+                "contract transition needs its runtime method (the "
+                "socket engines mirror them method-for-method)",
+            ))
+    deg = methods.get("degraded")
+    if deg is not None and "lh_frac" not in _attrs_in(deg):
+        findings.append(Finding(
+            "spec-runtime-protocol", _RUNTIME, deg.lineno,
+            "degraded() no longer implements the contract formula "
+            f"({spec.THRESHOLDS['degraded']})",
+        ))
+    win = methods.get("t_suspect_window")
+    if win is not None:
+        attrs = _attrs_in(win)
+        if not {"t_suspect", "lh_multiplier", "degraded"} <= attrs:
+            findings.append(Finding(
+                "spec-runtime-protocol", _RUNTIME, win.lineno,
+                "t_suspect_window() dropped the Lifeguard stretch: the "
+                "contract window is "
+                + spec.THRESHOLDS["confirm_window"],
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# spec-native-annotations
+# ---------------------------------------------------------------------------
+
+_ANN_RE = re.compile(r"//\s*@gfs:(\w+)[ \t]+([^\n]*)")
+_OBS_RE = re.compile(r'ObsEmit\(\s*"([a-z_]+)"')
+_TRANSITION_RE = re.compile(r"^(\w+)->(\w+)\s+guard=([\w-]+)\s*$")
+_DISSEM_RE = re.compile(r"^(\w+)\s+profile=(\w+)\s+bound=([\w+]+)\s*$")
+
+# How far above an ObsEmit a dominating annotation may sit (lines).
+_DOMINATION_WINDOW = 30
+
+
+def _parse_annotations(src: str):
+    """[(line, tag, payload, emits-or-None, matches_spec)] for every
+    ``// @gfs:`` annotation in the native source."""
+    out = []
+    for m in _ANN_RE.finditer(src):
+        tag, payload = m.group(1), m.group(2).strip()
+        line = _line_of(src, m.start())
+        emits, ok = None, False
+        if tag == "transition":
+            tm = _TRANSITION_RE.match(payload)
+            if tm:
+                row = spec.transition(tm.group(1), tm.group(2), tm.group(3))
+                if row is not None and "native" in row.engines:
+                    ok, emits = True, row.emits
+        elif tag == "verb":
+            ok = payload in spec.WIRE_VERBS
+        elif tag == "rate_limit":
+            row = spec.rate_limit(payload)
+            ok = row is not None and "native" in row.engines
+        elif tag == "dissemination":
+            dm = _DISSEM_RE.match(payload)
+            if dm:
+                row = spec.dissemination_row(dm.group(1), dm.group(2))
+                ok = row is not None and row.bound == dm.group(3) \
+                    and "native" in row.engines
+        elif tag == "inject":
+            row = spec.injection(payload)
+            if row is not None:
+                ok, emits = True, row.emits
+        out.append((line, tag, payload, emits, ok))
+    return out
+
+
+@rule(
+    "spec-native-annotations",
+    "engine.cc's // @gfs: annotations are the native protocol "
+    "extraction, cross-checked both ways: every annotation must match "
+    "a contract row, every lifecycle ObsEmit must be dominated by a "
+    "matching annotated transition/injection, and every native "
+    "contract row must be annotated",
+    fixture="spec_native_annotations.cc",
+    fixture_at=_ENGINE,
+)
+def spec_native_annotations(index) -> list[Finding]:
+    findings: list[Finding] = []
+    src = index.source(_ENGINE)
+    anns = _parse_annotations(src)
+    sites = [(_line_of(src, m.start()), m.group(1))
+             for m in _OBS_RE.finditer(src)]
+    if not anns and not sites:
+        return [Finding(
+            "spec-native-annotations", _ENGINE, 1,
+            "extractor went blind: no @gfs: annotations and no ObsEmit "
+            "sites found — the native protocol surface is invisible",
+        )]
+    # 1) forward: every annotation matches a contract row
+    for line, tag, payload, _emits, ok in anns:
+        if not ok:
+            findings.append(Finding(
+                "spec-native-annotations", _ENGINE, line,
+                f"annotation `@gfs:{tag} {payload}` matches no "
+                "protocol_spec row (native engines column included) — "
+                "either the annotation drifted or the contract is "
+                "missing a row",
+            ))
+    # 2) domination: every lifecycle ObsEmit kind is declared by a
+    # matching annotation within the preceding window
+    lifecycle = spec.lifecycle_emit_kinds()
+    for line, kind in sites:
+        if kind not in lifecycle:
+            continue
+        declared = {
+            emits for aline, _t, _p, emits, ok in anns
+            if ok and emits is not None
+            and line - _DOMINATION_WINDOW <= aline < line
+        }
+        if kind not in declared:
+            findings.append(Finding(
+                "spec-native-annotations", _ENGINE, line,
+                f'lifecycle ObsEmit("{kind}") is not dominated by a '
+                "matching @gfs:transition/@gfs:inject annotation in the "
+                f"preceding {_DOMINATION_WINDOW} lines — the native "
+                "emission has no declared contract edge",
+            ))
+    # 3) reverse: every native contract row is annotated somewhere
+    ok_anns = [(tag, payload) for _l, tag, payload, _e, ok in anns if ok]
+    for t in spec.TRANSITIONS:
+        if t.emits is None or "native" not in t.engines:
+            continue
+        want = f"{t.src}->{t.dst} guard={t.guard}"
+        if ("transition", want) not in ok_anns:
+            findings.append(Finding(
+                "spec-native-annotations", _ENGINE, 1,
+                f"contract transition `{want}` (emits {t.emits}) has no "
+                "@gfs:transition annotation in the native engine",
+            ))
+    for verb in spec.WIRE_VERBS:
+        if ("verb", verb) not in ok_anns:
+            findings.append(Finding(
+                "spec-native-annotations", _ENGINE, 1,
+                f"wire verb `{verb}` has no @gfs:verb annotation at the "
+                "native dispatch",
+            ))
+    for r in spec.RATE_LIMITS:
+        if "native" in r.engines and ("rate_limit", r.name) not in ok_anns:
+            findings.append(Finding(
+                "spec-native-annotations", _ENGINE, 1,
+                f"rate limit `{r.name}` has no @gfs:rate_limit "
+                "annotation in the native engine",
+            ))
+    for d in spec.DISSEMINATION:
+        if not (d.annotated and "native" in d.engines):
+            continue
+        want = f"{d.event} profile={d.profile} bound={d.bound}"
+        if ("dissemination", want) not in ok_anns:
+            findings.append(Finding(
+                "spec-native-annotations", _ENGINE, 1,
+                f"dissemination row `{want}` has no @gfs:dissemination "
+                "annotation in the native engine",
+            ))
+    for i in spec.INJECTIONS:
+        if ("inject", i.name) not in ok_anns:
+            findings.append(Finding(
+                "spec-native-annotations", _ENGINE, 1,
+                f"injection `{i.name}` has no @gfs:inject annotation at "
+                "the native injection seam",
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# spec-obs-kind-coverage
+# ---------------------------------------------------------------------------
+
+@rule(
+    "spec-obs-kind-coverage",
+    "obs/schema.py LIFECYCLE_KINDS and the contract's emit kinds must "
+    "be the same set, and every emit kind an EVENT_KINDS entry — a new "
+    "lifecycle state cannot ship without a contract row",
+    fixture="spec_obs_kinds.py",
+    fixture_at=_SCHEMA,
+)
+def spec_obs_kind_coverage(index) -> list[Finding]:
+    findings: list[Finding] = []
+    tree = index.tree(_SCHEMA)
+    kinds = _literal_tuple(tree, "EVENT_KINDS")
+    lifecycle = _literal_tuple(tree, "LIFECYCLE_KINDS")
+    if not isinstance(kinds, dict) or not isinstance(lifecycle, tuple):
+        return [Finding(
+            "spec-obs-kind-coverage", _SCHEMA, 1,
+            "extractor went blind: EVENT_KINDS / LIFECYCLE_KINDS are no "
+            "longer module-level literals the contract can diff against",
+        )]
+    line = _assign_line(tree, "LIFECYCLE_KINDS")
+    spec_kinds = spec.lifecycle_emit_kinds()
+    for k in sorted(spec_kinds - set(lifecycle)):
+        findings.append(Finding(
+            "spec-obs-kind-coverage", _SCHEMA, line,
+            f"the contract emits `{k}` but schema LIFECYCLE_KINDS lacks "
+            "it — the lifecycle timeline would silently drop a contract "
+            "event",
+        ))
+    for k in sorted(set(lifecycle) - spec_kinds):
+        findings.append(Finding(
+            "spec-obs-kind-coverage", _SCHEMA, line,
+            f"schema lifecycle kind `{k}` has no contract "
+            "transition/injection row — add the protocol_spec row "
+            "before shipping the state",
+        ))
+    for k in sorted(spec_kinds - set(kinds)):
+        findings.append(Finding(
+            "spec-obs-kind-coverage", _SCHEMA, line,
+            f"contract emit kind `{k}` is missing from EVENT_KINDS",
+        ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# scan-carry-arity
+# ---------------------------------------------------------------------------
+
+@rule(
+    "scan-carry-arity",
+    "the rr scan carry tuple, parallel/mesh.py out_specs and the "
+    "PackedDetector threading must agree in arity and field order "
+    "(MetricsCarry/RoundMetrics construction checked against the "
+    "NamedTuple definitions; the 9-ary scan return against its unpack)",
+    fixture="spec_scan_carry_arity.py",
+    fixture_at=_MESH,
+)
+def scan_carry_arity(index) -> list[Finding]:
+    findings: list[Finding] = []
+    rtree = index.tree(_ROUNDS)
+    mc_fields = namedtuple_fields(rtree, "MetricsCarry")
+    rm_fields = namedtuple_fields(rtree, "RoundMetrics")
+    if mc_fields is None or rm_fields is None:
+        findings.append(Finding(
+            "scan-carry-arity", _ROUNDS, 1,
+            "extractor went blind: MetricsCarry / RoundMetrics "
+            "NamedTuple definitions not found",
+        ))
+    # -- rr scan internal consistency: base carry arity A, step unpacks
+    # {A, A+1} (lh arms an extra sus_counts slot), out_carry == A,
+    # final unpack A non-star targets + star, return tuple arity R
+    ret_arity = None
+    fn = _func(rtree, "_scan_rounds_rr_packed")
+    if fn is None:
+        findings.append(Finding(
+            "scan-carry-arity", _ROUNDS, 1,
+            "extractor went blind: _scan_rounds_rr_packed not found",
+        ))
+    else:
+        base = out = None
+        base_line = fn.lineno
+        unpacks: list[tuple[int, int]] = []
+        final_np = None
+        final_star = False
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                continue
+            t, v = node.targets[0], node.value
+            if isinstance(t, ast.Name) and isinstance(v, ast.Tuple):
+                if t.id == "carry0" and base is None:
+                    base, base_line = len(v.elts), node.lineno
+                elif t.id == "out_carry" and out is None:
+                    out = len(v.elts)
+            if isinstance(t, ast.Tuple) and isinstance(v, ast.Name):
+                stars = sum(isinstance(e, ast.Starred) for e in t.elts)
+                if v.id == "carry":
+                    unpacks.append((len(t.elts), node.lineno))
+                elif v.id == "final":
+                    final_np = len(t.elts) - stars
+                    final_star = stars > 0
+        for node in fn.body:
+            if isinstance(node, ast.Return) \
+                    and isinstance(node.value, ast.Tuple):
+                ret_arity = len(node.value.elts)
+        if base is None or ret_arity is None:
+            findings.append(Finding(
+                "scan-carry-arity", _ROUNDS, base_line,
+                "extractor went blind: the rr carry0 tuple / packed "
+                "return tuple are no longer recognizable",
+            ))
+        else:
+            for arity, line in unpacks:
+                if arity not in (base, base + 1):
+                    findings.append(Finding(
+                        "scan-carry-arity", _ROUNDS, line,
+                        f"rr step unpacks {arity} carry slots where "
+                        f"carry0 threads {base} (or {base + 1} with "
+                        "local health armed) — a silently shifted field "
+                        "order corrupts every downstream counter",
+                    ))
+            if out is not None and out != base:
+                findings.append(Finding(
+                    "scan-carry-arity", _ROUNDS, base_line,
+                    f"rr out_carry has {out} slots where carry0 has "
+                    f"{base} — the scan would re-thread misaligned state",
+                ))
+            if final_np is not None \
+                    and (final_np != base or not final_star):
+                findings.append(Finding(
+                    "scan-carry-arity", _ROUNDS, base_line,
+                    f"the final carry unpack names {final_np} slots "
+                    f"(star={final_star}) where carry0 threads {base} "
+                    "plus the starred lh tail",
+                ))
+    # -- constructor-call arity at the seams (mesh out_specs, sim)
+    for path in (_MESH, _SIM):
+        tree = index.tree(path)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if d is None:
+                continue
+            last = d.rsplit(".", 1)[-1]
+            if last == "MetricsCarry":
+                want = mc_fields
+            elif last == "RoundMetrics":
+                want = rm_fields
+            else:
+                continue
+            if want is None \
+                    or any(isinstance(a, ast.Starred) for a in node.args) \
+                    or any(kw.arg is None for kw in node.keywords):
+                continue
+            got = len(node.args) + len(node.keywords)
+            if got != len(want):
+                findings.append(Finding(
+                    "scan-carry-arity", path, node.lineno,
+                    f"{last}(...) constructed with {got} fields where "
+                    f"core.rounds defines {len(want)} "
+                    f"({', '.join(want)}) — shard specs / threaded "
+                    "metrics would bind to the wrong slots",
+                ))
+    # -- PackedDetector threading: the scan's return arity must match
+    # the advance-path unpack
+    if ret_arity is not None:
+        stree = index.tree(_SIM)
+        for node in ast.walk(stree):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                continue
+            t, v = node.targets[0], node.value
+            if not (isinstance(t, ast.Tuple) and isinstance(v, ast.Call)):
+                continue
+            d = dotted(v.func)
+            if d is None or not d.endswith("._step"):
+                continue
+            if len(t.elts) != ret_arity:
+                findings.append(Finding(
+                    "scan-carry-arity", _SIM, node.lineno,
+                    f"PackedDetector unpacks {len(t.elts)} values from "
+                    f"the packed scan step where it returns {ret_arity}",
+                ))
+    return findings

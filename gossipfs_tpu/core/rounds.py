@@ -534,6 +534,10 @@ def _tick(
             _rx(active, nd) & ~eye & (status == SUSPECT)
             & (age.astype(jnp.int32) > confirm_thr)
         )
+        # contract order (analysis/protocol_spec.py, spec-transition-order):
+        # confirm is computed from the pre-round SUSPECT set BEFORE the
+        # MEMBER->SUSPECT write lands, and the FAILED write is last —
+        # swapping these lets an entry suspect and confirm in one round
         status = jnp.where(suspect_new, SUSPECT, status)
         status = jnp.where(confirm, FAILED, status)
         fail = confirm

@@ -806,6 +806,9 @@ class PackedDetector:
             hb4, as4, alive, hb_base, rnd, counts = self._carry
             round_idx = int(rnd)
             prev_first = self._mcarry.first_detect
+            # 9-value unpack mirrors one_round's return; its width (and
+            # the MetricsCarry/RoundMetrics constructor arities above)
+            # are pinned to core/rounds by the scan-carry-arity rule
             (hb4, as4, alive, hb_base, rnd, counts, sus_counts, mc,
              per_round) = (
                 self._step(hb4, as4, alive, hb_base, rnd, counts,

@@ -332,9 +332,30 @@ class UdpNode:
                 if rt.suspect(addr, now):
                     self._obs("suspect", addr)
                     msg = f"{addr}{CMD_SEP}SUSPECT"
-                    for peer in list(self.members):
-                        if peer != self.addr:
+                    if c.push == "random":
+                        # campaign profile: bounded dissemination
+                        # (protocol_spec new_suspect/campaign, shared
+                        # with the native engine) — the SUBJECT always
+                        # hears (its active incarnation-bump refute is
+                        # the point) plus fanout random peers, O(fanout)
+                        # per new suspicion like every other push in
+                        # this mode.  The all-peers broadcast below is
+                        # O(suspects x N) per round: at cohort sizes a
+                        # rack outage makes every observer suspect the
+                        # whole rack in one tick.
+                        self._send(addr, msg)
+                        peers = [a for a in self.members
+                                 if a != self.addr and a != addr]
+                        for peer in self._rng.sample(
+                                peers, min(c.fanout, len(peers))):
                             self._send(peer, msg)
+                    else:
+                        # reference-faithful ring mode: all-peers
+                        # broadcast, kept verbatim for the small-n
+                        # udp-parity lane
+                        for peer in list(self.members):
+                            if peer != self.addr:
+                                self._send(peer, msg)
                     continue
                 window = rt.t_suspect_window(c.period, len(self.members))
                 if not rt.expired(addr, now, window):

@@ -107,6 +107,10 @@ EVENT_KINDS: dict[str, str] = {
 
 # Kinds that constitute a subject's detection-lifecycle timeline, in
 # canonical order — tools/timeline.py renders/validates against this.
+# This tuple is held in exact bijection with the protocol contract's
+# emit kinds (analysis/protocol_spec.py): adding a kind here without a
+# contract transition/injection row — or vice versa — fails the
+# spec-obs-kind-coverage rule and tests/test_protocol_spec.py.
 LIFECYCLE_KINDS = (
     "crash", "hb_freeze", "leave", "join",
     "suspect", "refute", "confirm", "remove",

@@ -1497,6 +1497,12 @@ def _rr_tick_packed(hb, asl, act_r, ref_r, eye, thr_g, member, failed,
     rr/SWAR fast path no longer degrades to stripe/XLA for
     lh_multiplier > 0.  ``lh_r=None`` keeps the scalar compare
     bit-identical to round 11.
+
+    Both windows are instances of the contract's ``stale`` /
+    ``confirm_window`` threshold formulas (analysis/protocol_spec.py
+    THRESHOLDS) — the fused kernel implements the same guards as the
+    XLA ``_tick`` and both socket engines, and the spec-* lint rules
+    plus tests/test_protocol_spec.py hold all of them to that table.
     """
     st_bits = asl & 3
     st_mem = st_bits == member

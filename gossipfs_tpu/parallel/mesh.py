@@ -159,6 +159,9 @@ def _sharded_runner(mesh, config, crash_rate, rejoin_rate, has_churn_ok,
     from gossipfs_tpu.scenarios.tensor import TensorScenario
 
     scn_spec = TensorScenario(*([rep] * len(TensorScenario._fields)))
+    # the positional MetricsCarry/RoundMetrics specs below must track the
+    # NamedTuple widths in core/rounds — a dropped/reordered spec silently
+    # binds later fields to the wrong sharding (scan-carry-arity rule)
     fn = _shard_map(
         local_run,
         mesh=mesh,

@@ -24,7 +24,14 @@ from gossipfs_tpu.suspicion.params import SuspicionParams
 
 
 class SuspicionRuntime:
-    """One node's suspect table + refute/confirm accounting."""
+    """One node's suspect table + refute/confirm accounting.
+
+    The verb surface here (suspect/adopt/expired/refute/confirm/drop/
+    degraded/t_suspect_window) IS the contract's per-node lifecycle API
+    (analysis/protocol_spec.py); the spec-runtime-protocol rule pins it,
+    including the lh_frac-driven ``degraded`` signal and the
+    lh-multiplied confirmation window.
+    """
 
     def __init__(self, params: SuspicionParams):
         self.params = params

@@ -39,6 +39,7 @@
 #include <vector>
 
 #include "codec.h"
+#include "tsa.h"
 
 namespace gossipfs {
 namespace {
@@ -130,70 +131,16 @@ struct GateTable {
   int horizon = 0;
 };
 
-class Cluster;
-
-class Node {
- public:
-  Node(Cluster* cluster, int idx, int port);
-  ~Node() { Close(); }
-
-  bool Open();   // bind the UDP socket
-  void Close();
-
-  void HandleDatagram(const std::string& payload);
-  void Tick(double now);
-  void StopGraceful();  // LEAVE broadcast then die
-  void StopCrash();     // silent death (CTRL+C)
-  void ResetState();    // fresh process state for a rejoin
-  void SeedMembers(const std::vector<std::string>& addrs, double now);
-
-  int fd() const { return fd_; }
-  int idx() const { return idx_; }
-  bool alive() const { return alive_; }
-  const std::string& addr() const { return addr_; }
-  std::vector<std::string> MemberAddrs() const;
-
- private:
-  void Send(const std::string& peer_addr, const std::string& msg);
-  void AddMember(const std::string& addr, double now);   // introducer path
-  void RemoveMember(const std::string& addr, double now);
-  void Merge(const std::vector<MemberEntry>& remote, double now);
-  void OnSuspect(const std::string& addr, double now);
-  void OnRefute(const std::string& arg, double now);
-  bool Degraded() const;  // Lifeguard local health (runtime.py::degraded)
-  std::string EncodeSelf() const;
-  uint32_t NextRand();  // per-node stream for the random-push draw
-
-  Cluster* cluster_;
-  int idx_;
-  int port_;
-  std::string addr_;
-  int fd_ = -1;
-  bool alive_ = false;
-  std::map<std::string, Member> members_;     // sorted: ring order by address
-  std::map<std::string, double> fail_list_;   // addr -> cooldown-start ts
-  // suspicion (armed iff cfg.t_suspect > 0): addr -> suspect-start ts,
-  // plus cumulative lifecycle counters (the vitals/round_tick surface)
-  std::map<std::string, double> suspects_;
-  long long sus_entered_ = 0;
-  long long sus_refutations_ = 0;
-  long long sus_confirms_ = 0;
-  double last_refute_t_ = -1e18;  // rate-limits REFUTE broadcasts
-  uint32_t rng_state_;
-
-  friend class Cluster;
-};
+// Cluster is defined BEFORE Node so Node's thread-safety attributes can
+// name the capability they are guarded by (`cluster_->mu_` must resolve
+// against a complete Cluster).  The members Node needs (ctor, dtor,
+// RecordDetection) are declared here and defined out-of-line after Node.
+class Node;
 
 class Cluster {
  public:
-  explicit Cluster(const Config& cfg) : cfg_(cfg) {
-    nodes_.reserve(cfg.n);
-    for (int i = 0; i < cfg.n; ++i) {
-      nodes_.emplace_back(new Node(this, i, cfg.base_port + i));
-      addr_to_idx_[nodes_.back()->addr()] = i;
-    }
-  }
-  ~Cluster() { Stop(); }
+  explicit Cluster(const Config& cfg);
+  ~Cluster();  // out-of-line: unique_ptr<Node> needs Node complete
 
   bool Start();
   void Stop();
@@ -207,7 +154,7 @@ class Cluster {
   void Advance(int rounds);
 
   int Round() {
-    std::lock_guard<std::mutex> lk(mu_);
+    MutexLock lk(mu_);
     return round_;
   }
   int Membership(int observer, int* out, int cap);
@@ -225,16 +172,8 @@ class Cluster {
   int Warm();       // 1 iff every alive view is full with every hb > 1
 
   const Config& cfg() const { return cfg_; }
-  void RecordDetection(int observer, const std::string& subject_addr) {
-    auto it = addr_to_idx_.find(subject_addr);
-    if (it == addr_to_idx_.end()) return;
-    int fp = nodes_[it->second]->alive() ? 1 : 0;
-    events_.push_back(DetectionEvent{round_, observer, it->second, fp});
-    det_total_ += 1;
-    fp_total_ += fp;
-    ObsEmit("confirm", observer, it->second,
-            fp ? "false_positive=1" : "false_positive=0");
-  }
+  void RecordDetection(int observer, const std::string& subject_addr)
+      GFS_REQUIRES(mu_);
   int IdxOf(const std::string& addr) const {
     auto it = addr_to_idx_.find(addr);
     return it == addr_to_idx_.end() ? -1 : it->second;
@@ -244,48 +183,164 @@ class Cluster {
   // reader stays obs.recorder.load_stream).  Kind strings are literals
   // at every call site: gossipfs-lint's native-obs-kinds rule requires
   // each to appear in obs/schema.py EVENT_KINDS (single ownership
-  // across the language boundary).
+  // across the language boundary), and rules_spec's
+  // spec-native-annotations rule requires every LIFECYCLE kind to be
+  // dominated by a matching `// @gfs:` contract annotation.
   void ObsEmit(const char* kind, int observer, int subject,
-               const std::string& detail);
+               const std::string& detail) GFS_REQUIRES(mu_);
   void ObsEmit(const char* kind, int observer,
-               const std::string& subject_addr, const std::string& detail);
-  bool ScenarioDrops(int src, const std::string& dst_addr) const;
-  void CountSend() { sends_total_ += 1; }
+               const std::string& subject_addr, const std::string& detail)
+      GFS_REQUIRES(mu_);
+  bool ScenarioDrops(int src, const std::string& dst_addr) const
+      GFS_REQUIRES(mu_);
+  void CountSend() GFS_REQUIRES(mu_) { sends_total_ += 1; }
 
  private:
   void LoopBody();
-  void EmitRoundTick(double tick_ms);
+  void EmitRoundTick(double tick_ms) GFS_REQUIRES(mu_);
 
+  // Immutable after construction / Start (no lock needed): cfg_ (knob
+  // writes only before the loop thread exists), nodes_, addr_to_idx_,
+  // epoll_fd_, loop_, running_ (atomic).
   Config cfg_;
   std::vector<std::unique_ptr<Node>> nodes_;
   std::map<std::string, int> addr_to_idx_;
-  std::vector<DetectionEvent> events_;
-  std::mutex mu_;  // guards all protocol state; the loop thread holds it
-                   // while processing one batch of datagrams / one tick
   std::thread loop_;
   std::atomic<bool> running_{false};
   int epoll_fd_ = -1;
-  int round_ = 0;
-  double next_tick_ = 0.0;
+  // mu_ guards all protocol state — every Node field below plus these —
+  // against the epoll loop thread vs the C-ABI control verbs.  The loop
+  // thread holds it while processing one batch of datagrams / one tick.
+  Mutex mu_;
+  std::vector<DetectionEvent> events_ GFS_GUARDED_BY(mu_);
+  int round_ GFS_GUARDED_BY(mu_) = 0;
+  double next_tick_ GFS_GUARDED_BY(mu_) = 0.0;
   // -- cumulative counters (vitals; events_ drains, so the `metrics`
   // surface needs its own accounting — the udp engine's convention)
-  long long det_total_ = 0;
-  long long fp_total_ = 0;
-  long long sends_total_ = 0;
+  long long det_total_ GFS_GUARDED_BY(mu_) = 0;
+  long long fp_total_ GFS_GUARDED_BY(mu_) = 0;
+  long long sends_total_ GFS_GUARDED_BY(mu_) = 0;
   // -- obs plane: rendered event lines awaiting ObsDrain.  OFF until
   // gfs_obs_enable so detectors without a recorder never grow the
   // buffer; enabling rebases the stamped round clock to 0 (the
   // arming-relative frame the udp campaign streams use).
-  bool obs_enabled_ = false;
-  int obs_round0_ = 0;
-  std::string obs_buf_;
-  long long obs_det0_ = 0, obs_fp0_ = 0, obs_sends0_ = 0;
-  long long obs_sus_entered0_ = 0, obs_refut0_ = 0;
+  bool obs_enabled_ GFS_GUARDED_BY(mu_) = false;
+  int obs_round0_ GFS_GUARDED_BY(mu_) = 0;
+  std::string obs_buf_ GFS_GUARDED_BY(mu_);
+  long long obs_det0_ GFS_GUARDED_BY(mu_) = 0;
+  long long obs_fp0_ GFS_GUARDED_BY(mu_) = 0;
+  long long obs_sends0_ GFS_GUARDED_BY(mu_) = 0;
+  long long obs_sus_entered0_ GFS_GUARDED_BY(mu_) = 0;
+  long long obs_refut0_ GFS_GUARDED_BY(mu_) = 0;
   // -- armed fault gates (ScenarioLoad); windows are round0-relative
-  GateTable gates_;
-  bool gates_armed_ = false;
-  int scn_round0_ = 0;
+  GateTable gates_ GFS_GUARDED_BY(mu_);
+  bool gates_armed_ GFS_GUARDED_BY(mu_) = false;
+  int scn_round0_ GFS_GUARDED_BY(mu_) = 0;
+
+  friend class Node;
 };
+
+class Node {
+ public:
+  Node(Cluster* cluster, int idx, int port);
+  ~Node() { Close(); }
+
+  bool Open();   // bind the UDP socket
+  void Close();
+
+  void HandleDatagram(const std::string& payload)
+      GFS_REQUIRES(cluster_->mu_);
+  void Tick(double now) GFS_REQUIRES(cluster_->mu_);
+  void StopGraceful() GFS_REQUIRES(cluster_->mu_);  // LEAVE broadcast, die
+  void StopCrash() GFS_REQUIRES(cluster_->mu_);     // silent death (CTRL+C)
+  void ResetState() GFS_REQUIRES(cluster_->mu_);    // fresh state for rejoin
+  void SeedMembers(const std::vector<std::string>& addrs, double now)
+      GFS_REQUIRES(cluster_->mu_);
+
+  int fd() const { return fd_; }
+  int idx() const { return idx_; }
+  bool alive() const GFS_REQUIRES(cluster_->mu_) { return alive_; }
+  const std::string& addr() const { return addr_; }
+  std::vector<std::string> MemberAddrs() const GFS_REQUIRES(cluster_->mu_);
+
+  // TSA compares capability expressions syntactically, so at a Cluster
+  // call site `node->Tick()` requires `node->cluster_->mu_` — an alias
+  // of the held `this->mu_` the analysis cannot prove.  This assert-only
+  // no-op states the aliasing fact; Cluster calls it once per node at
+  // every crossing made with mu_ held.
+  void AssertLockHeld() const GFS_ASSERT_CAPABILITY(cluster_->mu_) {}
+
+ private:
+  void Send(const std::string& peer_addr, const std::string& msg)
+      GFS_REQUIRES(cluster_->mu_);
+  void AddMember(const std::string& addr, double now)
+      GFS_REQUIRES(cluster_->mu_);  // introducer path
+  void RemoveMember(const std::string& addr, double now)
+      GFS_REQUIRES(cluster_->mu_);
+  void Merge(const std::vector<MemberEntry>& remote, double now)
+      GFS_REQUIRES(cluster_->mu_);
+  void OnSuspect(const std::string& addr, double now)
+      GFS_REQUIRES(cluster_->mu_);
+  void OnRefute(const std::string& arg, double now)
+      GFS_REQUIRES(cluster_->mu_);
+  // Lifeguard local health (runtime.py::degraded)
+  bool Degraded() const GFS_REQUIRES(cluster_->mu_);
+  std::string EncodeSelf() const GFS_REQUIRES(cluster_->mu_);
+  // per-node stream for the random-push draw
+  uint32_t NextRand() GFS_REQUIRES(cluster_->mu_);
+
+  Cluster* const cluster_;
+  const int idx_;
+  const int port_;
+  std::string addr_;
+  int fd_ = -1;  // epoll registration is pre-thread; Close post-join
+  bool alive_ GFS_GUARDED_BY(cluster_->mu_) = false;
+  // sorted: ring order by address
+  std::map<std::string, Member> members_ GFS_GUARDED_BY(cluster_->mu_);
+  // addr -> cooldown-start ts
+  std::map<std::string, double> fail_list_ GFS_GUARDED_BY(cluster_->mu_);
+  // suspicion (armed iff cfg.t_suspect > 0): addr -> suspect-start ts,
+  // plus cumulative lifecycle counters (the vitals/round_tick surface)
+  std::map<std::string, double> suspects_ GFS_GUARDED_BY(cluster_->mu_);
+  long long sus_entered_ GFS_GUARDED_BY(cluster_->mu_) = 0;
+  long long sus_refutations_ GFS_GUARDED_BY(cluster_->mu_) = 0;
+  long long sus_confirms_ GFS_GUARDED_BY(cluster_->mu_) = 0;
+  // rate-limits REFUTE broadcasts
+  double last_refute_t_ GFS_GUARDED_BY(cluster_->mu_) = -1e18;
+  uint32_t rng_state_ GFS_GUARDED_BY(cluster_->mu_);
+
+  friend class Cluster;
+};
+
+// -- Cluster members that need a complete Node --------------------------------
+
+Cluster::Cluster(const Config& cfg) : cfg_(cfg) {
+  nodes_.reserve(cfg.n);
+  for (int i = 0; i < cfg.n; ++i) {
+    nodes_.emplace_back(new Node(this, i, cfg.base_port + i));
+    addr_to_idx_[nodes_.back()->addr()] = i;
+  }
+}
+
+Cluster::~Cluster() { Stop(); }
+
+void Cluster::RecordDetection(int observer, const std::string& subject_addr) {
+  auto it = addr_to_idx_.find(subject_addr);
+  if (it == addr_to_idx_.end()) return;
+  Node* subject = nodes_[it->second].get();
+  subject->AssertLockHeld();
+  int fp = subject->alive() ? 1 : 0;
+  events_.push_back(DetectionEvent{round_, observer, it->second, fp});
+  det_total_ += 1;
+  fp_total_ += fp;
+  // the one emission point every failure declaration funnels through —
+  // the suspicion path after the (lh-stretched) window expires, and the
+  // direct stale confirm when suspicion is disarmed (t_suspect == 0)
+  // @gfs:transition SUSPECT->FAILED guard=confirm_window
+  // @gfs:transition MEMBER->FAILED guard=stale
+  ObsEmit("confirm", observer, it->second,
+          fp ? "false_positive=1" : "false_positive=0");
+}
 
 // ---------------------------------------------------------------------------
 // Node
@@ -391,12 +446,17 @@ void Node::HandleDatagram(const std::string& payload) {
   if (!alive_) return;
   double now = MonotonicNow();
   if (auto ctrl = DecodeControl(payload)) {
+    // @gfs:verb JOIN
     if (ctrl->verb == "JOIN") {
       AddMember(ctrl->arg, now);
+      // @gfs:verb LEAVE
+      // @gfs:verb REMOVE
     } else if (ctrl->verb == "LEAVE" || ctrl->verb == "REMOVE") {
       RemoveMember(ctrl->arg, now);
+      // @gfs:verb SUSPECT
     } else if (ctrl->verb == "SUSPECT") {
       OnSuspect(ctrl->arg, now);
+      // @gfs:verb REFUTE
     } else if (ctrl->verb == "REFUTE") {
       OnRefute(ctrl->arg, now);
     }
@@ -426,6 +486,7 @@ void Node::OnSuspect(const std::string& addr, double now) {
     // k*(N-1) copies land here).
     auto me = members_.find(addr_);
     if (me == members_.end()) return;
+    // @gfs:rate_limit refute_broadcast
     if (now - last_refute_t_ < cfg.period) return;
     last_refute_t_ = now;
     me->second.hb += 1;
@@ -460,6 +521,7 @@ void Node::OnRefute(const std::string& arg, double now) {
   it->second.ts = now;
   if (suspects_.erase(addr)) {
     sus_refutations_ += 1;
+    // @gfs:transition SUSPECT->MEMBER guard=refute_evidence
     cluster_->ObsEmit("refute", idx_, addr, "");
   }
 }
@@ -467,6 +529,7 @@ void Node::OnRefute(const std::string& arg, double now) {
 void Node::AddMember(const std::string& addr, double now) {
   // introducer path: append at hb=0, push the full list to every member
   // (addNewMember, slave.go:250-274)
+  // @gfs:transition UNKNOWN->MEMBER guard=join_or_merge_add
   if (members_.find(addr) == members_.end()) members_[addr] = Member{0, now};
   std::string msg = EncodeSelf();
   for (const auto& [peer, m] : members_)
@@ -481,6 +544,7 @@ void Node::RemoveMember(const std::string& addr, double now) {
     // (removeMember appends the live struct, slave.go:276-286);
     // fresh_cooldown stamps removal time for a real suppression window
     fail_list_[addr] = cluster_->cfg().fresh_cooldown ? now : it->second.ts;
+    // @gfs:transition MEMBER->FAILED guard=leave_or_remove
     cluster_->ObsEmit("remove", idx_, addr, "");
   }
   members_.erase(it);
@@ -501,9 +565,11 @@ void Node::Merge(const std::vector<MemberEntry>& remote, double now) {
           // refute-by-advance: a fresher counter observed while SUSPECT
           // cancels the pending failure (runtime.py::refute)
           sus_refutations_ += 1;
+          // @gfs:transition SUSPECT->MEMBER guard=refute_evidence
           cluster_->ObsEmit("refute", idx_, entry.addr, "");
         }
       }
+      // @gfs:transition UNKNOWN->MEMBER guard=join_or_merge_add
     } else if (fail_list_.find(entry.addr) == fail_list_.end()) {
       members_[entry.addr] = Member{entry.hb, now};
     }
@@ -579,8 +645,10 @@ void Node::Tick(double now) {
     failed.push_back(addr);
   }
   for (const auto& addr : newly_suspect) {
+    // @gfs:transition MEMBER->SUSPECT guard=stale
     cluster_->ObsEmit("suspect", idx_, addr, "");
     std::string msg = EncodeControl(addr, "SUSPECT");
+    // @gfs:dissemination new_suspect profile=campaign bound=subject+fanout
     if (cfg.push_random) {
       // campaign profile: bounded dissemination — the SUBJECT always
       // hears (its active incarnation-bump refute is the point) plus
@@ -605,6 +673,7 @@ void Node::Tick(double now) {
     } else {
       // ring mode: the asyncio engine's wire behavior verbatim (the
       // small-n udp-parity lane compares event sequences)
+      // @gfs:dissemination new_suspect profile=reference bound=all_peers
       for (const auto& [peer, m] : members_)
         if (peer != addr_) Send(peer, msg);
     }
@@ -621,6 +690,7 @@ void Node::Tick(double now) {
     }
   }
   // fail-list cooldown expiry (slave.go:484-497)
+  // @gfs:transition FAILED->UNKNOWN guard=cooldown_expiry
   double t_cool = cfg.t_cooldown * cfg.period;
   for (auto it = fail_list_.begin(); it != fail_list_.end();) {
     if (it->second < now - t_cool)
@@ -688,7 +758,6 @@ bool Cluster::Start() {
   if (epoll_fd_ < 0) return false;
   for (auto& node : nodes_) {
     if (!node->Open()) return false;
-    node->ResetState();
     epoll_event ev{};
     ev.events = EPOLLIN;
     ev.data.u32 = static_cast<uint32_t>(node->idx());
@@ -696,11 +765,17 @@ bool Cluster::Start() {
   }
   // everyone joins through the introducer (slave.go:288-308)
   {
-    std::lock_guard<std::mutex> lk(mu_);
+    MutexLock lk(mu_);
     Node* intro = nodes_[cfg_.introducer].get();
-    for (auto& node : nodes_)
+    for (auto& node : nodes_) {
+      node->AssertLockHeld();
+      node->ResetState();
+    }
+    for (auto& node : nodes_) {
+      node->AssertLockHeld();
       if (node->idx() != cfg_.introducer)
         node->Send(intro->addr(), EncodeControl(node->addr(), "JOIN"));
+    }
     next_tick_ = MonotonicNow() + cfg_.period;
   }
   running_ = true;
@@ -712,14 +787,20 @@ bool Cluster::Start() {
 
 void Cluster::LoopBody() {
   epoll_event events[64];
+  double deadline;
+  {
+    MutexLock lk(mu_);
+    deadline = next_tick_;
+  }
   double now = MonotonicNow();
-  double wait_s = next_tick_ - now;
+  double wait_s = deadline - now;
   int timeout_ms = wait_s > 0 ? static_cast<int>(wait_s * 1000) + 1 : 0;
   int nfds = ::epoll_wait(epoll_fd_, events, 64, std::min(timeout_ms, 50));
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   char buf[65536];
   for (int e = 0; e < nfds; ++e) {
     Node* node = nodes_[events[e].data.u32].get();
+    node->AssertLockHeld();
     while (true) {
       ssize_t len = ::recv(node->fd(), buf, sizeof(buf), 0);
       if (len <= 0) break;
@@ -729,7 +810,10 @@ void Cluster::LoopBody() {
   now = MonotonicNow();
   if (now >= next_tick_) {
     double t0 = MonotonicNow();
-    for (auto& node : nodes_) node->Tick(now);
+    for (auto& node : nodes_) {
+      node->AssertLockHeld();
+      node->Tick(now);
+    }
     double tick_ms = (MonotonicNow() - t0) * 1000.0;
     if (obs_enabled_) EmitRoundTick(tick_ms);
     round_ += 1;
@@ -753,6 +837,7 @@ void Cluster::EmitRoundTick(double tick_ms) {
   long long members_listed = 0;
   long long sus_entered = 0, sus_refut = 0, sus_now = 0;
   for (const auto& node : nodes_) {
+    node->AssertLockHeld();
     if (node->alive()) {
       n_alive += 1;
       members_listed += static_cast<long long>(node->members_.size());
@@ -791,40 +876,47 @@ void Cluster::Stop() {
 }
 
 void Cluster::Crash(int i) {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
+  nodes_[i]->AssertLockHeld();
   nodes_[i]->StopCrash();
   // ground truth stamped at the injection seam: a dead process bumps
   // nothing, so the hb_freeze rides along (the tensor decode's pairing)
+  // @gfs:inject crash
   ObsEmit("crash", -1, i, "scheduled=1");
+  // @gfs:inject hb_freeze
   ObsEmit("hb_freeze", -1, i, "");
 }
 
 void Cluster::Leave(int i) {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
+  nodes_[i]->AssertLockHeld();
   nodes_[i]->StopGraceful();
+  // @gfs:inject leave
   ObsEmit("leave", -1, i, "");
 }
 
 void Cluster::Join(int i) {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   Node* node = nodes_[i].get();
+  node->AssertLockHeld();
   if (!node->alive()) node->ResetState();
   // JOIN to the introducer; lost if the introducer is down (SPOF kept,
   // slave.go:22)
   node->Send(nodes_[cfg_.introducer]->addr(),
              EncodeControl(node->addr(), "JOIN"));
+  // @gfs:inject join
   ObsEmit("join", -1, i, "");
 }
 
 void Cluster::Advance(int rounds) {
   int target;
   {
-    std::lock_guard<std::mutex> lk(mu_);
+    MutexLock lk(mu_);
     target = round_ + rounds;
   }
   while (running_) {
     {
-      std::lock_guard<std::mutex> lk(mu_);
+      MutexLock lk(mu_);
       if (round_ >= target) return;
     }
     std::this_thread::sleep_for(
@@ -833,8 +925,9 @@ void Cluster::Advance(int rounds) {
 }
 
 int Cluster::Membership(int observer, int* out, int cap) {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   std::vector<int> ids;
+  nodes_[observer]->AssertLockHeld();
   for (const auto& addr : nodes_[observer]->MemberAddrs()) {
     int idx = IdxOf(addr);
     if (idx >= 0) ids.push_back(idx);
@@ -846,15 +939,17 @@ int Cluster::Membership(int observer, int* out, int cap) {
 }
 
 int Cluster::AliveNodes(int* out, int cap) {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   int count = 0;
-  for (const auto& node : nodes_)
+  for (const auto& node : nodes_) {
+    node->AssertLockHeld();
     if (node->alive() && count < cap) out[count++] = node->idx();
+  }
   return count;
 }
 
 int Cluster::DrainEvents(int* out, int cap) {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   int n = std::min(static_cast<int>(events_.size()), cap / 4);
   for (int i = 0; i < n; ++i) {
     out[i * 4 + 0] = events_[i].round;
@@ -870,7 +965,7 @@ int Cluster::DrainEvents(int* out, int cap) {
 // round-16 control/observation surface
 
 int Cluster::Configure(const std::string& kv) {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   if (running_) return -1;  // protocol knobs are fixed once the loop runs
   std::istringstream in(kv);
   std::string tok;
@@ -928,7 +1023,7 @@ void Cluster::ObsEmit(const char* kind, int observer,
 }
 
 int Cluster::ObsEnable() {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   obs_enabled_ = true;
   // rebase the stamped round clock to 0 and zero the per-round deltas:
   // the recorded stream lives in the arming-relative frame the udp
@@ -939,6 +1034,7 @@ int Cluster::ObsEnable() {
   obs_sends0_ = sends_total_;
   long long e = 0, r = 0;
   for (const auto& node : nodes_) {
+    node->AssertLockHeld();
     e += node->sus_entered_;
     r += node->sus_refutations_;
   }
@@ -948,7 +1044,7 @@ int Cluster::ObsEnable() {
 }
 
 int Cluster::ObsDrain(char* out, int cap) {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   if (obs_buf_.empty() || cap <= 1) return 0;
   size_t take = obs_buf_.size();
   if (take > static_cast<size_t>(cap - 1)) {
@@ -964,10 +1060,11 @@ int Cluster::ObsDrain(char* out, int cap) {
 }
 
 std::string Cluster::VitalsText() {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   int n_alive = 0;
   long long sus_now = 0, entered = 0, refut = 0, confirms = 0;
   for (const auto& node : nodes_) {
+    node->AssertLockHeld();
     if (node->alive()) {
       n_alive += 1;
       sus_now += static_cast<long long>(node->suspects_.size());
@@ -1050,7 +1147,7 @@ int Cluster::ScenarioLoad(const std::string& table, int round0) {
       return -1;
     }
   }
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   gates_ = std::move(g);
   gates_armed_ = true;
   scn_round0_ = round0;
@@ -1062,7 +1159,7 @@ int Cluster::ScenarioLoad(const std::string& table, int round0) {
 }
 
 void Cluster::ScenarioClear() {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   if (gates_armed_) ObsEmit("scenario_clear", -1, -1, "");
   gates_armed_ = false;
 }
@@ -1096,18 +1193,21 @@ bool Cluster::ScenarioDrops(int src, const std::string& dst_addr) const {
 }
 
 void Cluster::SeedFull() {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   double now = MonotonicNow();
   std::vector<std::string> addrs;
   addrs.reserve(nodes_.size());
   for (const auto& node : nodes_) addrs.push_back(node->addr());
-  for (auto& node : nodes_)
+  for (auto& node : nodes_) {
+    node->AssertLockHeld();
     if (node->alive()) node->SeedMembers(addrs, now);
+  }
 }
 
 int Cluster::Warm() {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   for (const auto& node : nodes_) {
+    node->AssertLockHeld();
     if (!node->alive()) continue;
     // full view with every counter past the hb<=1 grace — and NO churn
     // residue: a pending suspicion means some entry is already past

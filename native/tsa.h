@@ -1,0 +1,72 @@
+// Clang Thread Safety Analysis surface for the native engine
+// (`make tsa` = clang++ -Wthread-safety -Werror): the compile-time
+// counterpart of the tsan/asan evidence lanes.  Under any non-clang
+// compiler every macro expands empty and Mutex/MutexLock degrade to a
+// plain std::mutex + lock_guard, so the g++ production build is
+// byte-for-byte unaffected.
+//
+// The engine's locking discipline the analysis enforces:
+//   - ONE capability, Cluster::mu_, guards all protocol state — every
+//     Node field the epoll thread and the C-ABI control verbs both
+//     touch is GFS_GUARDED_BY(cluster_->mu_), every Node method that
+//     touches them is GFS_REQUIRES(cluster_->mu_).
+//   - TSA compares capability expressions syntactically after
+//     this-substitution, so at a Cluster call site `node->Tick()` the
+//     requirement reads `node->cluster_->mu_` — an alias of the held
+//     `this->mu_` the analysis cannot prove.  Node::AssertLockHeld()
+//     (a GFS_ASSERT_CAPABILITY no-op) is called once per node at every
+//     Cluster -> Node crossing to state exactly that aliasing fact;
+//     it asserts, never acquires, so a crossing OUTSIDE the lock still
+//     fails the analysis at the first guarded access.
+
+#ifndef GOSSIPFS_NATIVE_TSA_H_
+#define GOSSIPFS_NATIVE_TSA_H_
+
+#include <mutex>
+
+#if defined(__clang__)
+#define GFS_THREAD_ANNOTATION(x) __attribute__((x))
+#else
+#define GFS_THREAD_ANNOTATION(x)
+#endif
+
+#define GFS_CAPABILITY(x) GFS_THREAD_ANNOTATION(capability(x))
+#define GFS_SCOPED_CAPABILITY GFS_THREAD_ANNOTATION(scoped_lockable)
+#define GFS_GUARDED_BY(x) GFS_THREAD_ANNOTATION(guarded_by(x))
+#define GFS_REQUIRES(...) \
+  GFS_THREAD_ANNOTATION(requires_capability(__VA_ARGS__))
+#define GFS_ACQUIRE(...) \
+  GFS_THREAD_ANNOTATION(acquire_capability(__VA_ARGS__))
+#define GFS_RELEASE(...) \
+  GFS_THREAD_ANNOTATION(release_capability(__VA_ARGS__))
+#define GFS_ASSERT_CAPABILITY(x) GFS_THREAD_ANNOTATION(assert_capability(x))
+#define GFS_NO_TSA GFS_THREAD_ANNOTATION(no_thread_safety_analysis)
+
+namespace gossipfs {
+
+// std::mutex carries no TSA annotations under libstdc++, so the engine
+// locks through this annotated wrapper instead.
+class GFS_CAPABILITY("mutex") Mutex {
+ public:
+  void lock() GFS_ACQUIRE() { mu_.lock(); }
+  void unlock() GFS_RELEASE() { mu_.unlock(); }
+
+ private:
+  std::mutex mu_;
+};
+
+// Scoped holder (the lock_guard shape the engine already used).
+class GFS_SCOPED_CAPABILITY MutexLock {
+ public:
+  explicit MutexLock(Mutex& mu) GFS_ACQUIRE(mu) : mu_(mu) { mu_.lock(); }
+  ~MutexLock() GFS_RELEASE() { mu_.unlock(); }
+  MutexLock(const MutexLock&) = delete;
+  MutexLock& operator=(const MutexLock&) = delete;
+
+ private:
+  Mutex& mu_;
+};
+
+}  // namespace gossipfs
+
+#endif  // GOSSIPFS_NATIVE_TSA_H_

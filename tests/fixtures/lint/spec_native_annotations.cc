@@ -1,0 +1,11 @@
+// Seeded drift for spec-native-annotations (mounted over
+// native/engine.cc): an annotation matching no contract row, a
+// lifecycle emission with no dominating annotation, and a native
+// surface missing most of the contract's required annotations.
+
+// @gfs:transition FAILED->MEMBER guard=zombie_resurrection
+void Node::Tick(double now) {
+  for (const auto& addr : newly_suspect) {
+    cluster_->ObsEmit("suspect", idx_, addr, "");
+  }
+}

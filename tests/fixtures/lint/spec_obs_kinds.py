@@ -1,0 +1,26 @@
+"""Seeded drift for spec-obs-kind-coverage (mounted over
+gossipfs_tpu/obs/schema.py): LIFECYCLE_KINDS dropped `refute` and grew
+a `resurrect` kind no contract transition emits."""
+
+EVENT_KINDS = {
+    "crash": "ground truth: process death injected",
+    "hb_freeze": "ground truth: heartbeat counter frozen",
+    "leave": "ground truth: graceful departure injected",
+    "join": "ground truth: (re)join injected",
+    "suspect": "observer entered a suspicion window for subject",
+    "refute": "pending suspicion cancelled by evidence of life",
+    "confirm": "observer declared subject failed",
+    "remove": "observer dropped subject from its membership list",
+    "resurrect": "DRIFT: a lifecycle kind with no contract row",
+}
+
+LIFECYCLE_KINDS = (
+    "crash",
+    "hb_freeze",
+    "leave",
+    "join",
+    "suspect",
+    "confirm",
+    "remove",
+    "resurrect",
+)

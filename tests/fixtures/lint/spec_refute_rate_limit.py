@@ -1,0 +1,28 @@
+"""Seeded drift for spec-refute-rate-limit: the once-per-period REFUTE
+rate limit dropped from _on_suspect — every received SUSPECT copy now
+triggers a full broadcast, amplifying one episode to O(k x N) datagrams
+(mounted over gossipfs_tpu/detector/udp.py)."""
+
+CMD_SEP = "<CMD>"
+FIELD_SEP = "<#INFO#>"
+
+
+class UdpNode:
+    def _on_suspect(self, addr):
+        now = self._now()
+        if addr == self.addr:
+            me = self.members.get(self.addr)
+            if me is None:
+                return
+            # DRIFT: no compare against self._last_refute_t, no stamp —
+            # the incarnation bump + broadcast runs per received copy
+            me.hb += 1
+            me.ts = now
+            msg = f"{self.addr}{FIELD_SEP}{me.hb}{CMD_SEP}REFUTE"
+            for peer in list(self.members):
+                if peer != self.addr:
+                    self._send(peer, msg)
+        elif addr in self.members:
+            rt = self._suspicion()
+            if rt is not None:
+                rt.adopt(addr, now)

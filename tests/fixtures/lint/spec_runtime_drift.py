@@ -1,0 +1,41 @@
+"""Seeded drift for spec-runtime-protocol: SuspicionRuntime lost the
+`refute` lifecycle verb (the SUSPECT->MEMBER contract edge) and its
+degraded() formula no longer references lh_frac (mounted over
+gossipfs_tpu/suspicion/runtime.py)."""
+
+
+class SuspicionRuntime:
+    def __init__(self, params):
+        self.params = params
+        self.pending = {}
+
+    def suspect(self, addr, now):
+        if addr in self.pending:
+            return False
+        self.pending[addr] = now
+        return True
+
+    def adopt(self, addr, now):
+        self.pending.setdefault(addr, now)
+
+    def expired(self, addr, now, window):
+        t0 = self.pending.get(addr)
+        return t0 is not None and now - t0 > window
+
+    # DRIFT: no refute() — refuting evidence can no longer cancel a
+    # pending failure through the runtime
+
+    def confirm(self, addr):
+        self.pending.pop(addr, None)
+
+    def drop(self, addr):
+        self.pending.pop(addr, None)
+
+    def degraded(self, n_listed):
+        # DRIFT: hardwired threshold instead of the lh_frac formula
+        return len(self.pending) > 4
+
+    def t_suspect_window(self, unit, n_listed):
+        mult = 1 + (self.params.lh_multiplier
+                    if self.degraded(n_listed) else 0)
+        return self.params.t_suspect * mult * unit

@@ -1,0 +1,19 @@
+"""Seeded drift for scan-carry-arity (mounted over
+gossipfs_tpu/parallel/mesh.py): the MetricsCarry out_spec lost a field
+— three shard specs against core.rounds' four NamedTuple slots, so
+every spec after the dropped one binds to the wrong carry field."""
+
+from jax.sharding import PartitionSpec as P
+
+from gossipfs_tpu.core import rounds
+
+AXIS = "nodes"
+
+
+def _out_specs():
+    rep = P()
+    return (
+        # DRIFT: first_suspect's spec dropped — 3 specs, 4 fields
+        rounds.MetricsCarry(P(AXIS), P(AXIS), P(AXIS)),
+        rounds.RoundMetrics(rep, rep, rep, rep, rep, rep),
+    )

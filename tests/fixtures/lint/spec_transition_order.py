@@ -1,0 +1,22 @@
+"""Seeded drift for spec-transition-order: the SUSPECT status write
+hoisted ABOVE the confirm-mask computation, so a same-round entry can
+satisfy the confirm compare and skip its suspect window entirely
+(mounted over gossipfs_tpu/core/rounds.py)."""
+
+import jax.numpy as jnp
+
+SUSPECT = 2
+FAILED = 3
+
+
+def _tick(status, age, stale, suspect_new, degraded, config, sus):
+    confirm_age = (
+        config.t_fail
+        + sus.t_suspect * (1 + jnp.where(degraded, sus.lh_multiplier, 0))
+    )
+    # DRIFT: SUSPECT written FIRST — the mask below sees post-write
+    # status, collapsing the MEMBER->SUSPECT->FAILED two-round floor
+    status = jnp.where(suspect_new, SUSPECT, status)
+    confirm = (status == SUSPECT) & (age > confirm_age)
+    status = jnp.where(confirm, FAILED, status)
+    return status

@@ -1,0 +1,25 @@
+"""Seeded drift for spec-dissemination: the new-suspicion SUSPECT push
+widened back to an unconditional all-peers broadcast — the exact
+ENTRY-broadcast asymmetry this rule flagged at head (mounted over
+gossipfs_tpu/detector/udp.py)."""
+
+CMD_SEP = "<CMD>"
+
+
+class UdpNode:
+    def tick(self, now):
+        c = self.cluster
+        rt = self._suspicion()
+        for addr in list(self.members):
+            if addr == self.addr:
+                continue
+            if rt is not None:
+                if rt.suspect(addr, now):
+                    self._obs("suspect", addr)
+                    msg = f"{addr}{CMD_SEP}SUSPECT"
+                    # DRIFT: no campaign-profile gate — every new
+                    # suspicion goes to every peer regardless of c.push
+                    for peer in list(self.members):
+                        if peer != self.addr:
+                            self._send(peer, msg)
+                    continue

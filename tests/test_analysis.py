@@ -141,7 +141,8 @@ def test_cli_usage_errors_exit_two():
 
 def test_native_makefile_has_sanitizer_targets():
     mk = (REPO / "native" / "Makefile").read_text()
-    for target in ("tsan:", "asan:", "lint-native:"):
+    for target in ("tsan:", "asan:", "lint-native:", "tsa:"):
         assert target in mk, f"native/Makefile lost the {target} target"
     assert (REPO / "native" / ".clang-tidy").is_file()
     assert (REPO / "native" / "sanitize_main.cc").is_file()
+    assert (REPO / "native" / "tsa.h").is_file()

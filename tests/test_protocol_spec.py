@@ -1,0 +1,116 @@
+"""gossipfs-spec completeness (gossipfs_tpu/analysis/protocol_spec.py).
+
+The contract is itself held to the repo's surfaces, pure-AST where the
+surface is a source file — no jax, no runtime:
+
+  * every lifecycle kind in obs/schema.py LIFECYCLE_KINDS maps to a
+    contract transition/injection emit and vice versa, so a new
+    protocol state cannot ship without a contract row;
+  * every contract emit is a declared EVENT_KINDS entry;
+  * every transition references declared states, a THRESHOLDS guard
+    formula, and a subset of the declared engines;
+  * the wire-verb vocabulary equals the verbs the udp dispatch
+    actually compares against (the socket wire's source of truth);
+  * the drift-prone campaign dissemination row stays subject+fanout —
+    the bound the round-17 satellite fix implements in both socket
+    engines.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+
+from gossipfs_tpu.analysis import protocol_spec as spec
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _module_literal(path: str, name: str):
+    tree = ast.parse((REPO / path).read_text())
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets
+                       if isinstance(t, ast.Name)]
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name):
+            targets, value = [node.target.id], node.value
+        else:
+            continue
+        if name in targets and value is not None:
+            return ast.literal_eval(value)
+    raise AssertionError(f"{path} has no module-level literal {name}")
+
+
+def test_lifecycle_kinds_bijection():
+    lifecycle = _module_literal("gossipfs_tpu/obs/schema.py",
+                                "LIFECYCLE_KINDS")
+    assert spec.lifecycle_emit_kinds() == set(lifecycle), (
+        "obs/schema.py LIFECYCLE_KINDS and the contract's emit kinds "
+        "must be the same set — add the protocol_spec row (or the "
+        "schema kind) before shipping the other"
+    )
+
+
+def test_every_emit_is_a_declared_event_kind():
+    kinds = _module_literal("gossipfs_tpu/obs/schema.py", "EVENT_KINDS")
+    assert spec.lifecycle_emit_kinds() <= set(kinds)
+
+
+def test_transitions_reference_declared_states_guards_engines():
+    assert spec.TRANSITIONS, "the contract lost its transition table"
+    for t in spec.TRANSITIONS:
+        assert t.src in spec.STATES, t
+        assert t.dst in spec.STATES, t
+        assert t.guard in spec.THRESHOLDS, (
+            f"transition {t.src}->{t.dst} guard `{t.guard}` has no "
+            "THRESHOLDS formula"
+        )
+        assert set(t.engines) <= set(spec.ENGINES), t
+    for i in spec.INJECTIONS:
+        assert i.emits, i
+    for r in spec.RATE_LIMITS:
+        assert set(r.engines) <= set(spec.ENGINES), r
+    for d in spec.DISSEMINATION:
+        assert set(d.engines) <= set(spec.ENGINES), d
+
+
+def test_wire_verbs_match_udp_dispatch():
+    tree = ast.parse(
+        (REPO / "gossipfs_tpu/detector/udp.py").read_text())
+    handle = next(
+        n for n in ast.walk(tree)
+        if isinstance(n, ast.FunctionDef) and n.name == "handle")
+    compared: set[str] = set()
+    for node in ast.walk(handle):
+        if not isinstance(node, ast.Compare):
+            continue
+        for comp in node.comparators:
+            for sub in ast.walk(comp):
+                if isinstance(sub, ast.Constant) \
+                        and isinstance(sub.value, str) \
+                        and sub.value.isupper():
+                    compared.add(sub.value)
+    assert compared == set(spec.WIRE_VERBS), (
+        "the udp receive dispatch and the contract's WIRE_VERBS "
+        f"disagree: dispatch={sorted(compared)} "
+        f"contract={sorted(spec.WIRE_VERBS)}"
+    )
+
+
+def test_campaign_dissemination_row_stays_bounded():
+    row = spec.dissemination_row("new_suspect", "campaign")
+    assert row is not None
+    assert row.bound == "subject+fanout"
+    assert set(row.engines) == {"udp", "native"}
+    assert row.annotated, (
+        "the drift-prone row must require an explicit native "
+        "@gfs:dissemination annotation"
+    )
+
+
+def test_refute_rate_limit_covers_both_socket_engines():
+    limit = spec.rate_limit("refute_broadcast")
+    assert limit is not None
+    assert set(limit.engines) == {"udp", "native"}

@@ -14,7 +14,12 @@ Usage::
 
 Exit codes: 0 clean, 1 findings, 2 usage/internal error.  The rule
 registry lives in ``gossipfs_tpu/analysis/`` — see its module docstring
-and BASELINE.md's "Static analysis" section.
+and BASELINE.md's "Static analysis" section.  The spec-* rules diff all
+three engines against the machine-readable protocol contract
+(``gossipfs_tpu/analysis/protocol_spec.py``; BASELINE.md "Protocol
+contract"); ``make lint`` chains this CLI with the clang Thread Safety
+Analysis and clang-tidy legs, and ``tools/spec_verify.py`` re-proves
+every spec rule red (on its fixture) + green (on the repo).
 """
 
 from __future__ import annotations

@@ -1,0 +1,69 @@
+"""spec_clean: the gossipfs-spec analyzer verified both ways, one JSON line.
+
+Green half: ``tools/lint.py`` (every registered rule, the protocol-spec
+extractors included) must exit 0 on the repo itself.  Red half: each
+spec rule must exit NONZERO when its committed seeded-drift fixture is
+overlay-mounted at the rule's extraction point — a rule that cannot
+fire on its own fixture is a dead check, and a repo that fails clean
+has drifted from the contract.  The committed red→green evidence for
+the round-17 ENTRY-broadcast fix is SPEC_r17.json.
+
+    python tools/spec_verify.py          # one JSON object line, exit 0 iff ok
+
+Consumed by tools/verify_claims.py as the ``spec_clean`` claim.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gossipfs_tpu.analysis import REGISTRY  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join("tests", "fixtures", "lint")
+
+# The cross-language protocol-contract rules (rules_spec.py): the spec-
+# prefixed extractors plus the scan-carry seam rule that rides with them.
+SPEC_RULES = sorted(
+    name for name in REGISTRY
+    if name.startswith("spec-") or name == "scan-carry-arity"
+)
+
+
+def _lint(*args: str) -> int:
+    out = subprocess.run(
+        [sys.executable, os.path.join("tools", "lint.py"), *args],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    return out.returncode
+
+
+def main() -> int:
+    repo_clean = _lint() == 0
+    fixtures = []
+    for name in SPEC_RULES:
+        r = REGISTRY[name]
+        overlay = f"{r.fixture_at}={os.path.join(FIXTURES, r.fixture)}"
+        rc = _lint("--rule", name, "--overlay", overlay)
+        fixtures.append({"rule": name, "fixture": r.fixture,
+                         "mounted_at": r.fixture_at, "exit_code": rc,
+                         "fired": rc == 1})
+    red = sum(1 for f in fixtures if f["fired"])
+    ok = repo_clean and red == len(fixtures) and fixtures
+    print(json.dumps({
+        "claim": "spec_clean",
+        "repo_clean": repo_clean,
+        "fixtures_total": len(fixtures),
+        "fixtures_red": red,
+        "ok": bool(ok),
+        "fixtures": fixtures,
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

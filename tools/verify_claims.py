@@ -219,6 +219,14 @@ CLAIMS = {
     # (traffic/audit.py, the same replay tools/timeline.py attaches to
     # traffic streams) — and (c) the two accountings agreeing EXACTLY
     # (acked writes, files, repairs, losses).  CPU-pinned.
+    # round-17 protocol contract (SPEC_r17.json is the committed
+    # red→green evidence): gossipfs-lint — the protocol-spec extractors
+    # included — exits 0 on the repo, and every spec rule exits nonzero
+    # on its committed seeded-drift fixture (tools/spec_verify.py).
+    # Pure static analysis; no accelerator, ~30 s.
+    "spec_clean": (
+        [sys.executable, "tools/spec_verify.py"],
+        lambda d: 1.0 if d["ok"] else 0.0, 1.0, 0.0),
     "traffic_durability": (
         ["env", "JAX_PLATFORMS=cpu", sys.executable, "-m",
          "gossipfs_tpu.bench.traffic_bench", "--partition-race",
