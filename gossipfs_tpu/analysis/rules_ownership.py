@@ -74,6 +74,55 @@ def check_quorum(index: RepoIndex) -> list[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# stripe quorum arithmetic — owner: gossipfs_tpu/sdfs/quorum.py
+# ---------------------------------------------------------------------------
+
+_STRIPE_NAMES = {"k", "m", "stripe_k", "stripe_m", "STRIPE_K", "STRIPE_M"}
+
+
+def _is_stripe_threshold(node: ast.AST) -> bool:
+    """``k + m - slack`` used as a COMPARISON bound — the stripe
+    write-quorum shape (``acks >= k + m - f``).  ``k + m`` alone (a
+    stripe width, a fragment count, a loop bound) is legal everywhere;
+    only subtracting slack from the width *inside a comparison*
+    re-derives the erasure threshold math."""
+    if not isinstance(node, ast.Compare):
+        return False
+    for comp in [node.left, *node.comparators]:
+        if isinstance(comp, ast.BinOp) and isinstance(comp.op, ast.Sub) \
+                and isinstance(comp.left, ast.BinOp) \
+                and isinstance(comp.left.op, ast.Add) \
+                and len(names_in(comp.left) & _STRIPE_NAMES) >= 2:
+            return True
+    return False
+
+
+@rule(
+    "stripe-quorum-ownership",
+    "the stripe threshold shape (acks >= k + m - slack, k-of-(k+m) "
+    "bounds) may appear only in sdfs/quorum.py; erasure/traffic/bench "
+    "import stripe_read_quorum/stripe_write_quorum",
+    fixture="stripe_quorum_ownership.py",
+    fixture_at="gossipfs_tpu/erasure/_lint_fixture.py",
+)
+def check_stripe_quorum(index: RepoIndex) -> list[Finding]:
+    out = []
+    for rel in index.py_files():
+        if rel == _QUORUM_OWNER:
+            continue
+        for node in ast.walk(index.tree(rel)):
+            if _is_stripe_threshold(node):
+                out.append(Finding(
+                    "stripe-quorum-ownership", rel, node.lineno,
+                    "stripe threshold arithmetic (k + m - slack in a "
+                    "comparison) re-derived here — import "
+                    "stripe_read_quorum/stripe_write_quorum from "
+                    "gossipfs_tpu.sdfs.quorum",
+                ))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # exponential backoff — owner: gossipfs_tpu/shim/retry.py
 # ---------------------------------------------------------------------------
 

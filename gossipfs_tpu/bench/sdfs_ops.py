@@ -39,9 +39,13 @@ import sys
 import time
 
 from gossipfs_tpu.sdfs.cluster import SDFSCluster
+from gossipfs_tpu.sdfs.types import STRIPE_K, STRIPE_M
 
 DEFAULT_SIZES = (65_536, 1_048_576, 4_194_304)  # 64 KB, 1 MB, 4 MB
 CLUSTERS = (4, 8)                               # the report's two settings
+# stripe mode needs n >= k+m ack-able holders, so its two settings scale
+# up while keeping the same 2x cluster-size contrast
+STRIPE_CLUSTERS = (8, 16)
 REPS = 7
 
 
@@ -60,21 +64,28 @@ def _time(fn) -> float:
     return dt
 
 
-def run(sizes=DEFAULT_SIZES, clusters=CLUSTERS, reps=REPS,
-        trace: str | None = None) -> dict:
+def run(sizes=DEFAULT_SIZES, clusters=None, reps=REPS,
+        trace: str | None = None, redundancy: str = "replica",
+        stripe_k: int = STRIPE_K, stripe_m: int = STRIPE_M) -> dict:
     # Reps interleave across cluster sizes (and rep 0 is a discarded
     # warmup) so host-load drift perturbs the 4- and 8-node measurements
     # equally; best-of-reps is the noise-robust latency estimator.  The
     # sequential-medians version was flaky under concurrent load.
+    if clusters is None:
+        clusters = STRIPE_CLUSTERS if redundancy == "stripe" else CLUSTERS
     recorder = None
     if trace is not None:
         from gossipfs_tpu.obs.recorder import FlightRecorder
 
         recorder = FlightRecorder(
             trace, source="sdfs_ops", sizes=list(sizes),
-            clusters=list(clusters), reps=reps,
+            clusters=list(clusters), reps=reps, redundancy=redundancy,
         )
-    built = {n_nodes: SDFSCluster(n_nodes, seed=7) for n_nodes in clusters}
+    built = {
+        n_nodes: SDFSCluster(n_nodes, seed=7, redundancy=redundancy,
+                             stripe_k=stripe_k, stripe_m=stripe_m)
+        for n_nodes in clusters
+    }
     samples: dict[tuple[int, int], dict[str, list[float]]] = {
         (n_nodes, size): {"insert": [], "update": [], "read": []}
         for n_nodes in built
@@ -115,6 +126,10 @@ def run(sizes=DEFAULT_SIZES, clusters=CLUSTERS, reps=REPS,
         {
             "nodes": n_nodes,
             "size_bytes": size,
+            # self-describing redundancy (stripe rows carry their shape)
+            "redundancy": redundancy,
+            **({"stripe_k": stripe_k, "stripe_m": stripe_m}
+               if redundancy == "stripe" else {}),
             "insert_ms": round(min(cell["insert"]) * 1e3, 4),
             "update_ms": round(min(cell["update"]) * 1e3, 4),
             "read_ms": round(min(cell["read"]) * 1e3, 4),
@@ -140,15 +155,18 @@ def run(sizes=DEFAULT_SIZES, clusters=CLUSTERS, reps=REPS,
             > med("insert_ms", lambda r: r["size_bytes"] == small)
         ),
         # 3: replica count, not cluster size, governs latency (<= 2x gap
-        # between 4- and 8-node clusters at the largest size)
+        # between the small and 2x-larger clusters at the largest size)
         "cluster_size_insignificant": (
             0.5
             < (
-                med("insert_ms", lambda r: r["nodes"] == 4 and r["size_bytes"] == big)
+                med("insert_ms",
+                    lambda r: r["nodes"] == min(clusters)
+                    and r["size_bytes"] == big)
                 / max(
                     med(
                         "insert_ms",
-                        lambda r: r["nodes"] == 8 and r["size_bytes"] == big,
+                        lambda r: r["nodes"] == max(clusters)
+                        and r["size_bytes"] == big,
                     ),
                     1e-9,
                 )
@@ -156,7 +174,8 @@ def run(sizes=DEFAULT_SIZES, clusters=CLUSTERS, reps=REPS,
             < 2.0
         ),
     }
-    return {"rows": rows, "reference_claims_reproduced": claims}
+    return {"rows": rows, "redundancy": redundancy,
+            "reference_claims_reproduced": claims}
 
 
 def main(argv=None) -> None:
@@ -166,9 +185,16 @@ def main(argv=None) -> None:
     p.add_argument("--trace", type=str, default=None, metavar="PATH",
                    help="flight-recorder client_op stream (self-describing "
                         "gossipfs-obs/v1 header; timeline.py-ingestable)")
+    p.add_argument("--redundancy", choices=("replica", "stripe"),
+                   default="replica",
+                   help="byte plane under test; stripe uses the 8/16-node "
+                        "settings (n must exceed k+m)")
+    p.add_argument("--stripe-k", type=int, default=STRIPE_K)
+    p.add_argument("--stripe-m", type=int, default=STRIPE_M)
     args = p.parse_args(argv)
     print(json.dumps(run(sizes=tuple(args.sizes), reps=args.reps,
-                         trace=args.trace)))
+                         trace=args.trace, redundancy=args.redundancy,
+                         stripe_k=args.stripe_k, stripe_m=args.stripe_m)))
 
 
 if __name__ == "__main__":

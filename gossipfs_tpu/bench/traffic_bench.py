@@ -23,23 +23,36 @@ Two lanes, one document:
         --out TRAFFIC_r12.json
     JAX_PLATFORMS=cpu python -m gossipfs_tpu.bench.traffic_bench \
         --partition-race --n 64 --trace /tmp/traffic.jsonl
+
+Round 18 adds the ERASURE lane (``--erasure-matrix`` — the
+ERASURE_r18.json artifact): the same four cosim scenarios in
+``redundancy="stripe"`` mode (k data + m parity Reed-Solomon fragments,
+gossipfs_tpu/erasure/) plus a replica-mode repair-storm twin at the
+SAME failure schedule, so the document carries the measured
+stripe-vs-replica repair-bandwidth ratio next to the durability
+verdicts.  Every cosim row is redundancy-self-describing.
 """
 
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import sys
 import time
 
+from gossipfs_tpu.sdfs.types import STRIPE_K, STRIPE_M
 from gossipfs_tpu.traffic.workload import WorkloadSpec
 
 
-def default_spec(rate: float = 8.0, n_keys: int = 96,
-                 seed: int = 0) -> WorkloadSpec:
+def default_spec(rate: float = 8.0, n_keys: int = 96, seed: int = 0,
+                 redundancy: str = "replica", stripe_k: int = STRIPE_K,
+                 stripe_m: int = STRIPE_M) -> WorkloadSpec:
     """The bench mix: 30% puts / 2% deletes / 68% gets, Zipf keys, the
     reference-shard size distribution with capped materialized bytes."""
-    return WorkloadSpec(rate=rate, n_keys=n_keys, seed=seed)
+    return WorkloadSpec(rate=rate, n_keys=n_keys, seed=seed,
+                        redundancy=redundancy, stripe_k=stripe_k,
+                        stripe_m=stripe_m)
 
 
 # ---------------------------------------------------------------------------
@@ -48,10 +61,13 @@ def default_spec(rate: float = 8.0, n_keys: int = 96,
 
 
 def cosim_lane(n: int, rounds: int, rate: float, seed: int,
-               trace: str | None = None, only: str | None = None) -> dict:
+               trace: str | None = None, only: str | None = None,
+               redundancy: str = "replica", stripe_k: int = STRIPE_K,
+               stripe_m: int = STRIPE_M) -> dict:
     from gossipfs_tpu.traffic import harness
 
-    spec = default_spec(rate=rate, seed=seed)
+    spec = default_spec(rate=rate, seed=seed, redundancy=redundancy,
+                        stripe_k=stripe_k, stripe_m=stripe_m)
     out: dict = {}
     # single-run flags write PATH itself; --all suffixes per run
     t = lambda name: (  # noqa: E731
@@ -69,7 +85,65 @@ def cosim_lane(n: int, rounds: int, rate: float, seed: int,
         out["repair_storm"] = harness.repair_storm(
             n, spec, files=max(96, n * 2), rack=(n // 4, max(4, n // 8)),
             repair_budget=8, seed=seed, trace=t("storm"))
+    for row in out.values():
+        # artifact rows self-describe their redundancy mode
+        row["redundancy"] = spec.redundancy
+        if spec.redundancy == "stripe":
+            row["stripe_k"], row["stripe_m"] = spec.stripe_k, spec.stripe_m
     return out
+
+
+def erasure_matrix(n: int, rounds: int, rate: float, seed: int,
+                   trace: str | None = None, stripe_k: int = STRIPE_K,
+                   stripe_m: int = STRIPE_M) -> dict:
+    """The ERASURE_r18 lane: the whole gray-failure scenario matrix in
+    stripe mode, plus a replica repair-storm twin at the SAME failure
+    schedule (same seed, same victim set — the master/introducer never
+    dies in these scenarios, so the schedules coincide exactly) for the
+    repair-bandwidth comparison."""
+    from gossipfs_tpu.traffic import harness
+
+    doc = cosim_lane(n, rounds, rate, seed, trace=trace,
+                     redundancy="stripe", stripe_k=stripe_k,
+                     stripe_m=stripe_m)
+    doc["redundancy"] = "stripe"
+    doc["stripe_k"], doc["stripe_m"] = stripe_k, stripe_m
+    rspec = default_spec(rate=rate, seed=seed)
+    twin = harness.repair_storm(
+        n, rspec, files=max(96, n * 2), rack=(n // 4, max(4, n // 8)),
+        repair_budget=8, seed=seed)
+    twin["redundancy"] = "replica"
+    doc["replica_storm_twin"] = twin
+    sb = doc["repair_storm"]["repair_bytes_written"]
+    sc = doc["repair_storm"]["repair_copies"]
+    rb = twin["repair_bytes_written"]
+    rc = twin["repair_copies"]
+    doc["repair_bandwidth"] = {
+        "stripe_bytes": sb,
+        "stripe_units": sc,
+        "replica_bytes": rb,
+        "replica_units": rc,
+        # bytes written per unit of lost redundancy repaired — the
+        # ~k-fold erasure saving (a lost fragment re-encodes ceil(S/k)
+        # row bytes where a lost replica re-copies all S) and what the
+        # verify_claims.py erasure_durability claim pins against 1/k
+        "per_unit_ratio": (round((sb / sc) / (rb / rc), 4)
+                           if sc and rc and rb else None),
+        # total traffic at the same failure schedule, reported honestly
+        # but NOT the 1/k claim: the (k+m)-wide stripe exposes more
+        # units to the same rack kill than R=4 replicas, so totals
+        # scale by (k+m)/(R*k) — 0.375 at (4,2) vs the reference's R=4
+        "total_ratio": round(sb / rb, 4) if rb else None,
+        "bound_1_over_k": round(1.0 / stripe_k, 4),
+    }
+    scenarios = ("steady", "churn", "partition_race", "repair_storm")
+    doc["losses_total"] = sum(
+        doc[s]["durability"]["harness"]["lost"] for s in scenarios)
+    doc["matches_all"] = all(
+        doc[s]["durability"]["match"]
+        and doc[s]["durability"]["monitor"]["match_events"]
+        for s in scenarios)
+    return doc
 
 
 # ---------------------------------------------------------------------------
@@ -192,6 +266,16 @@ def main(argv=None) -> None:
     p.add_argument("--churn", action="store_true")
     p.add_argument("--partition-race", action="store_true")
     p.add_argument("--repair-storm", action="store_true")
+    p.add_argument("--redundancy", choices=("replica", "stripe"),
+                   default="replica",
+                   help="cosim-lane byte plane: 4 full replicas or k+m "
+                        "Reed-Solomon fragments (gossipfs_tpu/erasure/)")
+    p.add_argument("--stripe-k", type=int, default=STRIPE_K)
+    p.add_argument("--stripe-m", type=int, default=STRIPE_M)
+    p.add_argument("--erasure-matrix", action="store_true",
+                   help="the ERASURE_r18 lane: all four cosim scenarios "
+                        "in stripe mode + a replica repair-storm twin at "
+                        "the same failure schedule (bandwidth ratio)")
     p.add_argument("--scale", action="store_true",
                    help="the tensorized-planner lane at --scale-n members")
     p.add_argument("--scale-n", type=int, default=100_000)
@@ -220,15 +304,21 @@ def main(argv=None) -> None:
                      "materialized bytes capped — BASELINE.md boundary)",
         },
     }
-    if args.all or not (picked or args.scale):
+    red = dict(redundancy=args.redundancy, stripe_k=args.stripe_k,
+               stripe_m=args.stripe_m)
+    if args.erasure_matrix:
+        doc["erasure_matrix"] = erasure_matrix(
+            args.n, args.rounds, args.rate, args.seed, trace=args.trace,
+            stripe_k=args.stripe_k, stripe_m=args.stripe_m)
+    elif args.all or not (picked or args.scale):
         doc.update(cosim_lane(args.n, args.rounds, args.rate, args.seed,
-                              trace=args.trace))
+                              trace=args.trace, **red))
         doc["scale"] = scale_lane(args.scale_n, args.scale_files,
                                   budget=args.scale_budget, seed=args.seed)
     else:
         for name in picked:
             doc.update(cosim_lane(args.n, args.rounds, args.rate, args.seed,
-                                  trace=args.trace, only=name))
+                                  trace=args.trace, only=name, **red))
         if args.scale:
             doc["scale"] = scale_lane(args.scale_n, args.scale_files,
                                       budget=args.scale_budget,

@@ -31,6 +31,7 @@ from gossipfs_tpu.campaigns.driver import (
     make_scenario,
     run_case,
     run_scenario,
+    run_traffic_case_doc,
     sweep_axis,
     write_case,
 )
@@ -47,6 +48,7 @@ __all__ = [
     "run_case",
     "run_case_engine",
     "run_scenario",
+    "run_traffic_case_doc",
     "scale_case",
     "sweep_axis",
     "verdict_agreement",

@@ -474,8 +474,60 @@ def case_verdict_ok(row: dict, expect: dict) -> bool:
     return bool(ok)
 
 
+def run_traffic_case_doc(doc: dict) -> dict:
+    """Replay a TRAFFIC-plane case: the durability harness's rack-kill
+    storm (``traffic/harness.repair_storm``) in the case's redundancy
+    mode — the campaign matrix's byte-plane leg, same self-contained
+    knob contract as the gossip cases.  The verdict is the storm's
+    durability triple: ``pass`` iff zero acked writes are lost under
+    the cluster-state ledger AND the event replay AND the streaming
+    monitor, with all three accountings in exact agreement (the
+    ``no_acked_write_lost`` invariant holding verbatim in stripe mode)."""
+    from gossipfs_tpu.traffic.harness import repair_storm
+    from gossipfs_tpu.traffic.workload import WorkloadSpec
+
+    t = doc["traffic"]
+    spec = WorkloadSpec(
+        rate=float(t.get("rate", 4.0)),
+        n_keys=int(t.get("n_keys", 32)),
+        payload_cap=int(t.get("payload_cap", 4096)),
+        seed=int(t.get("seed", 0)),
+        redundancy=t.get("redundancy", "replica"),
+        **({"stripe_k": int(t["stripe_k"])} if "stripe_k" in t else {}),
+        **({"stripe_m": int(t["stripe_m"])} if "stripe_m" in t else {}),
+    )
+    out = repair_storm(
+        int(t["n"]), spec, files=int(t.get("files", 32)),
+        rack=tuple(t.get("rack", (8, 8))),
+        repair_budget=int(t.get("repair_budget", 8)),
+        seed=int(t.get("seed", 0)),
+    )
+    d = out["durability"]
+    ok = (d["harness"]["lost"] == 0 and d["events"]["lost"] == 0
+          and d["match"] and d["monitor"]["ok"]
+          and d["monitor"]["match_events"])
+    row = {
+        "verdict": "pass" if ok else "violated",
+        "lost": d["harness"]["lost"],
+        "files_acked": d["harness"]["files_acked"],
+        "rack_killed": out["rack_killed"],
+        "repairs_total": out["repairs_total"],
+        "repair_bytes_written": out["repair_bytes_written"],
+        "repair_copies": out["repair_copies"],
+        "durability": d,
+        "traffic_vitals": out["traffic_vitals"],
+    }
+    expect = doc["expect"]
+    reproduced = (row["verdict"] == expect["verdict"]
+                  and row["lost"] == int(expect.get("lost", row["lost"])))
+    return {"reproduced": bool(reproduced), "expect": expect, "row": row}
+
+
 def run_case_doc(doc: dict) -> dict:
-    """Replay one parsed case document on the tensor engine."""
+    """Replay one parsed case document — gossip cases on the tensor
+    engine, ``"traffic"`` cases on the durability harness."""
+    if "traffic" in doc:
+        return run_traffic_case_doc(doc)
     sc = FaultScenario.from_json(json.dumps(doc["scenario"]))
     c = doc["config"]
     row = run_scenario(

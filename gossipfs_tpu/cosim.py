@@ -19,7 +19,7 @@ from gossipfs_tpu.config import SimConfig
 from gossipfs_tpu.detector.api import DetectionEvent
 from gossipfs_tpu.detector.sim import SimDetector
 from gossipfs_tpu.sdfs.cluster import SDFSCluster
-from gossipfs_tpu.sdfs.types import RECOVERY_DELAY
+from gossipfs_tpu.sdfs.types import RECOVERY_DELAY, STRIPE_K, STRIPE_M
 from gossipfs_tpu.utils.eventlog import EventLog
 
 
@@ -54,6 +54,10 @@ class CoSim:
         election: str = "local",
         detector=None,
         repair_budget: int | None = None,
+        redundancy: str = "replica",
+        stripe_k: int = STRIPE_K,
+        stripe_m: int = STRIPE_M,
+        rack_size: int | None = None,
     ):
         """``election``: "local" computes election outcomes centrally inside
         ``update_membership`` (the in-process fast path); "rpc" defers them —
@@ -71,13 +75,22 @@ class CoSim:
         fail_recover(budget=...)``); a pass that defers work schedules
         another pass NEXT round, so a mass failure drains at budget/round
         instead of serializing one giant pass.  None = unbounded (the
-        reference's behavior)."""
+        reference's behavior).
+
+        ``redundancy``: "replica" (4 full copies, the reference) or
+        "stripe" — the erasure plane (``gossipfs_tpu/erasure/``): puts
+        land k+m rack-balanced Reed-Solomon fragments, repairs re-encode
+        at ~1/k the bytes.  ``rack_size`` groups nodes into contiguous
+        racks, the stripe placement's correlated-failure domain."""
         if election not in ("local", "rpc"):
             raise ValueError(f"unknown election mode: {election!r}")
         self.config = config
         self.election = election
         self.detector = detector or SimDetector(config, seed=seed)
-        self.cluster = SDFSCluster(config.n, seed=seed, introducer=config.introducer)
+        self.cluster = SDFSCluster(config.n, seed=seed,
+                                   introducer=config.introducer,
+                                   redundancy=redundancy, stripe_k=stripe_k,
+                                   stripe_m=stripe_m, rack_size=rack_size)
         self.log = log or EventLog()
         self._recover_at: list[int] = []  # rounds at which to run fail_recover
         self.events: list[DetectionEvent] = []
@@ -268,6 +281,23 @@ class CoSim:
                 plans = self.cluster.fail_recover(budget=self.repair_budget)
                 self.repairs_done += len(plans)
                 for plan in plans:
+                    if self.cluster.redundancy == "stripe":
+                        # a stripe repair has k sources, not one: the
+                        # master coordinates, so it owns the log line
+                        self.log.write(
+                            f"Re-encoded {plan.file} v{plan.version} "
+                            f"slots {list(plan.slots)} to "
+                            f"{list(plan.new_nodes)}",
+                            round=now,
+                            kind="re_replicate",
+                            node=self.cluster.master_node,
+                        )
+                        self._rec("stripe_repair",
+                                  observer=self.cluster.master_node,
+                                  file=plan.file, version=plan.version,
+                                  slots=list(plan.slots),
+                                  targets=list(plan.new_nodes))
+                        continue
                     # logged by the SOURCE machine doing the Re_put
                     # (slave.go:1174)
                     self.log.write(
@@ -287,13 +317,18 @@ class CoSim:
                 # files with no replica left in the view: observable loss
                 # evidence (recovers — and re-arms — across heals)
                 lost_now = set(self.cluster.lost_files())
+                lost_kind = ("stripe_lost"
+                             if self.cluster.redundancy == "stripe"
+                             else "replica_lost")
                 for name in sorted(lost_now - self._lost_reported):
                     self.log.write(
-                        f"All replicas of {name} lost from the view",
+                        f"All replicas of {name} lost from the view"
+                        if lost_kind == "replica_lost" else
+                        f"Stripe {name} below k live fragments in the view",
                         round=now, kind="lost",
                         node=self.cluster.master_node,
                     )
-                    self._rec("replica_lost",
+                    self._rec(lost_kind,
                               observer=self.cluster.master_node, file=name)
                 self._lost_reported = lost_now
 
@@ -301,7 +336,22 @@ class CoSim:
     def _put_event(self, name: str) -> None:
         """One acked put's schema event: the committed version plus the
         replica nodes that actually acked (reachable at commit time) —
-        what the durability audit (traffic/audit.py) replays."""
+        what the durability audit (traffic/audit.py) replays.  Stripe
+        mode reports the slot-aligned fragment holders instead (-1 where
+        the fragment did not land), plus the (k, m) shape the replay
+        needs for its k-of-(k+m) loss line."""
+        if self.cluster.redundancy == "stripe":
+            sinfo = self.cluster.master.stripes.get(name)
+            if sinfo is None:
+                return
+            fragments = [
+                nd if nd >= 0 and nd in self.cluster.reachable else -1
+                for nd in sinfo.fragment_nodes
+            ]
+            self._rec("stripe_put", observer=self.cluster.master_node,
+                      file=name, version=sinfo.version, fragments=fragments,
+                      k=self.cluster.stripe_k, m=self.cluster.stripe_m)
+            return
         info = self.cluster.master.files.get(name)
         if info is None:
             return
@@ -370,16 +420,40 @@ class CoSim:
         this co-sim, repairs executed, and the CURRENT repair backlog
         (budget-deferred plans from the last recovery pass plus files
         still under-replicated right now — computed on demand; cheap at
-        interactive scale)."""
-        pending = len(self.cluster.master.plan_repairs(
-            self.cluster.live, reachable=self.cluster.reachable
-        ))
+        interactive scale).  Stripe mode adds the erasure vitals
+        (``stripes_degraded`` / ``fragments_lost``); replica mode leaves
+        them ABSENT so consumers render n/a, never a fabricated 0."""
+        cl = self.cluster
+        if cl.redundancy == "stripe":
+            pending = len(cl.master.plan_stripe_repairs(
+                cl.live, reachable=cl.reachable
+            ))
+        else:
+            pending = len(cl.master.plan_repairs(
+                cl.live, reachable=cl.reachable
+            ))
         doc = {
             "ops_issued": self.ops_issued,
             "ops_acked": self.ops_acked,
             "repairs_pending": pending,
             "repairs_done": self.repairs_done,
         }
+        if cl.redundancy == "stripe":
+            from gossipfs_tpu.sdfs.quorum import stripe_read_quorum
+
+            live_set = set(cl.live)
+            width = cl.stripe_k + cl.stripe_m
+            rq = stripe_read_quorum(cl.stripe_k, cl.stripe_m)
+            degraded = 0
+            frag_lost = 0
+            for info in cl.master.stripes.values():
+                w = sum(1 for nd in info.fragment_nodes if nd in live_set)
+                if w < width:
+                    frag_lost += width - w
+                    if w >= rq:
+                        degraded += 1
+            doc["stripes_degraded"] = degraded
+            doc["fragments_lost"] = frag_lost
         mon = getattr(self._recorder, "monitor", None)
         if mon is not None:
             # online health plane (obs/monitor.py): the live invariant

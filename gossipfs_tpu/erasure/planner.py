@@ -1,0 +1,254 @@
+"""Stripe-aware placement + repair planning over the live [N] masks.
+
+Extends the round-12 tensor planner (``traffic/planner.py``) to the
+erasure plane:
+
+  * **placement** — ``place_stripes`` draws k+m distinct fragment
+    holders per stripe with RACK-disjointness against a group vector:
+    the same rejection-free sampled machinery as ``place_batch``'s
+    sampled method, with the first-k-distinct dedup keyed on
+    ``racks[node]`` instead of the node id (distinct racks imply
+    distinct nodes).  A correlated rack kill then costs a stripe at
+    most ONE fragment — the whole point of paying m parities.
+  * **repair planning** — ``plan_stripe_repairs_tensor`` is the same
+    one-shot masked-top-k diff with per-stripe fragment-deficit
+    budgeting: score = (k+m) - live_fragments, masked to repairable
+    stripes, so the budget drains MOST-ENDANGERED-FIRST (a stripe at
+    k live fragments is one loss from data death; lost >= m fragments
+    IS data loss).  Lost stripes (live < k) are unreconstructable and
+    reported, never planned.
+
+Threshold math is IMPORTED from ``sdfs/quorum.py``
+(``stripe_read_quorum`` / ``stripe_write_quorum``) — never re-derived
+here; gossipfs-lint's stripe-quorum-ownership rule enforces it.
+"""
+
+from __future__ import annotations
+
+import functools
+import random
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from gossipfs_tpu.sdfs.placement import (
+    OVERSAMPLE_FACTOR,
+    first_k_distinct,
+    sample_members,
+)
+from gossipfs_tpu.sdfs.quorum import stripe_read_quorum, stripe_write_quorum
+from gossipfs_tpu.sdfs.types import STRIPE_K, STRIPE_M, STRIPE_WRITE_SLACK
+
+
+class StripePlan(NamedTuple):
+    """One budgeted stripe-repair planning pass (device arrays).
+
+    ``idx``/``valid`` — the up-to-``budget`` chosen stripe rows;
+    ``need`` — fragments to rebuild per chosen stripe; ``picks`` —
+    [budget, k+m] slot-aligned fresh holders (-1 where the slot is
+    healthy); ``degraded`` — repairable stripes below full strength
+    BEFORE the budget cut; ``lost`` — [F] stripes with fewer than k
+    live fragments (data loss this pass).
+    """
+
+    idx: jax.Array
+    valid: jax.Array
+    need: jax.Array
+    picks: jax.Array
+    degraded: jax.Array
+    lost: jax.Array
+
+
+def first_k_group_distinct(nodes: jnp.ndarray, groups: jnp.ndarray,
+                           k: int) -> jnp.ndarray:
+    """[rows, m] draws -> [rows, k] first k draws with DISTINCT group
+    ids, -1 padded — ``placement.first_k_distinct`` with the dup mask
+    keyed on ``groups[node]``; the kept values are still the nodes."""
+    rows, m = nodes.shape
+    g = jnp.where(nodes >= 0, groups[jnp.clip(nodes, 0)], -1)
+    dup = (g[:, :, None] == g[:, None, :]) & (
+        jnp.arange(m)[None, :] < jnp.arange(m)[:, None]
+    )[None]
+    is_new = ~dup.any(axis=2) & (nodes >= 0)
+    rank = jnp.cumsum(is_new, axis=1) - 1
+    take = is_new & (rank < k)
+    out = jnp.full((rows, k), -1, dtype=jnp.int32)
+    row_idx = jnp.broadcast_to(jnp.arange(rows)[:, None], (rows, m))
+    return out.at[row_idx, jnp.where(take, rank, k)].set(
+        jnp.where(take, nodes.astype(jnp.int32), -1), mode="drop"
+    )
+
+
+def place_stripes(
+    key: jax.Array,
+    alive: jax.Array,
+    racks: jax.Array,
+    n_stripes: int,
+    k: int = STRIPE_K,
+    m: int = STRIPE_M,
+) -> jax.Array:
+    """int32 [n_stripes, k+m] — fragment holders drawn uniformly over
+    live nodes, one per DISTINCT rack (``racks`` is the [N] group
+    vector).  Slots beyond the sampled distinct-rack count are -1 (the
+    caller's unplaced-slot retry rule, as in ``place_batch``)."""
+    draws = sample_members(key, alive, n_stripes,
+                           OVERSAMPLE_FACTOR * (k + m))
+    return first_k_group_distinct(draws, racks, k + m)
+
+
+def place_stripe(members: list[int], racks: dict[int, int] | list[int],
+                 rng: random.Random, k: int = STRIPE_K,
+                 m: int = STRIPE_M) -> list[int]:
+    """Host twin of :func:`place_stripes` for the control-plane path
+    (``sdfs/master.py``): k+m distinct holders, rack-BALANCED — each
+    pass takes at most one node per rack, so with R racks no rack ever
+    holds more than ceil((k+m)/R) fragments.  With R >= k+m that is
+    full rack-disjointness; smaller clusters degrade gracefully (a
+    correlated rack kill then costs at most ceil((k+m)/R) fragments,
+    which stays <= m down to R = 4 at the default (4, 2) shape)."""
+    pool = list(members)
+    rng.shuffle(pool)
+    chosen: list[int] = []
+    while pool and len(chosen) < k + m:
+        seen_racks: set[int] = set()
+        next_pool: list[int] = []
+        for node in pool:
+            if len(chosen) < k + m and racks[node] not in seen_racks:
+                seen_racks.add(racks[node])
+                chosen.append(node)
+            else:
+                next_pool.append(node)
+        pool = next_pool
+    return chosen
+
+
+def pick_repair_targets(candidates: list[int],
+                        racks: dict[int, int] | list[int],
+                        rack_load: dict[int, int], need: int,
+                        rng: random.Random) -> list[int]:
+    """Host-side repair placement: up to ``need`` distinct nodes from
+    ``candidates``, always picking the least-loaded rack first
+    (``rack_load`` counts the stripe's surviving fragments per rack) —
+    so repair restores :func:`place_stripe`'s ceil((k+m)/R) per-rack
+    bound instead of eroding it."""
+    pool = list(candidates)
+    rng.shuffle(pool)
+    load = dict(rack_load)
+    picks: list[int] = []
+    cap = 0
+    while pool and len(picks) < need:
+        cap += 1  # this pass admits racks holding < cap fragments
+        next_pool: list[int] = []
+        for node in pool:
+            if len(picks) < need and load.get(racks[node], 0) < cap:
+                load[racks[node]] = load.get(racks[node], 0) + 1
+                picks.append(node)
+            else:
+                next_pool.append(node)
+        pool = next_pool
+    return picks
+
+
+def _live_slots(holders: jax.Array, mask: jax.Array) -> jax.Array:
+    """[F, k+m] — fragment slot holds a node currently in ``mask``."""
+    return (holders >= 0) & mask[jnp.clip(holders, 0)]
+
+
+@functools.partial(jax.jit, static_argnames=("budget", "k", "m"))
+def plan_stripe_repairs_tensor(
+    key: jax.Array,
+    holders: jax.Array,
+    n_stripes: jax.Array,
+    alive: jax.Array,
+    reach: jax.Array,
+    budget: int,
+    k: int = STRIPE_K,
+    m: int = STRIPE_M,
+) -> StripePlan:
+    """The masked-top-k stripe-repair planner: degraded = fewer than
+    min(k+m, n_alive) live fragments but still >= k REACHABLE ones (the
+    re-encode needs k sources); the ``budget`` largest-deficit stripes
+    get slot-aligned fresh holders drawn uniformly from reachable
+    non-holder nodes.  Deterministic under ``key``."""
+    width = k + m
+    cap = holders.shape[0]
+    used = jnp.arange(cap) < n_stripes
+    live = _live_slots(holders, alive) & used[:, None]
+    w = live.sum(axis=1)
+    target = jnp.minimum(width, alive.sum())
+    sources = (_live_slots(holders, reach) & used[:, None]).sum(axis=1)
+    placed = used & (holders >= 0).any(axis=1)
+    lost = placed & (w < stripe_read_quorum(k, m))
+    degraded = placed & ~lost & (w < target) & (
+        sources >= stripe_read_quorum(k, m)
+    )
+
+    score = jnp.where(degraded, (width - w).astype(jnp.int32), 0)
+    top, idx = jax.lax.top_k(score, min(budget, cap))
+    valid = top > 0
+
+    hole = valid[:, None] & ~_live_slots(holders[idx], alive)
+    need = hole.sum(axis=1)
+
+    draws = sample_members(key, reach, idx.shape[0],
+                           OVERSAMPLE_FACTOR * width)
+    forb = holders[idx]
+    banned = (
+        (draws[:, :, None] == forb[:, None, :]) & (forb >= 0)[:, None, :]
+    ).any(axis=2)
+    picks_flat = first_k_distinct(jnp.where(banned, -1, draws), width)
+    # scatter the flat picks into the holed slots, in slot order
+    rank = jnp.cumsum(hole, axis=1) - 1
+    picks = jnp.where(
+        hole,
+        jnp.take_along_axis(picks_flat, jnp.clip(rank, 0, width - 1), 1),
+        -1,
+    )
+    return StripePlan(idx=idx, valid=valid, need=need, picks=picks,
+                      degraded=degraded.sum(), lost=lost)
+
+
+@jax.jit
+def commit_stripe_repairs(
+    holders: jax.Array,
+    idx: jax.Array,
+    valid: jax.Array,
+    picks: jax.Array,
+) -> jax.Array:
+    """Apply a :class:`StripePlan` in-array: landed picks fill their
+    slots, healthy slots keep their holders (slot-aligned, so no
+    compaction — the codec's row order IS the slot order)."""
+    rows = holders[idx]
+    newrow = jnp.where(valid[:, None] & (picks >= 0), picks, rows)
+    return holders.at[idx].set(newrow)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "m", "slack"))
+def stripe_stats(
+    holders: jax.Array,
+    n_stripes: jax.Array,
+    alive: jax.Array,
+    reach: jax.Array,
+    k: int = STRIPE_K,
+    m: int = STRIPE_M,
+    slack: int = STRIPE_WRITE_SLACK,
+) -> jax.Array:
+    """[k+m+4] summary: histogram of live-fragment counts (0..k+m; the
+    sub-k bins are data loss) + stripes meeting the write and read
+    quorums over REACHABLE fragments (``sdfs/quorum.py`` owns both) +
+    the degraded count."""
+    width = k + m
+    cap = holders.shape[0]
+    used = jnp.arange(cap) < n_stripes
+    placed = used & (holders >= 0).any(axis=1)
+    w = (_live_slots(holders, alive) & placed[:, None]).sum(axis=1)
+    hist = jnp.zeros((width + 1,), dtype=jnp.int32).at[
+        jnp.where(placed, w, width + 1)
+    ].add(placed.astype(jnp.int32), mode="drop")
+    r = (_live_slots(holders, reach) & placed[:, None]).sum(axis=1)
+    w_ok = (placed & (r >= stripe_write_quorum(k, m, slack))).sum()
+    r_ok = (placed & (r >= stripe_read_quorum(k, m))).sum()
+    degraded = (placed & (w >= stripe_read_quorum(k, m))
+                & (w < width)).sum()
+    return jnp.concatenate([hist, w_ok[None], r_ok[None], degraded[None]])

@@ -226,10 +226,10 @@ class StreamMonitor:
             elif k == "remove":
                 out.extend(self._check_remove(ev))
 
-        if k == "replica_put":
-            # the SAME gate analyze uses (replica_put | client_op, set
-            # above) — a repair/delete-only tail must not grow a
-            # durability doc the post-hoc side omits (monitor_parity)
+        if k in ("replica_put", "stripe_put"):
+            # the SAME gate analyze uses (put | client_op, set above) —
+            # a repair/delete-only tail must not grow a durability doc
+            # the post-hoc side omits (monitor_parity)
             self._has_traffic = True
         self._replay_observe(ev)
         return out
@@ -256,7 +256,8 @@ class StreamMonitor:
     # -- durability replay (one-round reorder buffer) -----------------------
     def _replay_observe(self, ev: Event) -> None:
         if ev.kind not in ("crash", "join", "replica_put",
-                           "replica_repair", "replica_delete"):
+                           "replica_repair", "replica_delete",
+                           "stripe_put", "stripe_repair"):
             return
         if self._replay_round is not None and ev.round > self._replay_round:
             self._replay_flush()

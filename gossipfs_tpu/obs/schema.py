@@ -85,6 +85,17 @@ EVENT_KINDS: dict[str, str] = {
     "replica_delete": "a file's metadata + replicas dropped by a client "
                       "delete (detail.file)",
     "replica_lost": "no live replica of a file remains",
+    # -- erasure plane (gossipfs_tpu/erasure/, redundancy="stripe")
+    "stripe_put": "a striped file version committed (detail.file / "
+                  "version / k / m / fragments — the slot-aligned holder "
+                  "list, -1 where the fragment did not land; the "
+                  "durability audit's stripe write record)",
+    "stripe_repair": "missing fragments re-encoded from k survivors "
+                     "(detail.file / version / slots / targets; observer "
+                     "= the coordinating master)",
+    "stripe_lost": "a stripe fell below k live fragments — "
+                   "unreconstructable (the MDS data-loss line, not "
+                   "total wipeout)",
     # -- traffic plane (traffic/)
     "client_op": "one SDFS client operation completed (detail.op / file / "
                  "bytes / ms / ok) — the open-loop load generator's and "
@@ -198,6 +209,11 @@ VITALS_FIELDS = (
     "ops_acked",        # of those, completed (quorum-acked / found / ok)
     "repairs_pending",  # under-replicated files awaiting a repair pass
     "repairs_done",     # re-replication plans executed so far
+    # -- erasure plane (redundancy="stripe" only): replica-mode documents
+    # OMIT both fields — they render n/a, never a fabricated clean 0,
+    # and a clean stripe-mode run reports a real measured 0
+    "stripes_degraded",  # stripes below full strength but >= k live
+    "fragments_lost",    # missing fragments summed over placed stripes
 )
 
 

@@ -16,29 +16,75 @@ from __future__ import annotations
 import dataclasses
 from typing import Callable
 
+from gossipfs_tpu.erasure import codec
 from gossipfs_tpu.sdfs import election
 from gossipfs_tpu.sdfs.master import SDFSMaster
-from gossipfs_tpu.sdfs.quorum import read_quorum, write_quorum
+from gossipfs_tpu.sdfs.quorum import (
+    read_quorum,
+    stripe_read_quorum,
+    stripe_write_quorum,
+    write_quorum,
+)
 from gossipfs_tpu.sdfs.store import LocalStore
-from gossipfs_tpu.sdfs.types import WRITE_CONFLICT_WINDOW, ReplicatePlan
+from gossipfs_tpu.sdfs.types import (
+    STRIPE_K,
+    STRIPE_M,
+    STRIPE_WRITE_SLACK,
+    WRITE_CONFLICT_WINDOW,
+    ReplicatePlan,
+    StripeInfo,
+    StripeRepairPlan,
+)
 
 
 class SDFSCluster:
-    """All nodes' stores plus the master role, driven by a membership view."""
+    """All nodes' stores plus the master role, driven by a membership view.
 
-    def __init__(self, n: int, seed: int = 0, introducer: int = 0):
+    ``redundancy="stripe"`` swaps the 4-full-replica byte plane for the
+    erasure plane (``gossipfs_tpu/erasure/``): puts encode the payload
+    into k data + m parity fragments (one LocalStore key per fragment,
+    ``codec.frag_key``), landed rack-disjointly; gets reconstruct from
+    ANY k fresh fragments; repair re-encodes missing fragments from k
+    surviving ones — moving ~1/k the bytes a whole-replica copy moves.
+    Threshold math stays in ``sdfs/quorum.py``.
+    """
+
+    def __init__(self, n: int, seed: int = 0, introducer: int = 0,
+                 redundancy: str = "replica", stripe_k: int = STRIPE_K,
+                 stripe_m: int = STRIPE_M, rack_size: int | None = None):
+        if redundancy not in ("replica", "stripe"):
+            raise ValueError(f"unknown redundancy mode: {redundancy!r}")
         self.n = n
         self.seed = seed
+        self.redundancy = redundancy
+        self.stripe_k = stripe_k
+        self.stripe_m = stripe_m
+        # node -> rack id; contiguous blocks of rack_size nodes (the
+        # scenario engine's correlated-outage grouping), or every node
+        # its own rack when no topology is configured
+        self.racks = {i: (i // rack_size if rack_size else i)
+                      for i in range(n)}
         self.stores = {i: LocalStore() for i in range(n)}
         self.master_node = introducer  # initial master = introducer (slave.go:22,99)
-        self.master = SDFSMaster(seed=seed)
+        self.master = self._new_master()
         self.live: list[int] = list(range(n))      # gossip membership VIEW
         self.reachable: set[int] = set(self.live)  # transport-level reachability
         self.election_pending = False  # master missing, external driver elects
         # repairs a budgeted fail_recover pass planned but deferred (the
         # repair-storm scheduler's backlog signal — see fail_recover)
         self.last_repair_pending = 0
+        # repair byte accounting, both modes: bytes actually written per
+        # landed repair copy (replica: the whole blob; stripe: one row of
+        # S/k bytes — framing headers excluded) — the ERASURE_r18
+        # repair-bandwidth claim's measurement
+        self.repair_bytes_written = 0
+        self.repair_copies = 0
         self.master.update_member(self.live)
+
+    def _new_master(self) -> SDFSMaster:
+        return SDFSMaster(seed=self.seed, redundancy=self.redundancy,
+                          stripe_k=self.stripe_k, stripe_m=self.stripe_m,
+                          racks=self.racks)
 
     # -- membership seam ---------------------------------------------------
     def update_membership(
@@ -105,13 +151,56 @@ class SDFSCluster:
         # a rebuilt file's true last-write time died with the old master;
         # treat it as not-recent so the conflict window doesn't spuriously
         # reject the first post-election put
-        rebuilt = election.rebuild_metadata(
-            registries, now=now - WRITE_CONFLICT_WINDOW
-        )
-        new_master = SDFSMaster(seed=self.seed)
-        new_master.files = rebuilt
+        new_master = self._new_master()
+        if self.redundancy == "stripe":
+            new_master.stripes = self._rebuild_stripes(
+                registries, now=now - WRITE_CONFLICT_WINDOW
+            )
+        else:
+            new_master.files = election.rebuild_metadata(
+                registries, now=now - WRITE_CONFLICT_WINDOW
+            )
         new_master.update_member(self.live)
         self.master = new_master
+
+    def _rebuild_stripes(
+        self, registries: dict[int, dict[str, int]], now: int
+    ) -> dict[str, StripeInfo]:
+        """Stripe-mode metadata rebuild: surviving registries list
+        fragment keys (``name#s<slot>``), so the new master recovers
+        per-slot holders at the highest version seen; the payload length
+        comes out of any surviving fragment's self-describing frame."""
+        width = self.stripe_k + self.stripe_m
+        # file -> slot -> (version, node), highest version per slot wins
+        best: dict[str, dict[int, tuple[int, int]]] = {}
+        for node, listing in registries.items():
+            for key, version in listing.items():
+                parsed = codec.parse_frag_key(key)
+                if parsed is None:
+                    continue
+                name, slot = parsed
+                if not 0 <= slot < width:
+                    continue
+                slots = best.setdefault(name, {})
+                if slot not in slots or version > slots[slot][0]:
+                    slots[slot] = (version, node)
+        rebuilt: dict[str, StripeInfo] = {}
+        for name, slots in best.items():
+            nodes = [-1] * width
+            version = max(v for v, _ in slots.values())
+            for slot, (_v, node) in slots.items():
+                nodes[slot] = node
+            length = 0
+            for slot, (v, node) in sorted(
+                slots.items(), key=lambda kv: -kv[1][0]
+            ):
+                blob = self.stores[node].get(codec.frag_key(name, slot))
+                if blob is not None:
+                    length, _ = codec.unpack_fragment(blob)
+                    break
+            rebuilt[name] = StripeInfo(fragment_nodes=nodes, version=version,
+                                       timestamp=now, length=length)
+        return rebuilt
 
     # -- client ops --------------------------------------------------------
     def put(
@@ -131,6 +220,9 @@ class SDFSCluster:
         if self.master.updated_recently(name, now):
             if confirm is None or not confirm():
                 return False  # "Write-Write conflicts!" (slave.go:681-686)
+        if self.redundancy == "stripe":
+            slots, version = self.master.handle_stripe_put(name, now)
+            return self._push_stripe(name, data, slots, version)
         replicas, version = self.master.handle_put(name, now)
         return self._push(name, data, replicas, version)
 
@@ -146,6 +238,31 @@ class SDFSCluster:
                 self.stores[node].put(name, data, version)
                 acks += 1
         return acks >= write_quorum(len(replicas))
+
+    def _push_stripe(self, name: str, data: bytes, slots: list[int],
+                     version: int) -> bool:
+        """Stripe fan-out: encode the payload into k+m fragments, land each
+        on its slot's holder, ack at the stripe write quorum
+        (``sdfs/quorum.py`` owns the threshold).  Every put re-encodes and
+        rewrites ALL slots, so at most ``STRIPE_WRITE_SLACK`` slots can be
+        stale at any acked version — which keeps k fresh fragments live
+        without a stripe read-repair path (the repair plane owns fragment
+        refresh)."""
+        if not slots:
+            return False
+        k, m = self.stripe_k, self.stripe_m
+        fragments = codec.encode_blob(data, k, m)
+        self.master.set_stripe_length(name, len(data))
+        acks = 0
+        for slot, node in enumerate(slots):
+            if node >= 0 and node in self.reachable:
+                self.stores[node].put(
+                    codec.frag_key(name, slot),
+                    codec.pack_fragment(fragments[slot], len(data)),
+                    version,
+                )
+                acks += 1
+        return acks >= stripe_write_quorum(k, m, STRIPE_WRITE_SLACK)
 
     def put_batch(
         self,
@@ -171,6 +288,15 @@ class SDFSCluster:
                 continue
             allowed.append(name)
             payload[name] = data
+        if self.redundancy == "stripe":
+            # stripe placement stays per file (the rack-disjoint draw has
+            # no batched twin yet — BASELINE.md's erasure section notes it)
+            for name in allowed:
+                slots, version = self.master.handle_stripe_put(name, now)
+                results[name] = self._push_stripe(
+                    name, payload[name], slots, version
+                )
+            return results
         placed = self.master.handle_put_batch(allowed, now)
         for name in allowed:
             replicas, version = placed[name]
@@ -180,6 +306,8 @@ class SDFSCluster:
     def get(self, name: str) -> bytes | None:
         """Read path with quorum of version reports + read-repair
         (slave.go:780-892)."""
+        if self.redundancy == "stripe":
+            return self._get_stripe(name)
         replicas, version = self.master.file_info(name)
         if not replicas or version < 0:
             return None  # "No File Found" (slave.go:830-834)
@@ -202,8 +330,43 @@ class SDFSCluster:
         # replica, slave.go:857-878) — reads move one copy, writes move R
         return None if blob is None else bytes(memoryview(blob))
 
+    def _get_stripe(self, name: str) -> bytes | None:
+        """Stripe read: fresh fragments from any ``stripe_read_quorum``
+        slots reconstruct the payload.  No read-repair here — every put
+        rewrites all slots and the repair plane refreshes the rest, so
+        stale slots are bounded by the write slack (see
+        :meth:`_push_stripe`)."""
+        k, m = self.stripe_k, self.stripe_m
+        slots, version, length = self.master.stripe_file_info(name)
+        if not slots or version < 0:
+            return None
+        rows: dict[int, bytes] = {}
+        for slot, node in enumerate(slots):
+            if node < 0 or node not in self.reachable:
+                continue
+            key = codec.frag_key(name, slot)
+            if self.stores[node].version(key) < version:
+                continue  # stale fragment can't serve this read
+            blob = self.stores[node].get(key)
+            if blob is None:
+                continue
+            _, rows[slot] = codec.unpack_fragment(blob)
+            if len(rows) == stripe_read_quorum(k, m):
+                break
+        if len(rows) < stripe_read_quorum(k, m):
+            return None
+        return codec.decode_blob(rows, k, m, length)
+
     def delete(self, name: str) -> bool:
         """Master drops metadata, replicas drop data (slave.go:1057-1091)."""
+        if self.redundancy == "stripe":
+            old_slots = self.master.stripe_delete(name)
+            if not old_slots:
+                return False
+            for slot, node in enumerate(old_slots):
+                if node >= 0:
+                    self.stores[node].delete(codec.frag_key(name, slot))
+            return True
         old = self.master.delete(name)
         if not old:
             return False
@@ -212,7 +375,11 @@ class SDFSCluster:
         return True
 
     def ls(self, name: str) -> list[int]:
-        """Replica locations of a file (slave.go:894-917)."""
+        """Replica locations of a file (slave.go:894-917); in stripe mode
+        the slot-aligned fragment holders (-1 = unplaced slot)."""
+        if self.redundancy == "stripe":
+            slots, _, _ = self.master.stripe_file_info(name)
+            return slots
         replicas, _ = self.master.file_info(name)
         return replicas
 
@@ -225,6 +392,15 @@ class SDFSCluster:
         ``replica_lost`` evidence (plan_repairs silently skips them as
         unrecoverable; the traffic plane wants them observable)."""
         live_set = set(self.live)
+        if self.redundancy == "stripe":
+            # a stripe is LOST once fewer than k fragments remain in the
+            # view — the MDS bound, not total wipeout, is the loss line
+            rq = stripe_read_quorum(self.stripe_k, self.stripe_m)
+            return [
+                name
+                for name, info in self.master.stripes.items()
+                if sum(1 for nd in info.fragment_nodes if nd in live_set) < rq
+            ]
         return [
             name
             for name, info in self.master.files.items()
@@ -232,7 +408,9 @@ class SDFSCluster:
         ]
 
     # -- failure recovery (slave.go:1093-1175 + master.go:74-127) ----------
-    def fail_recover(self, budget: int | None = None) -> list[ReplicatePlan]:
+    def fail_recover(
+        self, budget: int | None = None
+    ) -> list[ReplicatePlan] | list[StripeRepairPlan]:
         """Re-replicate every under-replicated file from its first healthy
         replica (Fail_recover + Re_put).  Called RECOVERY_DELAY rounds after a
         detection in the co-sim driver.
@@ -260,6 +438,8 @@ class SDFSCluster:
             # driver reschedules a full planning sweep each round
             raise ValueError("repair budget must be positive (None = "
                              "unbounded)")
+        if self.redundancy == "stripe":
+            return self._fail_recover_stripe(budget)
         plans = self.master.plan_repairs(self.live, reachable=self.reachable)
         executed: list[ReplicatePlan] = []
         self.last_repair_pending = 0
@@ -290,6 +470,8 @@ class SDFSCluster:
             for node in plan.new_nodes:
                 if node in self.reachable:
                     self.stores[node].put(plan.file, blob, plan.version)
+                    self.repair_bytes_written += len(blob)
+                    self.repair_copies += 1
                     copied.append(node)
             self.master.commit_repair(plan.file, list(plan.survivors) + copied)
             if copied:
@@ -298,6 +480,74 @@ class SDFSCluster:
                 executed.append(
                     dataclasses.replace(
                         plan, source=used_source, new_nodes=tuple(copied)
+                    )
+                )
+        return executed
+
+    def _fail_recover_stripe(
+        self, budget: int | None
+    ) -> list[StripeRepairPlan]:
+        """Stripe recovery: fetch k surviving fragments, re-encode the
+        missing slots, land them on the planned rack-disjoint targets.
+        Each landed fragment moves ceil(S/k) bytes where a replica repair
+        moves S — the 1/k repair-bandwidth claim's mechanism.  Budget
+        counts executed PLANS (stripes), symmetric with replica mode."""
+        k, m = self.stripe_k, self.stripe_m
+        plans = self.master.plan_stripe_repairs(
+            self.live, reachable=self.reachable
+        )
+        executed: list[StripeRepairPlan] = []
+        self.last_repair_pending = 0
+        for i, plan in enumerate(plans):
+            if budget is not None and len(executed) >= budget:
+                self.last_repair_pending = len(plans) - i
+                break
+            info = self.master.stripes.get(plan.file)
+            if info is None:
+                continue
+            # gather k source fragments at the plan's version — a listed
+            # survivor can be empty or stale (acked while unreachable, then
+            # rejoined), so fall through the other survivors; short of k
+            # sources the stripe is skipped and re-planned next pass
+            rows: dict[int, bytes] = {}
+            length = info.length
+            for slot in plan.survivors:
+                node = info.fragment_nodes[slot]
+                key = codec.frag_key(plan.file, slot)
+                if (
+                    node in self.reachable
+                    and self.stores[node].version(key) >= plan.version
+                ):
+                    blob = self.stores[node].get(key)
+                    if blob is not None:
+                        length, rows[slot] = codec.unpack_fragment(blob)
+                if len(rows) == stripe_read_quorum(k, m):
+                    break
+            if len(rows) < stripe_read_quorum(k, m):
+                continue
+            rebuilt = codec.repair_fragments(
+                rows, list(plan.slots), k, m, length
+            )
+            landed: dict[int, int] = {}
+            for slot, target in zip(plan.slots, plan.new_nodes):
+                if target in self.reachable:
+                    self.stores[target].put(
+                        codec.frag_key(plan.file, slot),
+                        codec.pack_fragment(rebuilt[slot], length),
+                        plan.version,
+                    )
+                    # row bytes only: the 4-byte frame is storage framing,
+                    # not repair traffic (BASELINE.md's convention)
+                    self.repair_bytes_written += len(rebuilt[slot])
+                    self.repair_copies += 1
+                    landed[slot] = target
+            if landed:
+                self.master.commit_stripe_repair(plan.file, landed)
+                executed.append(
+                    dataclasses.replace(
+                        plan,
+                        slots=tuple(landed),
+                        new_nodes=tuple(landed[s] for s in landed),
                     )
                 )
         return executed

@@ -16,11 +16,16 @@ import random
 import numpy as np
 
 from gossipfs_tpu.sdfs import placement
+from gossipfs_tpu.sdfs.quorum import stripe_read_quorum
 from gossipfs_tpu.sdfs.types import (
     REPLICATION_FACTOR,
+    STRIPE_K,
+    STRIPE_M,
     WRITE_CONFLICT_WINDOW,
     FileInfo,
     ReplicatePlan,
+    StripeInfo,
+    StripeRepairPlan,
 )
 
 # files at or above this count plan repairs through the vectorized array
@@ -32,11 +37,31 @@ BATCH_PLAN_THRESHOLD = 64
 class SDFSMaster:
     """File->replica metadata plus placement/repair planning."""
 
-    def __init__(self, seed: int = 0):
+    def __init__(self, seed: int = 0, redundancy: str = "replica",
+                 stripe_k: int = STRIPE_K, stripe_m: int = STRIPE_M,
+                 racks: dict[int, int] | None = None):
+        """``redundancy="stripe"`` keeps per-file :class:`StripeInfo`
+        (one holder per fragment slot) instead of replica lists, placed
+        rack-disjointly against ``racks`` (node -> rack id; None = every
+        node its own rack, i.e. plain distinct placement)."""
+        if redundancy not in ("replica", "stripe"):
+            raise ValueError(f"unknown redundancy mode: {redundancy!r}")
         self.files: dict[str, FileInfo] = {}
+        self.stripes: dict[str, StripeInfo] = {}
+        self.redundancy = redundancy
+        self.stripe_k = stripe_k
+        self.stripe_m = stripe_m
+        self.racks = racks
         self.members: list[int] = []
         self._seed = seed
         self._rng = random.Random(seed)
+
+    def _rack_map(self) -> dict[int, int]:
+        """Node -> rack id over the current view (identity when no rack
+        topology was configured — rack-disjoint degrades to distinct)."""
+        if self.racks is not None:
+            return self.racks
+        return {x: x for x in self.members}
 
     # -- membership seam (master.go:46-48) --------------------------------
     def update_member(self, members: list[int]) -> None:
@@ -46,7 +71,8 @@ class SDFSMaster:
     def updated_recently(self, name: str, now: int) -> bool:
         """Write-write conflict: a put within the last 60 rounds
         (If_file_updated_recent, master.go:214-229)."""
-        info = self.files.get(name)
+        info = (self.stripes if self.redundancy == "stripe"
+                else self.files).get(name)
         return info is not None and now - info.timestamp < WRITE_CONFLICT_WINDOW
 
     def handle_put(self, name: str, now: int) -> tuple[list[int], int]:
@@ -295,3 +321,111 @@ class SDFSMaster:
         info = self.files.get(name)
         if info is not None:
             info.node_list = list(node_list)
+
+    # -- stripe mode (gossipfs_tpu/erasure/) -------------------------------
+    def handle_stripe_put(self, name: str, now: int) -> tuple[list[int], int]:
+        """Stripe-mode :meth:`handle_put`: allocate k+m rack-disjoint
+        fragment holders once per file lifetime (``erasure.planner.
+        place_stripe``), bump the version on every put.  Slots beyond
+        what the membership can hold distinctly stay -1 (unplaced)."""
+        from gossipfs_tpu.erasure.planner import place_stripe
+
+        width = self.stripe_k + self.stripe_m
+        info = self.stripes.get(name)
+        if info is None:
+            nodes = place_stripe(self.members, self._rack_map(), self._rng,
+                                 self.stripe_k, self.stripe_m)
+            nodes = list(nodes) + [-1] * (width - len(nodes))
+            info = StripeInfo(fragment_nodes=nodes, version=0,
+                              timestamp=now, length=0)
+            self.stripes[name] = info
+        info.version += 1
+        info.timestamp = now
+        return list(info.fragment_nodes), info.version
+
+    def stripe_file_info(self, name: str) -> tuple[list[int], int, int]:
+        """Fragment holders + version + payload length; ([], -1, 0) when
+        absent (the stripe twin of :meth:`file_info`)."""
+        info = self.stripes.get(name)
+        if info is None:
+            return [], -1, 0
+        return list(info.fragment_nodes), info.version, info.length
+
+    def stripe_delete(self, name: str) -> list[int]:
+        """Drop stripe metadata; returns the old holder-by-slot list."""
+        info = self.stripes.pop(name, None)
+        return list(info.fragment_nodes) if info else []
+
+    def plan_stripe_repairs(
+        self, live: list[int], reachable: set[int] | None = None
+    ) -> list[StripeRepairPlan]:
+        """Diff every stripe's fragment holders against the live view —
+        the stripe twin of :meth:`plan_repairs`, same contracts: plans
+        come back MOST-ENDANGERED-FIRST (fewest live fragments at the
+        front — a stripe at k live fragments is one loss from data
+        death), sources must be reachable (re-encoding needs k live
+        fragments to read), candidates are reachable non-holders with
+        repair picks filling the least-loaded racks first,
+        and the caller commits only the fragments that actually landed
+        (``commit_stripe_repair``).  A stripe below k live fragments is
+        data loss — skipped as unrecoverable, like the replica path's
+        zero-survivor files."""
+        k, m = self.stripe_k, self.stripe_m
+        width = k + m
+        live_set = set(live)
+        reach = live_set if reachable is None else (set(reachable) & live_set)
+        members = sorted(live_set)
+        rng = random.Random(f"{self._seed}:stripe:{members}")
+        racks = self.racks if self.racks is not None else {
+            x: x for x in live_set
+        }
+        from gossipfs_tpu.erasure.planner import pick_repair_targets
+
+        plans: list[StripeRepairPlan] = []
+        for name, info in self.stripes.items():
+            nodes = info.fragment_nodes
+            live_slots = [s for s, nd in enumerate(nodes) if nd in live_set]
+            w = len(live_slots)
+            target = min(width, len(live_set))
+            if w >= target or w < stripe_read_quorum(k, m):
+                # full strength — or already below k (data loss, not a plan)
+                continue
+            reach_slots = [s for s in live_slots if nodes[s] in reach]
+            if len(reach_slots) < stripe_read_quorum(k, m):
+                # can't read k fragments right now: retried next pass
+                continue
+            holders = {nd for nd in nodes if nd >= 0}
+            candidates = [x for x in reach if x not in holders]
+            holes = [s for s in range(width) if s not in set(live_slots)]
+            need = min(len(holes), target - w)
+            rack_load: dict[int, int] = {}
+            for s in live_slots:
+                r = racks.get(nodes[s], nodes[s])
+                rack_load[r] = rack_load.get(r, 0) + 1
+            picks = pick_repair_targets(candidates, racks, rack_load,
+                                        need, rng)
+            if picks:
+                plans.append(StripeRepairPlan(
+                    file=name, version=info.version,
+                    slots=tuple(holes[: len(picks)]),
+                    new_nodes=tuple(picks),
+                    survivors=tuple(live_slots),
+                ))
+        plans.sort(key=lambda p: len(p.survivors))  # most-endangered-first
+        return plans
+
+    def commit_stripe_repair(self, name: str,
+                             assignments: dict[int, int]) -> None:
+        """Record landed repairs: slot -> new holder (only fragments
+        that actually received bytes — the stripe :meth:`commit_repair`)."""
+        info = self.stripes.get(name)
+        if info is not None:
+            for slot, node in assignments.items():
+                info.fragment_nodes[slot] = node
+
+    def set_stripe_length(self, name: str, length: int) -> None:
+        """The byte plane reports the payload length at put time (the
+        master never sees bytes; decode needs the unpadded length)."""
+        info = self.stripes.get(name)
+        if info is not None:
+            info.length = length

@@ -51,7 +51,7 @@ import sys
 from gossipfs_tpu.config import SimConfig
 from gossipfs_tpu.cosim import CoSim
 from gossipfs_tpu.obs import schema
-from gossipfs_tpu.sdfs.types import CONFIRM_TIMEOUT
+from gossipfs_tpu.sdfs.types import CONFIRM_TIMEOUT, STRIPE_K, STRIPE_M
 
 
 def stdin_confirm(
@@ -117,6 +117,23 @@ def make_parser() -> argparse.ArgumentParser:
              "--packed is gossip-only already and runs the lifecycle "
              "in-kernel since round 11)",
     )
+    p.add_argument(
+        "--redundancy", choices=["replica", "stripe"], default="replica",
+        help="SDFS redundancy mode: 'replica' = the reference's 4-copy "
+             "scheme; 'stripe' = (k,m) GF(256) erasure coding "
+             "(gossipfs_tpu/erasure/) — rack-disjoint fragments, "
+             "k-of-(k+m) reads, budgeted most-endangered-first repair",
+    )
+    p.add_argument(
+        "--stripe-k", type=int, default=STRIPE_K,
+        help="data fragments per stripe (with --redundancy stripe)")
+    p.add_argument(
+        "--stripe-m", type=int, default=STRIPE_M,
+        help="parity fragments per stripe (with --redundancy stripe)")
+    p.add_argument(
+        "--rack-size", type=int, default=None,
+        help="nodes per failure domain for stripe placement "
+             "(default: every node its own rack)")
     p.add_argument(
         "--arc-align", type=int, default=1,
         help="with --packed: tile-aligned windowed-arc gossip (bases are "
@@ -274,10 +291,16 @@ def dispatch(
                 # invariant_violations: present only when a streaming
                 # monitor (obs/monitor.py) rides the attached recorder —
                 # engines that can't know it render n/a, never 0
+                # stripes_degraded/fragments_lost: stripe-mode-only
+                # erasure vitals — replica-mode documents omit them, so
+                # they render n/a here (a stripe run's clean 0 is a real
+                # measurement)
                 print(f"ops issued={fmt('ops_issued')} "
                       f"acked={fmt('ops_acked')}; "
                       f"repairs pending={fmt('repairs_pending')} "
                       f"done={fmt('repairs_done')}; "
+                      f"stripes degraded={fmt('stripes_degraded')} "
+                      f"fragments lost={fmt('fragments_lost')}; "
                       f"invariant_violations={fmt('invariant_violations')}",
                       file=out)
             else:
@@ -349,8 +372,12 @@ def main(argv=None) -> None:
         from gossipfs_tpu.detector.sim import PackedDetector
 
         detector = PackedDetector(cfg, seed=args.seed)
-    sim = CoSim(cfg, seed=args.seed, detector=detector)
-    print(f"gossipfs sim: {args.n} nodes, {cfg.topology} topology"
+    sim = CoSim(cfg, seed=args.seed, detector=detector,
+                redundancy=args.redundancy, stripe_k=args.stripe_k,
+                stripe_m=args.stripe_m, rack_size=args.rack_size)
+    mode = (f", stripe({args.stripe_k},{args.stripe_m})"
+            if args.redundancy == "stripe" else "")
+    print(f"gossipfs sim: {args.n} nodes, {cfg.topology} topology{mode}"
           f"{' (packed frontier mode)' if args.packed else ''}. "
           "'quit' to exit.")
     # Read stdin UNBUFFERED (byte-at-a-time lines): any buffered layer

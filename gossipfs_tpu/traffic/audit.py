@@ -10,6 +10,12 @@ and ``tools/verify_claims.py``'s ``traffic_durability`` claim requires
 the two accountings to agree EXACTLY (the observability subsystem's
 standing-oracle pattern, applied to the data plane).
 
+The erasure plane rides the same machine: ``stripe_put`` (slot-aligned
+acking fragment holders + the stripe's k) and ``stripe_repair`` (landed
+slot/target pairs) maintain a PER-SLOT ledger, and a stripe counts lost
+when fewer than k distinct slots retain a live fresh holder — the MDS
+bound, audited from events alone.
+
 Conservative by construction: read-repair refills (a stale replica
 pulling fresh bytes during a get) emit no event, so the event-side
 replica sets can only UNDER-count copies — an event-side "zero lost"
@@ -44,6 +50,12 @@ class DurabilityReplay:
         self.dead: set[int] = set()
         # file -> {node: version} as far as events can know it
         self.holders: dict[str, dict[int, int]] = {}
+        # stripe mode: file -> {slot: {node: version}} — PER SLOT, because
+        # loss is counted in distinct recoverable slots: a rejoined stale
+        # holder and its repair replacement can both hold the SAME slot,
+        # and flattening them to nodes would double-count that fragment
+        self.stripe_slots: dict[str, dict[int, dict[int, int]]] = {}
+        self.stripe_k: dict[str, int] = {}
         self.acked_version: dict[str, int] = {}
         self.acked_writes = 0
         self.repair_events = 0
@@ -69,21 +81,55 @@ class DurabilityReplay:
             h = self.holders.setdefault(name, {})
             for nd in d.get("targets", []):
                 h[int(nd)] = version
+        elif e.kind == "stripe_put":
+            self.acked_writes += 1
+            name, version = d.get("file"), int(d.get("version", 0))
+            self.acked_version[name] = version
+            self.stripe_k[name] = int(d.get("k", 0))
+            slots = self.stripe_slots.setdefault(name, {})
+            for slot, nd in enumerate(d.get("fragments", [])):
+                if int(nd) >= 0:
+                    slots.setdefault(slot, {})[int(nd)] = version
+        elif e.kind == "stripe_repair":
+            self.repair_events += 1
+            self.repair_complete_round = e.round
+            name, version = d.get("file"), int(d.get("version", 0))
+            slots = self.stripe_slots.setdefault(name, {})
+            for slot, nd in zip(d.get("slots", []), d.get("targets", [])):
+                slots.setdefault(int(slot), {})[int(nd)] = version
         elif e.kind == "replica_delete":
+            # mode-neutral delete verb: drops replica and stripe state
             self.acked_version.pop(d.get("file"), None)
             self.holders.pop(d.get("file"), None)
+            self.stripe_slots.pop(d.get("file"), None)
+            self.stripe_k.pop(d.get("file"), None)
+
+    def _slots_alive(self, name: str, version: int) -> int:
+        """Distinct slots with >= 1 event-known live holder at the acked
+        version (stripe files only)."""
+        return sum(
+            1
+            for nodes in self.stripe_slots.get(name, {}).values()
+            if any(nd not in self.dead and v >= version
+                   for nd, v in nodes.items())
+        )
 
     def lost_files(self) -> list[str]:
         """Files whose last-acked version survives on NO event-known
-        live replica right now (end-of-stream: the durability verdict)."""
-        return sorted(
-            name
-            for name, version in self.acked_version.items()
-            if not any(
+        live replica right now (end-of-stream: the durability verdict).
+        Stripe files are lost below k live fresh SLOTS — the MDS
+        reconstruction bound, counted per slot."""
+        out = []
+        for name, version in self.acked_version.items():
+            if name in self.stripe_k:
+                if self._slots_alive(name, version) < self.stripe_k[name]:
+                    out.append(name)
+            elif not any(
                 nd not in self.dead and v >= version
                 for nd, v in self.holders.get(name, {}).items()
-            )
-        )
+            ):
+                out.append(name)
+        return sorted(out)
 
     def facts(self) -> dict:
         lost_files = self.lost_files()

@@ -60,8 +60,14 @@ class TrafficHarness:
     def __init__(self, n: int, spec: WorkloadSpec, seed: int = 0,
                  trace: str | None = None, repair_budget: int | None = None,
                  t_cooldown: int = 12):
+        # rack_size=8 groups nodes into the same contiguous blocks the
+        # repair-storm scenario kills, so stripe placement's rack
+        # balancing is exercised against the actual failure domain
         self.sim = CoSim(traffic_config(n, t_cooldown=t_cooldown),
-                         seed=seed, repair_budget=repair_budget)
+                         seed=seed, repair_budget=repair_budget,
+                         redundancy=spec.redundancy,
+                         stripe_k=spec.stripe_k, stripe_m=spec.stripe_m,
+                         rack_size=8)
         self.wl = Workload(spec)
         # round 13: the recorder carries the streaming invariant monitor
         # inline (obs/monitor.py) — the acked-write durability ledger is
@@ -106,9 +112,12 @@ class TrafficHarness:
             name = f"pre{i}.txt"
             items.append((name, self.wl.payload(name, rnd, size)))
         results = self.sim.put_batch(items, confirm=lambda: True)
+        meta = (self.sim.cluster.master.stripes
+                if self.sim.cluster.redundancy == "stripe"
+                else self.sim.cluster.master.files)
         for name, data in items:
             if results.get(name):
-                info = self.sim.cluster.master.files[name]
+                info = meta[name]
                 self.acked[name] = (info.version, payload_digest(data))
         return sum(bool(v) for v in results.values())
 
@@ -116,17 +125,39 @@ class TrafficHarness:
     def audit_stores(self) -> dict:
         """Harness-side durability: every acked write must have at least
         one LIVE listed replica holding the acked-or-newer version
-        (stores are read directly — no read-repair side effects)."""
+        (stores are read directly — no read-repair side effects).  In
+        stripe mode an acked write survives while >= k slots have their
+        CURRENT assigned holder live and fresh — the same
+        current-metadata semantics as the replica branch."""
+        from gossipfs_tpu.erasure import codec
+        from gossipfs_tpu.sdfs.quorum import stripe_read_quorum
+
         cluster = self.sim.cluster
         live = set(cluster.live)
+        stripe = cluster.redundancy == "stripe"
+        rq = (stripe_read_quorum(cluster.stripe_k, cluster.stripe_m)
+              if stripe else None)
         lost = []
         for name, (version, _digest) in sorted(self.acked.items()):
-            info = cluster.master.files.get(name)
-            nodes = info.node_list if info is not None else ()
-            ok = any(
-                nd in live and cluster.stores[nd].version(name) >= version
-                for nd in nodes
-            )
+            if stripe:
+                sinfo = cluster.master.stripes.get(name)
+                nodes = sinfo.fragment_nodes if sinfo is not None else ()
+                slots_ok = sum(
+                    1
+                    for slot, nd in enumerate(nodes)
+                    if nd in live
+                    and cluster.stores[nd].version(
+                        codec.frag_key(name, slot)) >= version
+                )
+                ok = slots_ok >= rq
+            else:
+                info = cluster.master.files.get(name)
+                fnodes = info.node_list if info is not None else ()
+                ok = any(
+                    nd in live
+                    and cluster.stores[nd].version(name) >= version
+                    for nd in fnodes
+                )
             if not ok:
                 lost.append(name)
         return {
@@ -143,7 +174,8 @@ class TrafficHarness:
         verdict (zero ``no_acked_write_lost`` violations)."""
         harness = self.audit_stores()
         harness["acked_writes"] = sum(
-            1 for e in self.recorder.events if e.kind == "replica_put"
+            1 for e in self.recorder.events
+            if e.kind in ("replica_put", "stripe_put")
         )
         harness["repair_events"] = self.sim.repairs_done
         from_events = audit.durability_from_events([
@@ -184,6 +216,8 @@ def steady_state(n: int, rounds: int, spec: WorkloadSpec, seed: int = 0,
     window = h.run(rounds)
     h.drain(RECOVERY_DELAY + 2)
     out = {"scenario": "steady", "n": n, **window,
+           "repair_bytes_written": h.sim.cluster.repair_bytes_written,
+           "repair_copies": h.sim.cluster.repair_copies,
            "durability": h.durability(),
            "traffic_vitals": h.sim.traffic_status()}
     h.close()
@@ -204,6 +238,8 @@ def churn(n: int, rounds: int, spec: WorkloadSpec, crashes: int = 4,
     out = {
         "scenario": "churn", "n": n, "crashed": victims,
         "before": first, "after_crash": second,
+        "repair_bytes_written": h.sim.cluster.repair_bytes_written,
+        "repair_copies": h.sim.cluster.repair_copies,
         "durability": h.durability(),
         "traffic_vitals": h.sim.traffic_status(),
     }
@@ -247,6 +283,8 @@ def partition_race(n: int, spec: WorkloadSpec, seed: int = 0,
         "split_rounds": split_rounds,
         "before": before, "during_split": during, "after_heal": after,
         "rejected_during_split": rejected_during,
+        "repair_bytes_written": h.sim.cluster.repair_bytes_written,
+        "repair_copies": h.sim.cluster.repair_copies,
         "durability": h.durability(),
         "traffic_vitals": h.sim.traffic_status(),
     }
@@ -280,7 +318,8 @@ def repair_storm(n: int, spec: WorkloadSpec, files: int = 128,
     drain_horizon = deficit_rounds + (files * 2) // repair_budget + 12
     h.drain(drain_horizon)
     repair_rounds = sorted(
-        e.round for e in h.recorder.events if e.kind == "replica_repair"
+        e.round for e in h.recorder.events
+        if e.kind in ("replica_repair", "stripe_repair")
         and e.round > crash_round
     )
     per_round: dict[int, int] = {}
@@ -296,6 +335,8 @@ def repair_storm(n: int, spec: WorkloadSpec, files: int = 128,
         "storm_drain_rounds": (repair_rounds[-1] - crash_round)
         if repair_rounds else None,
         "repairs_per_round": {str(k): v for k, v in sorted(per_round.items())},
+        "repair_bytes_written": h.sim.cluster.repair_bytes_written,
+        "repair_copies": h.sim.cluster.repair_copies,
         "durability": h.durability(),
         "traffic_vitals": h.sim.traffic_status(),
     }

@@ -30,6 +30,8 @@ import hashlib
 import random
 import time
 
+from gossipfs_tpu.sdfs.types import STRIPE_K, STRIPE_M
+
 # the reference shards' magnitudes: 64 KB / 1 MB / 3.2 MB / 4 MB
 # (file10.txt is 3.2 MB, file5.txt 4.0 MB — BASELINE.md "wire_ops")
 REFERENCE_SIZES = (65_536, 1_048_576, 3_276_800, 4_194_304)
@@ -50,6 +52,9 @@ class WorkloadSpec:
     keep the logical size for the record while moving capped payloads
     (the honest CPU-pinned boundary is documented in BASELINE.md; 0/None
     = move the full logical size).
+    ``redundancy`` — "replica" (4 full copies) or "stripe" (the erasure
+    plane: ``stripe_k`` data + ``stripe_m`` parity Reed-Solomon
+    fragments per file — gossipfs_tpu/erasure/).
     """
 
     rate: float = 16.0
@@ -62,6 +67,9 @@ class WorkloadSpec:
     size_weights: tuple[float, ...] = REFERENCE_SIZE_WEIGHTS
     payload_cap: int | None = 65_536
     seed: int = 0
+    redundancy: str = "replica"
+    stripe_k: int = STRIPE_K
+    stripe_m: int = STRIPE_M
 
     def __post_init__(self):
         if not 0 <= self.put_frac + self.delete_frac <= 1:
@@ -72,6 +80,10 @@ class WorkloadSpec:
             raise ValueError("sizes and size_weights lengths differ")
         if self.rate <= 0 or self.n_keys <= 0:
             raise ValueError("rate and n_keys must be positive")
+        if self.redundancy not in ("replica", "stripe"):
+            raise ValueError(f"unknown redundancy: {self.redundancy!r}")
+        if self.stripe_k < 1 or self.stripe_m < 1:
+            raise ValueError("stripe_k and stripe_m must be >= 1")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -190,7 +202,10 @@ def drive_cosim(sim, wl: Workload, rounds: int, *, recorder=None,
                 data = wl.payload(op.key, rnd, op.size)
                 ok = sim.put(op.key, data, confirm=confirm)
                 if ok and on_ack is not None:
-                    version = sim.cluster.master.files[op.key].version
+                    meta = (sim.cluster.master.stripes
+                            if sim.cluster.redundancy == "stripe"
+                            else sim.cluster.master.files)
+                    version = meta[op.key].version
                     on_ack(op.key, version, payload_digest(data))
             elif op.kind == "get":
                 ok = sim.get(op.key) is not None

@@ -84,3 +84,13 @@ def pytest_configure(config):
         "acked-write loss.  `pytest -m traffic` runs just this "
         "subsystem.",
     )
+    config.addinivalue_line(
+        "markers",
+        "erasure: erasure-plane coverage (gossipfs_tpu/erasure/ — the "
+        "GF(256) Reed-Solomon codec, stripe placement/repair planning, "
+        "and the redundancy=\"stripe\" byte plane through cluster/cosim/"
+        "harness).  Fast-lane cases ride tier-1, including the n=32 "
+        "put/get/rack-kill/repair smoke asserting no acked-write loss "
+        "and the committed stripe rack-kill regression-case replay.  "
+        "`pytest -m erasure` runs just this subsystem.",
+    )

@@ -1,0 +1,13 @@
+"""Trigger fixture for the stripe-quorum-ownership rule: re-derives the
+(k+m-f)-of-(k+m) stripe write threshold instead of importing
+sdfs/quorum.py.  Mounted over gossipfs_tpu/erasure/ by
+tests/test_analysis.py only — never imported."""
+
+
+def bad_stripe_write_quorum(acks: int, k: int, m: int, f: int) -> bool:
+    return acks >= k + m - f  # the owned threshold shape, re-derived
+
+
+def bad_stripe_width_check(live: int, stripe_k: int, stripe_m: int) -> bool:
+    # subtracting slack from the stripe width inside a comparison
+    return live > stripe_k + stripe_m - 1

@@ -193,7 +193,8 @@ def analyze(headers: list[dict], events: list[Event]) -> dict:
     # replica_put/repair/delete rows: re-derive the durability facts from
     # the events alone (traffic/audit.py — the same function the harness
     # diffs itself against) plus the client_op latency rollup
-    if any(e.kind in ("replica_put", "client_op") for e in events):
+    if any(e.kind in ("replica_put", "stripe_put", "client_op")
+           for e in events):
         from gossipfs_tpu.traffic.audit import durability_from_events
         from gossipfs_tpu.traffic.workload import quantiles
 
