@@ -8,6 +8,9 @@
 #   make test           tier-1 suite (the ROADMAP verify command's core)
 #   make verify-claims  every headline claim end-to-end (accelerator
 #                       lanes included — see tools/verify_claims.py)
+#   make conformance    adversarial-schedule conformance matrix, every
+#                       engine (tools/conformance.py --matrix; exits
+#                       nonzero on any verdict flip)
 
 PY ?= python
 
@@ -23,4 +26,7 @@ test:
 verify-claims:
 	$(PY) tools/verify_claims.py
 
-.PHONY: lint test verify-claims
+conformance:
+	env JAX_PLATFORMS=cpu $(PY) tools/conformance.py --matrix
+
+.PHONY: lint test verify-claims conformance

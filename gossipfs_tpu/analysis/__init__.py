@@ -38,6 +38,7 @@ from gossipfs_tpu.analysis import (  # noqa: E402,F401
     probes,
     rules_asyncio,
     rules_config,
+    rules_conformance,
     rules_jit,
     rules_native,
     rules_obs,

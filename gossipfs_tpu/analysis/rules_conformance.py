@@ -1,0 +1,92 @@
+"""gossipfs-lint: the conformance corpus must keep pace with the
+contract.
+
+``protocol_spec`` (round 17) is the one machine-readable protocol
+contract; the conformance fuzzer (round 19) is its dynamic twin.  The
+seam between them is the ``FAMILIES`` table in
+``gossipfs_tpu/conformance/schedules.py`` — each family declares which
+wire verbs and injection verbs its schedules exercise, and
+``schedules.coverage()`` checks the union at runtime.  This rule is
+the STATIC half of that check: a contract row added to
+``protocol_spec`` (a new wire verb, a new injection) without a family
+exercising it fails lint before any fuzz run happens — the same
+one-ownership discipline the spec-* rules apply to the engines.
+
+The declarations are trusted because the generators are validated
+against them: ``schedules.validate`` rejects a case whose steps use a
+verb outside its family's list, and the round-trip tests run every
+family through it.
+"""
+
+from __future__ import annotations
+
+from . import protocol_spec as spec
+from .framework import Finding, literal_dict, rule
+
+_SCHEDULES = "gossipfs_tpu/conformance/schedules.py"
+
+
+@rule(
+    "conformance-verb-coverage",
+    "every protocol_spec wire verb and injection verb must be exercised "
+    "by at least one conformance schedule family (schedules.FAMILIES), "
+    "and every family's declared verbs/injections must exist in the "
+    "contract — the corpus cannot silently fall behind the contract",
+    fixture="conformance_verb_coverage.py",
+    fixture_at=_SCHEDULES,
+)
+def conformance_verb_coverage(index) -> list[Finding]:
+    findings: list[Finding] = []
+    tree = index.tree(_SCHEDULES)
+    families = literal_dict(tree, "FAMILIES")
+    if not isinstance(families, dict) or not families:
+        return [Finding(
+            "conformance-verb-coverage", _SCHEDULES, 1,
+            "extractor went blind: the FAMILIES literal was not found — "
+            "the analyzer cannot see the corpus's declared coverage",
+        )]
+
+    covered_verbs: set[str] = set()
+    covered_inj: set[str] = set()
+    for name, fam in families.items():
+        if not isinstance(fam, dict):
+            findings.append(Finding(
+                "conformance-verb-coverage", _SCHEDULES, 1,
+                f"family {name!r} is not a declaration dict",
+            ))
+            continue
+        verbs = set(fam.get("verbs", ()))
+        injections = set(fam.get("injections", ()))
+        unknown_v = verbs - set(spec.WIRE_VERBS)
+        if unknown_v:
+            findings.append(Finding(
+                "conformance-verb-coverage", _SCHEDULES, 1,
+                f"family {name!r} declares wire verbs outside the "
+                f"contract: {sorted(unknown_v)} (protocol_spec.WIRE_VERBS)",
+            ))
+        unknown_i = injections - {i.name for i in spec.INJECTIONS}
+        if unknown_i:
+            findings.append(Finding(
+                "conformance-verb-coverage", _SCHEDULES, 1,
+                f"family {name!r} declares injections outside the "
+                f"contract: {sorted(unknown_i)} (protocol_spec.INJECTIONS)",
+            ))
+        covered_verbs |= verbs & set(spec.WIRE_VERBS)
+        covered_inj |= injections & {i.name for i in spec.INJECTIONS}
+
+    missing_verbs = set(spec.WIRE_VERBS) - covered_verbs
+    if missing_verbs:
+        findings.append(Finding(
+            "conformance-verb-coverage", _SCHEDULES, 1,
+            f"contract wire verbs with NO exercising schedule family: "
+            f"{sorted(missing_verbs)} — add a family (or extend one) "
+            "before the verb ships untested",
+        ))
+    missing_inj = {i.name for i in spec.INJECTIONS} - covered_inj
+    if missing_inj:
+        findings.append(Finding(
+            "conformance-verb-coverage", _SCHEDULES, 1,
+            f"contract injection verbs with NO exercising schedule "
+            f"family: {sorted(missing_inj)}",
+        ))
+    return findings

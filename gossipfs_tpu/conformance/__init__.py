@@ -1,0 +1,30 @@
+"""Conformance fuzzing: the protocol contract gone dynamic.
+
+``analysis/protocol_spec.py`` (round 17) pins the SWIM+Lifeguard
+lifecycle *statically* — the drift lint diffs what each engine's code
+SAYS against the contract.  This package is its runtime twin: it walks
+the same transition table and *executes* it, generating adversarial
+message schedules (delayed/dropped REFUTEs, replayed incarnations,
+SUSPECT verb floods, forged REMOVEs, malformed datagrams) and driving
+every engine through them, then comparing each engine's observable
+surface against a step-for-step reference prediction.
+
+  * :mod:`schedules`  — seed-pure adversarial-schedule generator driven
+    by ``protocol_spec`` (``gossipfs-conformance/v1`` case docs);
+  * :mod:`harness`    — one injection driver per engine, plus the
+    per-round reference oracle built on ``suspicion/runtime.py``;
+  * :mod:`verdict`    — the per-(schedule, engine) conformance matrix;
+  * :mod:`shrink`     — greedy delta-debugging for failing schedules
+    (minimal repros land in ``regressions/``).
+
+``tools/conformance.py`` is the CLI; ``CONFORMANCE_r19.json`` is the
+committed matrix artifact.
+"""
+
+from gossipfs_tpu.conformance.schedules import (  # noqa: F401
+    FAMILIES,
+    coverage,
+    generate,
+    generate_corpus,
+)
+from gossipfs_tpu.conformance.verdict import compare, run_matrix  # noqa: F401

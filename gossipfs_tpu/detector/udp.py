@@ -144,7 +144,19 @@ class UdpNode:
         for chunk in payload.split(ENTRY_SEP):
             parts = chunk.split(FIELD_SEP)
             if len(parts) >= 2:
-                out.append((parts[0], int(float(parts[1]))))
+                # wire-derived fields are untrusted: skip entries whose hb
+                # does not parse instead of aborting the whole datagram —
+                # the native codec's DecodeMembers semantics.  The old
+                # raise lost every VALID entry sharing a datagram with one
+                # bad chunk (conformance malformed_codec: a refuting
+                # incarnation advance rides with a truncated entry; losing
+                # it confirms a live node dead — the committed
+                # regressions/conformance_malformed_udp.json repro)
+                try:
+                    hb = int(float(parts[1]))
+                except ValueError:
+                    continue
+                out.append((parts[0], hb))
         return out
 
     # -- receive dispatch (GetMsg, slave.go:207-248) ------------------------

@@ -111,12 +111,16 @@ def load_library() -> ctypes.CDLL:
         lib.gfs_advance.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.gfs_round.argtypes = [ctypes.c_void_p]
         lib.gfs_round.restype = ctypes.c_int
-        for fn in (lib.gfs_membership,):
+        for fn in (lib.gfs_membership, lib.gfs_suspects):
             fn.argtypes = [
                 ctypes.c_void_p, ctypes.c_int,
                 ctypes.POINTER(ctypes.c_int), ctypes.c_int,
             ]
             fn.restype = ctypes.c_int
+        lib.gfs_incarnation.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int
+        ]
+        lib.gfs_incarnation.restype = ctypes.c_longlong
         for fn in (lib.gfs_alive, lib.gfs_drain_events):
             fn.argtypes = [
                 ctypes.c_void_p, ctypes.POINTER(ctypes.c_int), ctypes.c_int
@@ -328,6 +332,7 @@ class NativeUdpDetector:
     ):
         self._lib = load_library()
         self.n = n
+        self.base_port = base_port
         self.period = period
         self.suspicion = suspicion
         self._recorder = None
@@ -385,6 +390,22 @@ class NativeUdpDetector:
         buf = (ctypes.c_int * self.n)()
         count = self._lib.gfs_alive(self._h, buf, self.n)
         return list(buf[:count])
+
+    # -- conformance-harness read seams (round 19) -------------------------
+    def suspects(self, observer: int) -> list[int]:
+        """Node indices the observer currently holds under suspicion."""
+        buf = (ctypes.c_int * self.n)()
+        count = self._lib.gfs_suspects(self._h, observer, buf, self.n)
+        return list(buf[:count])
+
+    def incarnation(self, observer: int, subject: int) -> int:
+        """The subject's heartbeat counter in the observer's view
+        (the per-entry incarnation surface); -1 when absent."""
+        return int(self._lib.gfs_incarnation(self._h, observer, subject))
+
+    def wire_addr(self, node: int) -> str:
+        """The wire address datagrams name this node by."""
+        return f"127.0.0.1:{self.base_port + node}"
 
     # -- obs plane (round 16) ----------------------------------------------
     def attach_recorder(self, recorder) -> int:

@@ -158,6 +158,8 @@ class Cluster {
     return round_;
   }
   int Membership(int observer, int* out, int cap);
+  int Suspects(int observer, int* out, int cap);
+  long long Incarnation(int observer, int subject);  // hb, -1 if absent
   int AliveNodes(int* out, int cap);
   int DrainEvents(int* out, int cap);  // quadruples per event
 
@@ -262,6 +264,10 @@ class Node {
   bool alive() const GFS_REQUIRES(cluster_->mu_) { return alive_; }
   const std::string& addr() const { return addr_; }
   std::vector<std::string> MemberAddrs() const GFS_REQUIRES(cluster_->mu_);
+  std::vector<std::string> SuspectAddrs() const GFS_REQUIRES(cluster_->mu_);
+  // per-entry heartbeat counter (the incarnation surface the conformance
+  // harness reads); -1 when the addr is not in this node's view
+  long long HbOf(const std::string& addr) const GFS_REQUIRES(cluster_->mu_);
 
   // TSA compares capability expressions syntactically, so at a Cluster
   // call site `node->Tick()` requires `node->cluster_->mu_` — an alias
@@ -750,6 +756,18 @@ std::vector<std::string> Node::MemberAddrs() const {
   return out;
 }
 
+std::vector<std::string> Node::SuspectAddrs() const {
+  std::vector<std::string> out;
+  out.reserve(suspects_.size());
+  for (const auto& [addr, t] : suspects_) out.push_back(addr);
+  return out;
+}
+
+long long Node::HbOf(const std::string& addr) const {
+  auto it = members_.find(addr);
+  return it == members_.end() ? -1 : it->second.hb;
+}
+
 // ---------------------------------------------------------------------------
 // Cluster
 
@@ -936,6 +954,26 @@ int Cluster::Membership(int observer, int* out, int cap) {
   int n = std::min(static_cast<int>(ids.size()), cap);
   std::copy(ids.begin(), ids.begin() + n, out);
   return n;
+}
+
+int Cluster::Suspects(int observer, int* out, int cap) {
+  MutexLock lk(mu_);
+  std::vector<int> ids;
+  nodes_[observer]->AssertLockHeld();
+  for (const auto& addr : nodes_[observer]->SuspectAddrs()) {
+    int idx = IdxOf(addr);
+    if (idx >= 0) ids.push_back(idx);
+  }
+  std::sort(ids.begin(), ids.end());
+  int n = std::min(static_cast<int>(ids.size()), cap);
+  std::copy(ids.begin(), ids.begin() + n, out);
+  return n;
+}
+
+long long Cluster::Incarnation(int observer, int subject) {
+  MutexLock lk(mu_);
+  nodes_[observer]->AssertLockHeld();
+  return nodes_[observer]->HbOf(nodes_[subject]->addr());
 }
 
 int Cluster::AliveNodes(int* out, int cap) {
@@ -1267,6 +1305,18 @@ int gfs_round(void* h) { return static_cast<gossipfs::Cluster*>(h)->Round(); }
 
 int gfs_membership(void* h, int observer, int* out, int cap) {
   return static_cast<gossipfs::Cluster*>(h)->Membership(observer, out, cap);
+}
+
+// Conformance-harness read seams (round 19): the observer's current
+// suspect set and its per-entry heartbeat counter for one subject —
+// the same observable surface verdict.py reads off the udp engine's
+// node.rt.suspects / members[addr].hb.
+int gfs_suspects(void* h, int observer, int* out, int cap) {
+  return static_cast<gossipfs::Cluster*>(h)->Suspects(observer, out, cap);
+}
+
+long long gfs_incarnation(void* h, int observer, int subject) {
+  return static_cast<gossipfs::Cluster*>(h)->Incarnation(observer, subject);
 }
 
 int gfs_alive(void* h, int* out, int cap) {

@@ -86,6 +86,18 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "conformance: conformance-fuzzing coverage (gossipfs_tpu/"
+        "conformance/ — the spec-driven adversarial-schedule generator, "
+        "the per-engine injection harness with its reference oracle, "
+        "the verdict matrix and the shrinker).  Fast-lane cases ride "
+        "tier-1, including one short schedule through reference + "
+        "tensor + udp with verdict agreement and the committed "
+        "malformed-datagram repro replay; the native variant is "
+        "additionally marked slow.  `pytest -m conformance` runs just "
+        "this subsystem.",
+    )
+    config.addinivalue_line(
+        "markers",
         "erasure: erasure-plane coverage (gossipfs_tpu/erasure/ — the "
         "GF(256) Reed-Solomon codec, stripe placement/repair planning, "
         "and the redundancy=\"stripe\" byte plane through cluster/cosim/"

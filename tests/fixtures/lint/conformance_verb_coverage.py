@@ -1,0 +1,14 @@
+"""Seeded drift for conformance-verb-coverage: a FAMILIES table whose
+union covers neither the REFUTE wire verb nor the hb_freeze injection —
+the corpus silently fell behind the contract.  Mounted at
+gossipfs_tpu/conformance/schedules.py by the fixture test."""
+
+FAMILIES = {
+    "confirm_expiry": {
+        "doc": "unrefuted suspicion confirms",
+        "verbs": ["JOIN", "LEAVE", "REMOVE", "SUSPECT"],
+        "injections": ["crash", "leave", "join"],
+        "probes": ["SUSPECT->FAILED:confirm_window"],
+        "engines": ["reference", "tensor", "udp", "native"],
+    },
+}

@@ -245,10 +245,14 @@ class TestOutageAbsorption:
         # the matrix covers every committed GOSSIP case; traffic-plane
         # cases (a "traffic" block instead of a "scenario") replay on
         # the durability harness, not the engine matrix — see
-        # campaigns.run_traffic_case_doc and test_erasure.py
+        # campaigns.run_traffic_case_doc and test_erasure.py — and
+        # conformance schedule docs (gossipfs-conformance/v1) replay on
+        # the conformance harness — see tools/conformance.py --replay
+        # and test_conformance.py
         committed = {
             p.name for p in (REPO / "regressions").glob("*.json")
-            if "traffic" not in json.loads(p.read_text())
+            if "traffic" not in (doc := json.loads(p.read_text()))
+            and doc.get("schema") != "gossipfs-conformance/v1"
         }
         assert set(art["cases"]) == committed
         for name, row in art["cases"].items():

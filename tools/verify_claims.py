@@ -227,6 +227,19 @@ CLAIMS = {
     "spec_clean": (
         [sys.executable, "tools/spec_verify.py"],
         lambda d: 1.0 if d["ok"] else 0.0, 1.0, 0.0),
+    # round-19 dynamic conformance (CONFORMANCE_r19.json is the
+    # committed full matrix): the protocol contract EXECUTED — the
+    # pinned CPU slice of the adversarial-schedule corpus (oracle
+    # selfcheck over every family, the tensor column in full, the two
+    # shortest wire-verb families on the asyncio udp engine) must agree
+    # with the reference oracle row-for-row, with every protocol_spec
+    # wire verb + injection covered by the corpus.  ~40 s; the native
+    # column is the slow lane's (tools/conformance.py --matrix).
+    "spec_conformance": (
+        ["env", "JAX_PLATFORMS=cpu", sys.executable,
+         "tools/conformance.py", "--slice"],
+        lambda d: 1.0 if (d["ok"] and d["coverage_complete"]) else 0.0,
+        1.0, 0.0),
     # round-18 erasure plane (ERASURE_r18.json is the committed artifact
     # of the same command): the whole gray-failure cosim matrix (steady /
     # churn / partition-race / rack-kill storm) in redundancy="stripe"
