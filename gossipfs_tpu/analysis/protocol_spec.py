@@ -183,7 +183,62 @@ DISSEMINATION = (
     # The REFUTE answer goes to all peers in both profiles — it is
     # rate-limited at the source instead (RATE_LIMITS above).
     Dissemination("refute", "any", "all_peers", ("udp", "native")),
+    # Delta-piggyback membership refresh (round 20): under the delta
+    # profile the per-round push carries a BOUNDED slice of the view —
+    # recently-changed entries first (per-peer change cursor over a
+    # monotone entry version), round-robin refresh of the stable tail
+    # in any leftover capacity — instead of the full O(N) list.  The
+    # selection rule and the anti-entropy cadence live in DELTA_GOSSIP;
+    # both socket engines are structurally diffed against it by the
+    # spec-delta-dissemination rule.
+    Dissemination("membership_refresh", "delta", "changed+rr_tail+capped",
+                  ("udp", "native"), annotated=True),
 )
+
+# Delta-piggyback dissemination knobs (the membership_refresh/delta row
+# above, written once).  SWIM piggybacks *changes* on dissemination
+# (PAPERS.md #2) and van Renesse's analysis says correctness needs only
+# eventual max-merge (PAPERS.md #1) — so the wire payload shrinks from
+# O(N) to O(cap) per datagram provided a periodic full-list
+# anti-entropy push bounds every entry's refresh gap:
+#
+# * ``wire_mark`` — delta frames are the full-list wire format prefixed
+#   by this marker; the receiver strips it and runs the SAME hardened
+#   per-entry max-merge (a truncated or replayed delta degrades to a
+#   smaller merge, never a protocol error).
+# * ``max_entries`` — the per-datagram cap.  Selection: entries whose
+#   version advanced past the per-peer cursor, most recent first, then
+#   round-robin tail refresh in any leftover slots.  A peer with no
+#   cursor yet (first contact) gets the full list.
+# * ``anti_entropy_every`` — every K-th round (cluster-round aligned)
+#   pushes the FULL list so a lost delta can never wedge convergence;
+#   Pittel's bound stays the reconvergence oracle.  K must stay
+#   strictly below t_fail (2x margin recommended: a 1.33x margin
+#   manufactured a quiet-cluster FP at n=256).
+# * ``freshness`` — in delta mode ONLY, the merge also max-merges the
+#   wire ``ts`` on EQUAL heartbeat counters, clamped to local now.
+#   Without it, ts refreshes only on hb ADVANCE, and a synchronized
+#   anti-entropy round equalizes counters cluster-wide so the NEXT
+#   full push can't refresh many pairs — at n=1024 staleness crossed
+#   t_fail on a quiet cluster (a 7k-FP storm).  Live nodes keep
+#   stamping fresh ts into their own pushes, so the rule propagates
+#   liveness; a crashed node's copies converge to a constant max, so
+#   staleness still grows globally and crash detection is preserved.
+#
+# This dict is a pure literal: the lint extractor reads the defaults
+# without importing the engines, and the engines' own defaults must
+# match it exactly (spec-delta-dissemination goes red on drift).
+DELTA_GOSSIP = {
+    "event": "membership_refresh",
+    "profile": "delta",
+    "bound": "changed+rr_tail+capped",
+    "wire_mark": "<#DELTA#>",
+    "max_entries": 16,
+    "anti_entropy_every": 4,
+    "selection": ("changed_first", "rr_tail", "capped"),
+    "constraint": "anti_entropy_every < t_fail",
+    "freshness": "equal_hb_wire_ts_max_merge",
+}
 
 # Guard formulas, written once.  `period` is the heartbeat period (the
 # tensor engine's unit round); `age` is time since the entry's last

@@ -11,6 +11,15 @@ One rule per drift class, each with a committed seeded-drift fixture
   the ENTRY-broadcast asymmetry at head (detector/udp.py broadcast to
   all peers where native bounded it — the red half of this PR's
   red->green evidence).
+* ``spec-delta-dissemination`` — the delta-piggyback membership
+  refresh (``protocol_spec.DELTA_GOSSIP``) must keep its entry
+  selection rule in BOTH socket engines: changed-since-cursor entries
+  most-recent-first, round-robin refresh of the stable tail, capped
+  per datagram; the anti-entropy full-list cadence cluster-round
+  aligned; the engine defaults byte-identical to the contract dict;
+  and the ``anti_entropy_every < t_fail`` constraint enforced at
+  construction (a refresh gap past the detection window manufactures
+  false positives).
 * ``spec-refute-rate-limit`` — both socket engines must rate-limit the
   REFUTE broadcast to once per period (compare-then-stamp on the
   last-refute clock).
@@ -211,6 +220,193 @@ def spec_dissemination(index) -> list[Finding]:
                 f"bound: the contract row requires '{row.bound}' behind "
                 "a push_random gate with a fanout-sized sample",
             ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# spec-delta-dissemination
+# ---------------------------------------------------------------------------
+
+_CODEC_H = "native/codec.h"
+
+
+def _ctor_defaults(tree: ast.Module, cls_name: str):
+    """{param: literal default} for ``cls_name.__init__``, or None."""
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.ClassDef) and node.name == cls_name):
+            continue
+        for f in node.body:
+            if not (isinstance(f, ast.FunctionDef)
+                    and f.name == "__init__"):
+                continue
+            out: dict[str, object] = {}
+            a = f.args
+            pos = a.args[len(a.args) - len(a.defaults):]
+            for arg, d in list(zip(pos, a.defaults)) + [
+                    (k, v) for k, v in zip(a.kwonlyargs, a.kw_defaults)
+                    if v is not None]:
+                try:
+                    out[arg.arg] = ast.literal_eval(d)
+                except ValueError:
+                    pass
+            return out, f
+    return None, None
+
+
+@rule(
+    "spec-delta-dissemination",
+    "the delta-piggyback membership refresh must match "
+    "protocol_spec.DELTA_GOSSIP in both socket engines: changed-first "
+    "+ rr-tail + capped entry selection, cluster-round-aligned "
+    "anti-entropy cadence, contract-identical defaults, and the "
+    "anti_entropy_every < t_fail constraint enforced at construction",
+    fixture="spec_delta_dissemination.py",
+    fixture_at=_UDP,
+)
+def spec_delta_dissemination(index) -> list[Finding]:
+    findings: list[Finding] = []
+    dg = spec.DELTA_GOSSIP
+    # -- udp engine: wire mark literal
+    tree = index.tree(_UDP)
+    mark = _literal_tuple(tree, "DELTA_MARK")
+    if mark != dg["wire_mark"]:
+        findings.append(Finding(
+            "spec-delta-dissemination", _UDP,
+            _assign_line(tree, "DELTA_MARK"),
+            f"udp DELTA_MARK is {mark!r} where the contract wire mark "
+            f"is {dg['wire_mark']!r} — delta frames would stop "
+            "dispatching through the hardened merge on one side",
+        ))
+    # -- udp engine: the entry-selection rule lives in _encode_delta
+    fn = _func(tree, "_encode_delta")
+    if fn is None:
+        findings.append(Finding(
+            "spec-delta-dissemination", _UDP, 1,
+            "extractor went blind: UdpNode._encode_delta not found — "
+            "the delta entry-selection rule the contract bounds is "
+            "invisible",
+        ))
+    else:
+        attrs = _attrs_in(fn)
+        recent_first = any(
+            isinstance(c, ast.Call)
+            and (dotted(c.func) or "").endswith(".sort")
+            and any(kw.arg == "reverse"
+                    and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True for kw in c.keywords)
+            for c in ast.walk(fn))
+        missing = []
+        if "_sent_ver" not in attrs:
+            missing.append("per-peer change cursor (_sent_ver)")
+        if "ver" not in attrs:
+            missing.append("monotone entry version (ver)")
+        if not recent_first:
+            missing.append("most-recent-first sort (reverse=True)")
+        if "_refresh_pos" not in attrs:
+            missing.append("round-robin stable-tail refresh "
+                           "(_refresh_pos)")
+        if "delta_entries" not in attrs:
+            missing.append("per-datagram cap (delta_entries)")
+        if missing:
+            findings.append(Finding(
+                "spec-delta-dissemination", _UDP, fn.lineno,
+                "udp _encode_delta drifted from the contract selection "
+                f"rule '{dg['bound']}' — lost: " + "; ".join(missing),
+            ))
+    # -- udp engine: anti-entropy cadence in tick (cluster-round mod)
+    fn = _func(tree, "tick")
+    cadence = fn is not None and any(
+        isinstance(node, ast.Compare)
+        and isinstance(node.left, ast.BinOp)
+        and isinstance(node.left.op, ast.Mod)
+        and {"rounds", "anti_entropy_every"} <= _attrs_in(node)
+        for node in ast.walk(fn))
+    if not cadence:
+        findings.append(Finding(
+            "spec-delta-dissemination", _UDP,
+            fn.lineno if fn is not None else 1,
+            "udp tick lost the cluster-round-aligned anti-entropy "
+            "cadence (rounds % anti_entropy_every == 0 pushing the "
+            "FULL list) — a lost delta could wedge convergence forever",
+        ))
+    # -- udp engine: defaults + construction constraint
+    defaults, init = _ctor_defaults(tree, "UdpCluster")
+    if defaults is None:
+        findings.append(Finding(
+            "spec-delta-dissemination", _UDP, 1,
+            "extractor went blind: UdpCluster.__init__ not found — the "
+            "delta knob defaults cannot be diffed against the contract",
+        ))
+    else:
+        for knob, key in (("delta_entries", "max_entries"),
+                          ("anti_entropy_every", "anti_entropy_every")):
+            if defaults.get(knob) != dg[key]:
+                findings.append(Finding(
+                    "spec-delta-dissemination", _UDP, init.lineno,
+                    f"udp default {knob}={defaults.get(knob)!r} drifted "
+                    f"from the contract's {key}={dg[key]} — the two "
+                    "socket engines would ship different wire shapes "
+                    "under identical case configs",
+                ))
+        guarded = any(
+            isinstance(sub, ast.If)
+            and {"anti_entropy_every", "t_fail"} <= {
+                n.id for n in ast.walk(sub.test)
+                if isinstance(n, ast.Name)}
+            and any(isinstance(s, ast.Raise) for s in sub.body)
+            for sub in ast.walk(init))
+        if not guarded:
+            findings.append(Finding(
+                "spec-delta-dissemination", _UDP, init.lineno,
+                f"udp UdpCluster dropped the '{dg['constraint']}' "
+                "construction guard — an anti-entropy gap past the "
+                "detection window manufactures false positives",
+            ))
+    # -- native engine: annotated cadence + selection tokens
+    src = index.source(_ENGINE)
+    pos = src.find("membership_refresh profile=delta")
+    if pos < 0:
+        findings.append(Finding(
+            "spec-delta-dissemination", _ENGINE, 1,
+            "extractor went blind: the @gfs:dissemination "
+            "membership_refresh annotation is gone from the native Tick",
+        ))
+    else:
+        window = src[pos:pos + 2000]
+        if "anti_entropy_every" not in window \
+                or "PushRefresh" not in window:
+            findings.append(Finding(
+                "spec-delta-dissemination", _ENGINE, _line_of(src, pos),
+                "native Tick's annotated delta push lost its shape: the "
+                "annotation must dominate the anti_entropy_every cadence "
+                "and the PushRefresh per-peer selection call",
+            ))
+    for knob, key in (("delta_entries", "max_entries"),
+                      ("anti_entropy_every", "anti_entropy_every")):
+        m = re.search(rf"int\s+{knob}\s*=\s*(\d+)\s*;", src)
+        if m is None or int(m.group(1)) != dg[key]:
+            findings.append(Finding(
+                "spec-delta-dissemination", _ENGINE,
+                _line_of(src, m.start()) if m else 1,
+                f"native default {knob} drifted from the contract's "
+                f"{key}={dg[key]}",
+            ))
+    if not re.search(
+            r"delta\s*&&\s*cfg_\.anti_entropy_every\s*>=\s*cfg_\.t_fail",
+            src):
+        findings.append(Finding(
+            "spec-delta-dissemination", _ENGINE, 1,
+            f"native gfs_configure dropped the '{dg['constraint']}' "
+            "reject — the knob combination that manufactures false "
+            "positives must not start loops",
+        ))
+    csrc = index.source(_CODEC_H)
+    if f'kDeltaMark[] = "{dg["wire_mark"]}"' not in csrc:
+        findings.append(Finding(
+            "spec-delta-dissemination", _CODEC_H, 1,
+            f"native kDeltaMark no longer equals the contract wire "
+            f"mark {dg['wire_mark']!r}",
+        ))
     return findings
 
 

@@ -139,6 +139,25 @@ def _case_plan(doc: dict):
     return n, sc, crash_at, rounds, victims
 
 
+def _wire_knobs(c: dict) -> dict:
+    """Optional dissemination knobs a case config may carry (round 20).
+
+    ``delta=1`` switches the engine's membership refresh to the
+    delta-piggyback profile (``protocol_spec.DELTA_GOSSIP``) with the
+    case's ``delta_entries`` / ``anti_entropy_every``; absent, the
+    engines keep the committed full-list wire format, so every existing
+    case file runs bit-identically.  Both socket engines accept the same
+    keys — one derivation, like ``_case_plan``.
+    """
+    if not int(c.get("delta", 0)):
+        return {}
+    return {
+        "delta": True,
+        "delta_entries": int(c.get("delta_entries", 16)),
+        "anti_entropy_every": int(c.get("anti_entropy_every", 4)),
+    }
+
+
 def _suspicion_params(c: dict):
     if int(c.get("t_suspect", 0)) <= 0:
         return None
@@ -225,10 +244,25 @@ def _free_udp_base(n: int) -> int:
     return _free_port_base(n, tcp=False)
 
 
+def _wire_delta(v0: dict, v1: dict, rounds: int) -> dict:
+    """Measured-window wire accounting (the delta-gossip A/B surface):
+    payload bytes and frame counts actually handed to the transport
+    between two vitals snapshots, normalized per round."""
+    bytes_sent = v1["bytes_sent"] - v0["bytes_sent"]
+    return {
+        "rounds": rounds,
+        "bytes_sent": bytes_sent,
+        "bytes_per_round": bytes_sent / max(rounds, 1),
+        "frames_full": v1["frames_full"] - v0["frames_full"],
+        "frames_delta": v1["frames_delta"] - v0["frames_delta"],
+    }
+
+
 async def _udp_case(doc: dict, trace: str, period: float,
-                    warmup_timeout: float) -> dict[int, int]:
+                    warmup_timeout: float):
     """Drive one case on an in-process UdpCluster; returns the crash
-    schedule ({victim: round}) for the monitor's TTD accounting."""
+    schedule ({victim: round}) for the monitor's TTD accounting plus
+    the measured window's wire accounting."""
     from gossipfs_tpu.detector.udp import UdpCluster
     from gossipfs_tpu.obs.recorder import FlightRecorder
 
@@ -247,8 +281,9 @@ async def _udp_case(doc: dict, trace: str, period: float,
         # log-fanout push, gossip-only removal): verdict agreement must
         # compare PROTOCOLS, not the reference ring's O(N)-tick event
         # propagation (see UdpCluster's push notes)
-        push="random", fanout=SimConfig.log_fanout(n),
+        push="random", fanout=int(c.get("fanout", SimConfig.log_fanout(n))),
         remove_broadcast=False,
+        **_wire_knobs(c),
     )
     await cluster.start_all()
     try:
@@ -281,13 +316,15 @@ async def _udp_case(doc: dict, trace: str, period: float,
                                            for v in victims})
         cluster.attach_recorder(rec)
         cluster.load_scenario(sc)
+        v0 = cluster.vitals()
         for r in range(rounds):
             if r == crash_at:
                 for v in victims:
                     cluster.crash(v)
             await cluster.run(1, emit_round_ticks=True)
+        wire = _wire_delta(v0, cluster.vitals(), rounds)
         rec.close()
-        return {v: crash_at for v in victims}
+        return {v: crash_at for v in victims}, wire
     finally:
         cluster.stop_all()
 
@@ -312,12 +349,12 @@ def run_case_udp(doc: dict, *, period: float | None = None,
         period = udp_period(int(doc["config"]["n"]))
     if trace is None:
         trace = tempfile.mktemp(prefix="udp_case_", suffix=".jsonl")
-    crash_rounds = asyncio.run(
+    crash_rounds, wire = asyncio.run(
         _udp_case(doc, trace, period, warmup_timeout))
     row = _monitor_row(trace, MonitorParams.from_dict(doc["monitor"]),
                        int(doc["config"]["n"]),
                        crash_rounds=crash_rounds)
-    row.update(engine="udp", trace=str(trace), period=period)
+    row.update(engine="udp", trace=str(trace), period=period, wire=wire)
     return row
 
 
@@ -346,7 +383,7 @@ def native_period(n: int) -> float:
 
 def run_case_native(doc: dict, *, period: float | None = None,
                     trace: str | None = None,
-                    warmup_timeout: float = 120.0) -> dict:
+                    warmup_timeout: float | None = None) -> dict:
     """One case on the native C++ epoll engine (real localhost
     datagrams, one OS thread) — the cohort-exact lane: the asyncio
     engine honestly melts past n~64 (UDPCAMPAIGN_r14), so committed
@@ -372,6 +409,10 @@ def run_case_native(doc: dict, *, period: float | None = None,
     n, sc, crash_at, rounds, victims = _case_plan(doc)
     if period is None:
         period = native_period(n)
+    if warmup_timeout is None:
+        # scales with n for the same reason as run_ab_cell: the seeded
+        # cold start's one-time staleness churn grows with cohort size
+        warmup_timeout = max(120.0, 0.75 * n)
     if trace is None:
         trace = tempfile.mktemp(prefix="native_case_", suffix=".jsonl")
 
@@ -379,8 +420,10 @@ def run_case_native(doc: dict, *, period: float | None = None,
         n, base_port=_free_udp_base(n), period=period,
         t_fail=int(c["t_fail"]),
         t_cooldown=max(12, int(c["t_fail"]) + 4), fresh_cooldown=True,
-        push="random", fanout=SimConfig.log_fanout(n),
+        push="random", fanout=int(c.get("fanout", SimConfig.log_fanout(n))),
         remove_broadcast=False, suspicion=_suspicion_params(c),
+        loops=int(c.get("loops", 1)),
+        **_wire_knobs(c),
     )
     try:
         det.seed_full_membership()
@@ -399,12 +442,14 @@ def run_case_native(doc: dict, *, period: float | None = None,
         # absolute round attach_recorder rebased to anchors both
         r0 = det.attach_recorder(rec)
         det.load_scenario(sc, round0=r0)
+        v0 = det.vitals()
         det.advance((r0 + crash_at) - det.round)
         for v in victims:
             det.crash(v)
         remaining = (r0 + rounds) - det.round
         if remaining > 0:
             det.advance(remaining)
+        wire = _wire_delta(v0, det.vitals(), rounds)
         # stop the loop BEFORE draining: the drain's host-side parse is
         # seconds of CPU the 1-core epoll thread would otherwise lose —
         # enough wall time to stale entries and cascade manufactured
@@ -419,8 +464,108 @@ def run_case_native(doc: dict, *, period: float | None = None,
                        crash_rounds={v: crash_at for v in victims})
     _, events = load_stream(trace)
     row.update(engine="native", trace=str(trace), period=period,
-               tick_ms=latency_histogram(events))
+               tick_ms=latency_histogram(events), wire=wire)
     return row
+
+
+def run_ab_cell(n: int, *, delta: bool, loops: int = 1,
+                rounds: int = 24, period: float | None = None,
+                fanout: int | None = None, t_fail: int = 12,
+                delta_entries: int = 16, anti_entropy_every: int = 6,
+                settle: int | None = None,
+                warmup_timeout: float | None = None) -> dict:
+    """One quiet-cluster perf cell on the native engine — the delta
+    A/B's measurement unit (``tools/campaign.py --ab``): warm a fresh
+    n-node cluster in (delta, loops) mode, run ``rounds`` steady-state
+    rounds, and report the wire accounting (bytes/round, full vs delta
+    frame split) plus the per-round ``tick_ms`` histogram.  No faults:
+    the verdict plane is the matrix's job; this cell isolates the two
+    payoff observables — payload bytes and merge-pass latency.
+
+    ``fanout`` defaults to max(16, log-fanout): delta mode concentrates
+    a stable entry's refresh opportunities on anti-entropy rounds, so
+    the per-node miss floor is ~e^-fanout per AE round — 16 keeps the
+    expected misses over the run well under one node even at n=1024,
+    and BOTH arms run the same fanout so the A/B isolates the wire
+    format, not the push width.
+
+    ``settle`` rounds run between warmup and the measurement window so
+    per-peer delta cursors populate first — a cursor-less peer gets a
+    full list, and with random push each peer pair first meets after
+    ~n/fanout rounds in expectation, so an unsettled window measures
+    mostly first-contact fulls instead of the steady-state delta mix.
+    Defaults to 2*ceil(n/fanout) (residual cursor-less fraction ~e^-2);
+    both arms settle identically so the A/B stays symmetric.
+
+    ``t_fail`` defaults to 2x ``anti_entropy_every``: delta mode only
+    GUARANTEES an entry refresh on anti-entropy rounds (the changed-
+    first slots are recency-biased and the rr tail gets leftover
+    capacity only), so the staleness budget must clear the AE cadence
+    with margin — t_fail >= 2*anti_entropy_every keeps a single lost
+    AE push from crossing the suspicion threshold on a quiet cluster."""
+    import time as _time
+
+    from gossipfs_tpu.config import SimConfig
+    from gossipfs_tpu.native import NativeUdpDetector, latency_histogram
+    from gossipfs_tpu.obs.recorder import FlightRecorder, load_stream
+
+    if period is None:
+        period = native_period(n)
+    if fanout is None:
+        fanout = max(16, SimConfig.log_fanout(n))
+    if settle is None:
+        settle = 2 * -(-n // fanout)
+    if warmup_timeout is None:
+        # the seeded cold start pays a one-time staleness cascade in
+        # delta mode (every entry starts equally stale and the bounded
+        # frames throttle first refreshes): n=1024 warms in ~120s of
+        # churn that then fully quenches, so the gate scales with n
+        warmup_timeout = max(300.0, 0.75 * n)
+    knobs = {}
+    if delta:
+        knobs = dict(delta=True, delta_entries=delta_entries,
+                     anti_entropy_every=anti_entropy_every)
+    trace = tempfile.mktemp(prefix="ab_cell_", suffix=".jsonl")
+    det = NativeUdpDetector(
+        n, base_port=_free_udp_base(n), period=period, t_fail=t_fail,
+        t_cooldown=t_fail + 4, fresh_cooldown=True, push="random",
+        fanout=fanout, remove_broadcast=False, loops=loops, **knobs)
+    try:
+        det.seed_full_membership()
+        deadline = _time.monotonic() + warmup_timeout
+        while not det.warm():
+            if _time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"ab cell (n={n}, delta={delta}, loops={loops}) "
+                    f"did not converge within {warmup_timeout}s")
+            _time.sleep(period)
+        if settle > 0:
+            det.advance(settle)
+        rec = FlightRecorder(trace, source="native-ab", n=n, case="ab")
+        det.attach_recorder(rec)
+        v0 = det.vitals()
+        det.advance(rounds)
+        v1 = det.vitals()
+        wire = _wire_delta(v0, v1, rounds)
+        det.stop()
+        det.pump_obs()
+        rec.close()
+    finally:
+        det.close()
+    _, events = load_stream(trace)
+    cell = {
+        "n": n, "delta": bool(delta), "loops": loops, "period": period,
+        "fanout": fanout, "rounds": rounds, "settle": settle,
+        "t_fail": t_fail,
+        "false_positives": (v1["false_positives"]
+                            - v0["false_positives"]),
+        "n_alive": v1["n_alive"],
+        "wire": wire, "tick_ms": latency_histogram(events),
+    }
+    if delta:
+        cell["delta_entries"] = delta_entries
+        cell["anti_entropy_every"] = anti_entropy_every
+    return cell
 
 
 # ---------------------------------------------------------------------------

@@ -44,7 +44,8 @@ import time
 
 from gossipfs_tpu.analysis import protocol_spec
 from gossipfs_tpu.conformance.schedules import TRACKED_KINDS, validate
-from gossipfs_tpu.detector.udp import CMD_SEP, ENTRY_SEP, FIELD_SEP
+from gossipfs_tpu.detector.udp import (CMD_SEP, DELTA_MARK, ENTRY_SEP,
+                                       FIELD_SEP)
 from gossipfs_tpu.scenarios.schedule import CorrelatedOutage, FaultScenario
 from gossipfs_tpu.suspicion.params import SuspicionParams
 from gossipfs_tpu.suspicion.runtime import SuspicionRuntime
@@ -115,7 +116,28 @@ def malformed_payload(style: str, about_addr: str | None = None,
     if style == "mixed_refresh":
         return (f"{about_addr}{FIELD_SEP}{hb}{FIELD_SEP}0.0"
                 f"{ENTRY_SEP}x{FIELD_SEP}")
+    # delta wire format (round 20, protocol_spec.DELTA_GOSSIP).  Every
+    # engine must dispatch a marked frame through the SAME hardened
+    # max-merge as a full list, whatever its own dissemination mode.
+    if style == "truncated_delta":
+        # a delta frame cut mid-entry: the valid advance in front must
+        # still merge (hardened salvage), the truncated tail is skipped
+        return (f"{DELTA_MARK}{about_addr}{FIELD_SEP}{hb}{FIELD_SEP}0.0"
+                f"{ENTRY_SEP}x{FIELD_SEP}")
+    if style == "delta_refresh":
+        # a well-formed single-entry delta advance — the race/zombie
+        # probes' carrier (delta_stale_race, delta_unknown_member)
+        return f"{DELTA_MARK}{about_addr}{FIELD_SEP}{hb}{FIELD_SEP}0.0"
+    if style == "stale_full_replay":
+        # a replayed full-list fragment with a STALE counter: max-merge
+        # must neither regress the entry nor re-stamp its freshness
+        return f"{about_addr}{FIELD_SEP}{STALE_HB}{FIELD_SEP}0.0"
     raise ValueError(f"unknown malformed style {style!r}")
+
+
+#: malformed styles whose payload carries a live incarnation advance for
+#: ``about`` (the drivers compute hb = current + hb_boost at fire time)
+_ADVANCE_STYLES = ("mixed_refresh", "truncated_delta", "delta_refresh")
 
 
 def _steps_by_round(case: dict) -> dict[int, list[dict]]:
@@ -187,6 +209,11 @@ class _RefNode:
             elif verb == "REFUTE":
                 self._on_refute(arg)
             # unknown verbs: silent no-op (codec hardening contract)
+        elif payload.startswith(DELTA_MARK):
+            # delta frame: strip the marker, run the SAME hardened
+            # max-merge (the udp/native dispatch rule — a truncated or
+            # replayed delta degrades to a smaller merge, never an error)
+            self._merge(self._decode(payload[len(DELTA_MARK):]))
         else:
             self._merge(self._decode(payload))
 
@@ -436,7 +463,7 @@ class ReferenceEngine:
                     payload = wire_verb(step["verb"], about_addr, hb=hb)
                 else:
                     hb = None
-                    if step["style"] == "mixed_refresh":
+                    if step["style"] in _ADVANCE_STYLES:
                         m = self.nodes[t].members.get(about_addr)
                         hb = (m.hb if m else 0) + int(step["hb_boost"])
                     payload = malformed_payload(step["style"],
@@ -712,7 +739,7 @@ async def _udp_step(cluster, inj: _Injector, step: dict) -> None:
                 payload = wire_verb(step["verb"], about_addr, hb=hb)
             else:
                 hb = None
-                if step["style"] == "mixed_refresh":
+                if step["style"] in _ADVANCE_STYLES:
                     m = cluster.nodes[t].members.get(about_addr)
                     hb = (int(m.hb) if m else 0) + int(step["hb_boost"])
                 payload = malformed_payload(step["style"],
@@ -823,7 +850,7 @@ def _native_step(det, inj: _Injector, step: dict) -> None:
             payload = wire_verb(step["verb"], about_addr, hb=hb)
         else:
             hb = None
-            if step["style"] == "mixed_refresh":
+            if step["style"] in _ADVANCE_STYLES:
                 cur = det.incarnation(t, about)
                 hb = max(cur, 0) + int(step["hb_boost"])
             payload = malformed_payload(step["style"],

@@ -36,7 +36,12 @@ after each engine's warmup):
     heartbeats, unknown verbs, and ``mixed_refresh``: a valid
     incarnation-advance entry with a trailing malformed chunk — a
     hardened codec salvages the valid entry, a brittle one drops the
-    whole datagram).
+    whole datagram).  Round 20 adds the delta wire format's styles:
+    ``truncated_delta`` (a marked frame cut mid-entry), ``delta_refresh``
+    (a clean single-entry delta advance — the race/zombie carrier) and
+    ``stale_full_replay`` (a full-list fragment with a stale counter) —
+    the dispatch contract says marked frames run the SAME hardened
+    max-merge as full lists, whatever the receiver's dissemination mode.
 
 The cluster profile is the campaign/north-star protocol mode shared by
 ``campaigns/engines.py`` (random fanout push, gossip-only removal,
@@ -169,6 +174,39 @@ FAMILIES = {
         "injections": ["crash", "hb_freeze"],
         "probes": ["MEMBER->SUSPECT:stale", "SUSPECT->MEMBER:refute_evidence",
                    "SUSPECT->FAILED:confirm_window"],
+        "engines": ["reference", "udp", "native"],
+    },
+    "truncated_delta": {
+        "doc": "delta wire hardening (round 20): a delta frame cut "
+               "mid-entry — the valid incarnation advance in front must "
+               "still merge and deliver the refute, the truncated tail "
+               "is skipped (a lost/garbled delta degrades to a smaller "
+               "merge, never a protocol error)",
+        "verbs": [],
+        "injections": ["crash", "hb_freeze"],
+        "probes": ["MEMBER->SUSPECT:stale", "SUSPECT->MEMBER:refute_evidence",
+                   "SUSPECT->FAILED:confirm_window"],
+        "engines": ["reference", "udp", "native"],
+    },
+    "delta_stale_race": {
+        "doc": "a stale full-list replay racing a delta advance about "
+               "the same member: max-merge must keep the advance "
+               "whatever the arrival order — an engine that regresses "
+               "the counter never re-stales and the confirm dies",
+        "verbs": [],
+        "injections": ["crash", "hb_freeze"],
+        "probes": ["MEMBER->SUSPECT:stale", "SUSPECT->MEMBER:refute_evidence",
+                   "SUSPECT->FAILED:confirm_window"],
+        "engines": ["reference", "udp", "native"],
+    },
+    "delta_unknown_member": {
+        "doc": "a delta frame about a member the receivers no longer "
+               "list (graceful leave, mid-cooldown): the fail-list "
+               "suppression must beat the merge-add — no zombie "
+               "resurrection from a marked frame",
+        "verbs": [],
+        "injections": ["leave"],
+        "probes": ["MEMBER->FAILED:leave_or_remove"],
         "engines": ["reference", "udp", "native"],
     },
 }
@@ -420,6 +458,86 @@ def _gen_malformed_codec(seed: int) -> dict:
     return case
 
 
+def _gen_truncated_delta(seed: int) -> dict:
+    rng = random.Random(seed)
+    s = _subject(rng)
+    case = _base("truncated_delta", seed, rounds=34)
+    case["steps"] = [
+        {"round": 2, "op": "crash", "node": s},
+        # mid-suspect-window: a DELTA frame carrying a valid incarnation
+        # advance for the crashed subject, cut mid-entry after it.  The
+        # dispatch contract: a marked frame runs the SAME hardened
+        # max-merge as a full list, so the advance is salvaged
+        # (refute-by-advance revives s) and the truncated chunk is
+        # skipped — a brittle delta decoder drops the whole frame and
+        # the revive checkpoint goes red (timings mirror malformed_codec)
+        {"round": 13, "op": "malformed", "style": "truncated_delta",
+         "about": s, "hb_boost": 100, "to": "live", "copies": 2},
+    ]
+    case["tracked"] = [s]
+    case["expect"] = {str(s): {"final": "gone", "forbid": [],
+                               "optional": []}}
+    case["checkpoints"] = [
+        {"round": 15, "status": {str(s): "member"}},
+        {"round": 24, "status": {str(s): "suspect"}},
+    ]
+    return case
+
+
+def _gen_delta_stale_race(seed: int) -> dict:
+    rng = random.Random(seed)
+    s = _subject(rng)
+    case = _base("delta_stale_race", seed, rounds=34)
+    case["steps"] = [
+        {"round": 2, "op": "crash", "node": s},
+        # the race: a clean delta advance for the crashed subject AND a
+        # replayed stale full-list fragment (hb=1) about the same
+        # member, the stale copy injected LAST.  Max-merge is
+        # order-free: the advance must survive (revive at ~13), the
+        # stale replay must neither regress the counter nor re-stamp
+        # freshness.  An engine that adopts the stale counter leaves
+        # hb=1 — inside the detection grace, so s never re-stales and
+        # the suspect checkpoint goes red
+        {"round": 13, "op": "malformed", "style": "delta_refresh",
+         "about": s, "hb_boost": 100, "to": "live", "copies": 2},
+        {"round": 13, "op": "malformed", "style": "stale_full_replay",
+         "about": s, "to": "live", "copies": 2},
+    ]
+    case["tracked"] = [s]
+    case["expect"] = {str(s): {"final": "gone", "forbid": [],
+                               "optional": []}}
+    case["checkpoints"] = [
+        {"round": 15, "status": {str(s): "member"}},
+        {"round": 24, "status": {str(s): "suspect"}},
+    ]
+    return case
+
+
+def _gen_delta_unknown_member(seed: int) -> dict:
+    rng = random.Random(seed)
+    s = _subject(rng)
+    # rounds end BEFORE the fail-list cooldown expires (~9-10), like
+    # leave_broadcast: past expiry a re-injected advance legitimately
+    # re-adds (the cooldown intentionally scopes zombie suppression)
+    case = _base("delta_unknown_member", seed, rounds=9)
+    case["steps"] = [
+        {"round": 3, "op": "leave", "node": s},
+        # mid-cooldown: a clean delta advance about the departed member,
+        # whom no receiver lists any more.  The merge-add guard is the
+        # probe: fail-listed entries are NOT resurrected, marked frame
+        # or not — a brittle engine re-adds the zombie and the gone
+        # checkpoint goes red
+        {"round": 6, "op": "malformed", "style": "delta_refresh",
+         "about": s, "hb_boost": 100, "to": "live", "copies": 2},
+    ]
+    case["tracked"] = [s]
+    case["expect"] = {str(s): {"final": "gone",
+                               "forbid": ["suspect", "confirm", "refute"],
+                               "optional": []}}
+    case["checkpoints"] = [{"round": 8, "status": {str(s): "gone"}}]
+    return case
+
+
 _GENERATORS = {
     "refute_race": _gen_refute_race,
     "confirm_expiry": _gen_confirm_expiry,
@@ -430,6 +548,9 @@ _GENERATORS = {
     "stale_refute_replay": _gen_stale_refute_replay,
     "remove_poison": _gen_remove_poison,
     "malformed_codec": _gen_malformed_codec,
+    "truncated_delta": _gen_truncated_delta,
+    "delta_stale_race": _gen_delta_stale_race,
+    "delta_unknown_member": _gen_delta_unknown_member,
 }
 
 

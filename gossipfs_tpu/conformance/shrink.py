@@ -6,6 +6,14 @@ minimal repro names the one message ordering the engines disagree on.
 (the caller decides what "fails" means — usually "this engine's verdict
 row is not ok", see ``tools/conformance.py``) and greedily minimizes:
 
+  0. search NEIGHBOURING CORPUS SEEDS first: when the doc carries its
+     ``(family, seed)`` provenance, regenerate the draws at seeds within
+     ``seed_radius`` and restart from any failing draw that is smaller
+     (fewer steps, then fewer rounds, then fewer total datagram copies,
+     then the lowest seed as a canonical tiebreak).  Generator draws
+     differ only in which nodes the rng picks, so a neighbouring seed
+     can hand ddmin a strictly easier starting point for free — one
+     engine run per candidate, before the O(steps^2) pass begins;
   1. drop schedule steps one at a time, to fixpoint (classic ddmin with
      chunk size 1 — schedules are tens of steps, not thousands, so the
      O(steps^2) pass costs less than one socket-engine run);
@@ -25,7 +33,8 @@ from __future__ import annotations
 import copy
 
 from gossipfs_tpu.conformance.harness import run_case_reference
-from gossipfs_tpu.conformance.schedules import serialize, validate
+from gossipfs_tpu.conformance.schedules import (FAMILIES, generate,
+                                                serialize, validate)
 
 
 def _try(candidate: dict, still_fails) -> bool:
@@ -36,7 +45,32 @@ def _try(candidate: dict, still_fails) -> bool:
     return bool(still_fails(candidate))
 
 
-def shrink(case: dict, still_fails, *, settle_pad: int = 6) -> dict:
+def _size(case: dict):
+    """Smaller-is-better ordering for whole draws: steps dominate (each
+    is a datagram the repro must explain), rounds break ties, then total
+    injected copies; the seed itself is last so equal-size failing draws
+    canonicalize to the lowest seed in the neighbourhood."""
+    return (len(case["steps"]), case["rounds"],
+            sum(int(s.get("copies", 1)) for s in case["steps"]),
+            case.get("seed", 0))
+
+
+def _seed_pass(case: dict, still_fails, radius: int) -> dict:
+    fam, seed = case.get("family"), case.get("seed")
+    if radius <= 0 or fam not in FAMILIES or not isinstance(seed, int):
+        return case
+    best = case
+    for s in range(max(0, seed - radius), seed + radius + 1):
+        if s == seed:
+            continue
+        cand = generate(fam, seed=s)
+        if _size(cand) < _size(best) and _try(cand, still_fails):
+            best = cand
+    return best
+
+
+def shrink(case: dict, still_fails, *, settle_pad: int = 6,
+           seed_radius: int = 2) -> dict:
     """Minimize ``case`` while ``still_fails(candidate)`` stays true.
 
     The predicate is called on structurally-valid candidates only and
@@ -47,6 +81,10 @@ def shrink(case: dict, still_fails, *, settle_pad: int = 6) -> dict:
     case = copy.deepcopy(case)
     if not _try(case, still_fails):
         raise ValueError("shrink needs a failing case to start from")
+
+    # 0) seed-neighbourhood search — restart ddmin from the smallest
+    # failing draw within seed_radius of this one's corpus seed
+    case = _seed_pass(case, still_fails, seed_radius)
 
     # 1) drop steps to fixpoint
     changed = True

@@ -79,6 +79,13 @@ class _Env:
         self.push = "ring"
         self.fanout = 3
         self.remove_broadcast = True
+        # delta dissemination (round 20, protocol_spec DELTA_GOSSIP)
+        # stays OFF in the deployment: the daemons keep the committed
+        # full-list wire format; the knobs exist because UdpNode reads
+        # them from its host every tick
+        self.delta = False
+        self.delta_entries = 16
+        self.anti_entropy_every = 4
         # suspicion subsystem (suspicion/): SuspicionParams pushed over
         # the control plane (SuspicionLoad RPC); the UdpNode reads this
         # every tick, exactly like the in-process UdpCluster's attribute

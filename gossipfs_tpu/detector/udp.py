@@ -24,14 +24,23 @@ from gossipfs_tpu.detector.api import DetectionEvent
 ENTRY_SEP = "<#ENTRY#>"
 FIELD_SEP = "<#INFO#>"
 CMD_SEP = "<CMD>"
+# Delta-piggyback frame marker (protocol_spec.DELTA_GOSSIP wire_mark):
+# a delta payload is the full-list wire format prefixed by this token;
+# the receiver strips it and runs the SAME hardened per-entry max-merge.
+DELTA_MARK = "<#DELTA#>"
 
 
 class _Member:
-    __slots__ = ("hb", "ts")
+    __slots__ = ("hb", "ts", "ver")
 
-    def __init__(self, hb: float, ts: float):
+    def __init__(self, hb: float, ts: float, ver: int = 0):
         self.hb = int(hb)
         self.ts = ts
+        # monotone change version (delta gossip): stamped from the
+        # owner node's counter whenever this entry materially changes —
+        # add, heartbeat/incarnation advance, self bump.  Per-peer
+        # cursors compare against it to pick the changed-first slice.
+        self.ver = ver
 
 
 class _NodeProtocol(asyncio.DatagramProtocol):
@@ -69,6 +78,13 @@ class UdpNode:
         # per-node stream for the random-push topology draw (the
         # north-star campaign profile; unused in the reference ring mode)
         self._rng = random.Random(0x5EED ^ (idx * 2654435761))
+        # delta gossip state (protocol_spec DELTA_GOSSIP): the node's
+        # monotone change counter, the per-peer change cursors (last
+        # version pushed to that peer), and the round-robin refresh
+        # cursor over the stable tail
+        self._ver = 0
+        self._sent_ver: dict[str, int] = {}
+        self._refresh_pos = 0
 
     def _suspicion(self):
         """The armed SuspicionRuntime, tracking the host's params."""
@@ -99,6 +115,9 @@ class UdpNode:
         )
         self.alive = True
         self.members = {self.addr: _Member(0, self._now())}
+        self._ver = 0
+        self._sent_ver = {}
+        self._refresh_pos = 0
         self._hb_task = asyncio.create_task(self._heartbeat_loop())
 
     def stop(self, graceful: bool = False) -> None:
@@ -129,6 +148,11 @@ class UdpNode:
         allowed = getattr(self.cluster, "message_allowed", None)
         if allowed is not None and not allowed(self.idx, peer_addr):
             return
+        # wire accounting (the delta-gossip A/B surface): payload bytes
+        # actually handed to the transport, split full-list vs delta
+        account = getattr(self.cluster, "account_send", None)
+        if account is not None:
+            account(msg)
         host, port = peer_addr.rsplit(":", 1)
         self.transport.sendto(msg.encode(), (host, int(port)))
 
@@ -138,8 +162,47 @@ class UdpNode:
             f"{a}{FIELD_SEP}{m.hb}{FIELD_SEP}{m.ts}" for a, m in self.members.items()
         )
 
+    def _bump(self) -> int:
+        """Advance the node's change counter (delta gossip versioning)."""
+        self._ver += 1
+        return self._ver
+
+    def _encode_delta(self, peer: str) -> str:
+        """One bounded delta frame for ``peer`` — the protocol_spec
+        DELTA_GOSSIP entry-selection rule: entries whose version
+        advanced past the per-peer cursor, most recently changed first,
+        then round-robin refresh of the stable tail in any leftover
+        capacity, capped at ``delta_entries``.  A peer with no cursor
+        yet (first contact) gets the full list instead."""
+        c = self.cluster
+        cursor = self._sent_ver.get(peer)
+        self._sent_ver[peer] = self._ver
+        if cursor is None:
+            return self._encode()
+        cap = c.delta_entries
+        changed = [(a, m) for a, m in self.members.items() if m.ver > cursor]
+        changed.sort(key=lambda am: am[1].ver, reverse=True)
+        picks = changed[:cap]
+        if len(picks) < cap and len(self.members) > len(picks):
+            # round-robin refresh of the stable tail
+            addrs = sorted(self.members)
+            seen = {a for a, _ in picks}
+            taken = 0
+            for k in range(len(addrs)):
+                if len(picks) >= cap:
+                    break
+                a = addrs[(self._refresh_pos + k) % len(addrs)]
+                if a not in seen:
+                    picks.append((a, self.members[a]))
+                    seen.add(a)
+                taken = k + 1
+            self._refresh_pos = (self._refresh_pos + taken) % len(addrs)
+        return DELTA_MARK + ENTRY_SEP.join(
+            f"{a}{FIELD_SEP}{m.hb}{FIELD_SEP}{m.ts}" for a, m in picks
+        )
+
     @staticmethod
-    def _decode(payload: str) -> list[tuple[str, int]]:
+    def _decode(payload: str) -> list[tuple[str, int, float | None]]:
         out = []
         for chunk in payload.split(ENTRY_SEP):
             parts = chunk.split(FIELD_SEP)
@@ -156,7 +219,16 @@ class UdpNode:
                     hb = int(float(parts[1]))
                 except ValueError:
                     continue
-                out.append((parts[0], hb))
+                # the wire ts (delta mode merges it on EQUAL counters);
+                # an unparsable ts degrades the entry to hb-only, it
+                # does not drop it
+                ts = None
+                if len(parts) >= 3:
+                    try:
+                        ts = float(parts[2])
+                    except ValueError:
+                        ts = None
+                out.append((parts[0], hb, ts))
         return out
 
     # -- receive dispatch (GetMsg, slave.go:207-248) ------------------------
@@ -173,6 +245,11 @@ class UdpNode:
                 self._on_suspect(arg)
             elif verb == "REFUTE":
                 self._on_refute(arg)
+        elif payload.startswith(DELTA_MARK):
+            # delta frame: strip the marker and run the SAME hardened
+            # per-entry max-merge — a truncated or replayed delta
+            # degrades to a smaller merge, never a protocol error
+            self._merge(self._decode(payload[len(DELTA_MARK):]))
         else:
             self._merge(self._decode(payload))
 
@@ -210,6 +287,7 @@ class UdpNode:
             self._last_refute_t = now
             me.hb += 1
             me.ts = now
+            me.ver = self._bump()
             msg = f"{self.addr}{FIELD_SEP}{me.hb}{CMD_SEP}REFUTE"
             for peer in list(self.members):
                 if peer != self.addr:
@@ -234,6 +312,7 @@ class UdpNode:
             return
         if hb > m.hb:
             m.hb = hb
+            m.ver = self._bump()
         m.ts = self._now()
         rt = self._suspicion()
         if rt is not None and rt.refute(addr):
@@ -243,7 +322,7 @@ class UdpNode:
         """Introducer path: append + push full list to everyone
         (addNewMember, slave.go:250-274)."""
         if addr not in self.members:
-            self.members[addr] = _Member(0, self._now())
+            self.members[addr] = _Member(0, self._now(), self._bump())
         msg = self._encode()
         for peer in list(self.members):
             if peer != self.addr:
@@ -270,22 +349,39 @@ class UdpNode:
             # pending suspicion (a confirm already popped it, uncounted)
             self._sus[1].drop(addr)
 
-    def _merge(self, remote: list[tuple[str, int]]) -> None:
+    def _merge(self, remote: list[tuple[str, int, float | None]]) -> None:
         """Anti-entropy max-merge with local stamping (slave.go:414-440)."""
         now = self._now()
         rt = self._sus[1] if self._sus is not None else None
-        for addr, hb in remote:
+        delta_mode = getattr(self.cluster, "delta", False)
+        for addr, hb, wire_ts in remote:
             local = self.members.get(addr)
             if local is not None:
                 if hb > local.hb:
                     local.hb = hb
                     local.ts = now
+                    local.ver = self._bump()
                     if rt is not None and rt.refute(addr):
                         # refute-by-advance: a fresher counter observed
                         # while SUSPECT cancels the pending failure
                         self._obs("refute", addr)
+                elif (delta_mode and hb == local.hb
+                      and wire_ts is not None and wire_ts > local.ts):
+                    # delta mode only: freshness rides the wire on EQUAL
+                    # counters (the native Merge's twin).  Bounded frames
+                    # break the full-list assumption that every round
+                    # max-merges fanout fresh draws — after a synchronized
+                    # anti-entropy round most nodes hold the SAME hb for
+                    # an entry, so the next full push carries no advance
+                    # and local-stamp-only ts ages toward t_fail on a
+                    # QUIET cluster.  Max-merging the wire ts closes it
+                    # without breaking crash detection (a crashed node's
+                    # copies converge to a constant max, so staleness
+                    # still grows globally); clamped to now so a forged
+                    # future ts cannot suppress detection.
+                    local.ts = min(wire_ts, now)
             elif addr not in self.fail_list:
-                self.members[addr] = _Member(hb, now)
+                self.members[addr] = _Member(hb, now, self._bump())
 
     # -- heartbeat tick (HeartBeat, slave.go:499-544) -----------------------
     async def _heartbeat_loop(self) -> None:
@@ -316,6 +412,7 @@ class UdpNode:
         if me is not None:
             me.hb += 1
             me.ts = now
+            me.ver = self._bump()
         # detection (slave.go:460-482); with suspicion armed (suspicion/)
         # a stale member passes through SUSPECT first: the first stale
         # tick broadcasts SUSPECT (so the subject can actively refute by
@@ -400,7 +497,24 @@ class UdpNode:
         for addr in list(self.fail_list):
             if self.fail_list[addr] < now - t_cool:
                 del self.fail_list[addr]
-        msg = self._encode()
+        # membership refresh push.  Delta mode (protocol_spec
+        # membership_refresh/delta, round 20): every anti_entropy_every-th
+        # round — cluster-round aligned, all nodes tick on the same
+        # clock — pushes the FULL list so a lost delta can never wedge
+        # convergence; every other round sends a bounded per-peer delta
+        # frame (_encode_delta: changed-first, rr tail, capped).
+        anti_entropy = (not c.delta
+                        or self.rounds % c.anti_entropy_every == 0)
+        full_msg = self._encode() if anti_entropy else None
+
+        def refresh(peer: str) -> str:
+            if anti_entropy:
+                if c.delta:
+                    # a full list covers everything: advance the cursor
+                    self._sent_ver[peer] = self._ver
+                return full_msg
+            return self._encode_delta(peer)
+
         if c.push == "random":
             # north-star / campaign push topology: fanout random listed
             # peers per tick (the tensor engine's topology='random' —
@@ -409,7 +523,7 @@ class UdpNode:
             peers = [a for a in self.members if a != self.addr]
             for peer in self._rng.sample(peers,
                                          min(c.fanout, len(peers))):
-                self._send(peer, msg)
+                self._send(peer, refresh(peer))
             return
         # ring push to list positions self-1, self+1, self+2 (slave.go:515-542)
         ordered = sorted(self.members)
@@ -420,7 +534,7 @@ class UdpNode:
         for off in (-1, 1, 2):
             peer = ordered[(i + off) % n]
             if peer != self.addr:
-                self._send(peer, msg)
+                self._send(peer, refresh(peer))
 
 
 class UdpCluster:
@@ -440,6 +554,9 @@ class UdpCluster:
         push: str = "ring",
         fanout: int | None = None,
         remove_broadcast: bool = True,
+        delta: bool = False,
+        delta_entries: int = 16,
+        anti_entropy_every: int = 4,
     ):
         self.n = n
         self.period = period
@@ -466,6 +583,28 @@ class UdpCluster:
         self.fanout = fanout if fanout is not None else max(
             2, (n - 1).bit_length())
         self.remove_broadcast = remove_broadcast
+        # delta-piggyback dissemination (round 20, protocol_spec
+        # DELTA_GOSSIP): per-round refresh pushes carry a bounded
+        # changed-first + rr-tail slice instead of the full O(N) list,
+        # with a cluster-round-aligned full-list anti-entropy push every
+        # anti_entropy_every rounds.  The cadence must stay strictly
+        # below t_fail (the contract constraint): a receiver's last
+        # refresh of a live entry is then at most anti_entropy_every
+        # rounds old, so delta mode cannot manufacture staleness.
+        if delta and anti_entropy_every >= t_fail:
+            raise ValueError(
+                f"anti_entropy_every={anti_entropy_every} must stay "
+                f"strictly below t_fail={t_fail} (protocol_spec "
+                "DELTA_GOSSIP constraint — a refresh gap past the "
+                "detection window manufactures false positives)")
+        self.delta = delta
+        self.delta_entries = delta_entries
+        self.anti_entropy_every = anti_entropy_every
+        # wire accounting (the delta A/B surface): cumulative payload
+        # bytes handed to sendto + the full-list vs delta frame split
+        self._bytes_sent = 0
+        self._frames_full = 0
+        self._frames_delta = 0
         # suspicion subsystem (suspicion/): SuspicionParams or None; the
         # nodes read it every tick, so (dis)arming mid-run takes effect
         # on their next heartbeat
@@ -563,6 +702,14 @@ class UdpCluster:
             "confirms": confirms,
         }
 
+    def account_send(self, msg: str) -> None:
+        """The UdpNode._send accounting hook (wire-plane vitals)."""
+        self._bytes_sent += len(msg)
+        if msg.startswith(DELTA_MARK):
+            self._frames_delta += 1
+        elif CMD_SEP not in msg:
+            self._frames_full += 1
+
     def message_allowed(self, src: int, peer_addr: str) -> bool:
         """The UdpNode._send hook: False = the armed scenario drops it."""
         rt = self._scn_runtime
@@ -610,6 +757,9 @@ class UdpCluster:
             "n_alive": len(self.alive_nodes()),
             "detections": self._det_total,
             "false_positives": self._fp_total,
+            "bytes_sent": self._bytes_sent,
+            "frames_full": self._frames_full,
+            "frames_delta": self._frames_delta,
         }
         sus = self.suspicion_status()
         if sus is not None:

@@ -50,7 +50,8 @@ def _build() -> None:
 
 def _sources() -> list[pathlib.Path]:
     return [_NATIVE_DIR / "codec.cc", _NATIVE_DIR / "engine.cc",
-            _NATIVE_DIR / "codec.h", _NATIVE_DIR / "Makefile"]
+            _NATIVE_DIR / "codec.h", _NATIVE_DIR / "tsa.h",
+            _NATIVE_DIR / "Makefile"]
 
 
 def _stale() -> bool:
@@ -313,6 +314,12 @@ class NativeUdpDetector:
     ``pump_obs`` drains through the ONE schema (``obs/schema.py``) into
     the attached ``FlightRecorder`` — so a native trace is a plain
     ``gossipfs-obs/v1`` stream every existing reader ingests unchanged.
+
+    Round 20: ``delta=True`` turns membership pushes into delta-piggyback
+    frames (changed-first + round-robin tail, capped at ``delta_entries``,
+    full anti-entropy push every ``anti_entropy_every`` rounds — must stay
+    below ``t_fail``), and ``loops=k`` stripes the receive path across k
+    epoll loops with node i owned by stripe ``i % k``.
     """
 
     def __init__(
@@ -329,6 +336,10 @@ class NativeUdpDetector:
         fanout: int | None = None,
         remove_broadcast: bool = True,
         suspicion=None,
+        delta: bool = False,
+        delta_entries: int = 16,
+        anti_entropy_every: int = 4,
+        loops: int = 1,
     ):
         self._lib = load_library()
         self.n = n
@@ -352,6 +363,14 @@ class NativeUdpDetector:
             knobs.append(f"t_suspect={suspicion.t_suspect}")
             knobs.append(f"lh_multiplier={suspicion.lh_multiplier}")
             knobs.append(f"lh_frac={suspicion.lh_frac!r}")
+        if delta:
+            # delta piggybacking (protocol_spec.DELTA_GOSSIP); the engine
+            # rejects anti_entropy_every >= t_fail like UdpCluster does
+            knobs.append("delta=1")
+            knobs.append(f"delta_entries={delta_entries}")
+            knobs.append(f"anti_entropy_every={anti_entropy_every}")
+        if loops != 1:
+            knobs.append(f"loops={loops}")
         if knobs and self._lib.gfs_configure(
                 self._h, " ".join(knobs).encode()) != 0:
             self._lib.gfs_cluster_destroy(self._h)

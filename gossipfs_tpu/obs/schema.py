@@ -214,6 +214,13 @@ VITALS_FIELDS = (
     # and a clean stripe-mode run reports a real measured 0
     "stripes_degraded",  # stripes below full strength but >= k live
     "fragments_lost",    # missing fragments summed over placed stripes
+    # -- wire plane (socket engines only; round 20 delta gossip A/B):
+    # cumulative payload bytes actually handed to sendto, and the
+    # full-list vs delta-frame split.  The tensor engine has no wire,
+    # so its documents omit all three and render n/a
+    "bytes_sent",
+    "frames_full",      # full member-list frames (anti-entropy included)
+    "frames_delta",     # <#DELTA#>-marked bounded frames
 )
 
 

@@ -63,6 +63,19 @@ std::vector<MemberEntry> DecodeMembers(const std::string& payload) {
   return out;
 }
 
+std::string EncodeDelta(const std::vector<MemberEntry>& members) {
+  return std::string(kDeltaMark) + EncodeMembers(members);
+}
+
+bool IsDelta(const std::string& payload) {
+  return payload.compare(0, sizeof(kDeltaMark) - 1, kDeltaMark) == 0;
+}
+
+std::vector<MemberEntry> DecodeDelta(const std::string& payload) {
+  if (!IsDelta(payload)) return {};
+  return DecodeMembers(payload.substr(sizeof(kDeltaMark) - 1));
+}
+
 std::string EncodeControl(const std::string& addr, const std::string& verb) {
   return addr + kCmdSep + verb;
 }

@@ -18,6 +18,13 @@ namespace gossipfs {
 inline constexpr char kEntrySep[] = "<#ENTRY#>";
 inline constexpr char kFieldSep[] = "<#INFO#>";
 inline constexpr char kCmdSep[] = "<CMD>";
+// Delta-piggyback frames (protocol_spec.DELTA_GOSSIP): a membership list
+// prefixed with this mark carries only the sender's SELECTED entries
+// (recently-changed first, round-robin tail refresh, capped) instead of
+// the full table.  Receivers max-merge it exactly like a full list; the
+// mark only exists so anti-entropy full pushes stay distinguishable for
+// wire accounting and conformance fuzzing.
+inline constexpr char kDeltaMark[] = "<#DELTA#>";
 
 struct MemberEntry {
   std::string addr;
@@ -37,6 +44,15 @@ std::string EncodeMembers(const std::vector<MemberEntry>& members);
 // (fewer than 2 fields, non-numeric hb) are skipped, like the reference's
 // silent parse behavior.
 std::vector<MemberEntry> DecodeMembers(const std::string& payload);
+
+// Delta frame: kDeltaMark + EncodeMembers(selected entries).
+std::string EncodeDelta(const std::vector<MemberEntry>& members);
+
+// True iff the payload starts with kDeltaMark.
+bool IsDelta(const std::string& payload);
+
+// Entries of a delta frame; empty when the payload is not a delta frame.
+std::vector<MemberEntry> DecodeDelta(const std::string& payload);
 
 // Control framing: "addr<CMD>VERB".
 std::string EncodeControl(const std::string& addr, const std::string& verb);

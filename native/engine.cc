@@ -1,7 +1,16 @@
 // Native gossip runtime: N protocol nodes over real localhost UDP sockets,
-// driven by one epoll loop — the C++ equivalent of the reference's Go
-// runtime (goroutine heartbeat driver main.go:27-33, blocking UDP receive
-// loop slave/slave.go:207-248), for the BASELINE config-1 parity path.
+// driven by k striped epoll loops (gfs_configure(loops=k), default 1) —
+// the C++ equivalent of the reference's Go runtime (goroutine heartbeat
+// driver main.go:27-33, blocking UDP receive loop slave/slave.go:207-248),
+// for the BASELINE config-1 parity path.
+//
+// Striping mirrors parallel/mesh.py's row sharding: node i belongs to
+// stripe i % k, each stripe owns one epoll fd + one mutex guarding its
+// nodes' protocol state, and the per-round tick is a barrier — every
+// stripe ticks its own nodes once the period elapses, the last arriver
+// publishes round_tick and only then advances the shared round counter.
+// Cross-stripe reads (fp attribution, vitals, warm gate) take stripe
+// mutexes one at a time, never nested.
 //
 // Protocol semantics mirror the reference exactly (and the Python asyncio
 // twin, gossipfs_tpu/detector/udp.py):
@@ -10,10 +19,14 @@
 //   - per-period tick: refresh-only below min_group (slave.go:504-509), bump
 //     own heartbeat, detect members with hb > 1 silent past t_fail periods
 //     (slave.go:460-476), REMOVE broadcast (slave.go:338-363), fail-list
-//     cooldown expiry (slave.go:484-497), then full-list push to ring
-//     neighbours at sorted positions self-1, self+1, self+2 (slave.go:515-542)
+//     cooldown expiry (slave.go:484-497), then push to ring neighbours at
+//     sorted positions self-1, self+1, self+2 (slave.go:515-542) — a full
+//     list every anti_entropy_every rounds when delta mode is on, else a
+//     capped changed-first + round-robin-tail delta frame
+//     (protocol_spec.DELTA_GOSSIP)
 //   - merge: shared members take max heartbeat + LOCAL timestamp; unknown
-//     members are added unless on the fail list (slave.go:414-440)
+//     members are added unless on the fail list (slave.go:414-440); delta
+//     frames merge identically — the mark only changes wire accounting
 //
 // Exposed through a C ABI (extern "C") for ctypes — see gossipfs_tpu/native.py.
 
@@ -64,6 +77,10 @@ void AppendVital(std::ostringstream& os, const char* key, long long v) {
 struct Member {
   long long hb = 0;
   double ts = 0.0;
+  // monotone change version (delta gossip): stamped from the owning
+  // node's ver_clock_ whenever hb advances or the entry is (re)added,
+  // so EncodeDeltaFor can select "changed since this peer's cursor"
+  long long ver = 0;
 };
 
 struct DetectionEvent {
@@ -96,7 +113,29 @@ struct Config {
   int t_suspect = 0;
   int lh_multiplier = 0;
   double lh_frac = 0.25;
+  // delta-piggyback dissemination (protocol_spec.DELTA_GOSSIP, round
+  // 20): per-round refresh pushes carry a bounded per-peer delta frame
+  // (recently-changed entries first, round-robin refresh of the stable
+  // tail, capped at delta_entries) instead of the full list; every
+  // anti_entropy_every-th cluster round still pushes the FULL list so a
+  // lost delta can never wedge convergence.  The cadence must stay
+  // strictly inside the detection window (anti_entropy_every < t_fail):
+  // a receiver's freshest view of a live entry is then at most
+  // anti_entropy_every rounds old, so delta mode cannot manufacture
+  // staleness (Configure rejects the inversion, like UdpCluster does).
+  bool delta = false;
+  int delta_entries = 16;
+  int anti_entropy_every = 4;
+  // receive-path shards: k epoll loops, each with its own socket set +
+  // striped node ownership (node i -> stripe i % loops), the way
+  // parallel/mesh.py shards rows across devices
+  int loops = 1;
 };
+
+// Wire-frame class for send accounting (the delta A/B surface): the
+// caller names the kind at the send site, so the counters never pay a
+// payload scan.
+enum class FrameKind { kControl, kFull, kDelta };
 
 // -- fault gates (scenarios/schedule.py primitives, compiled to a text
 // table by gossipfs_tpu/native.py::compile_native_scenario and pushed
@@ -132,9 +171,10 @@ struct GateTable {
 };
 
 // Cluster is defined BEFORE Node so Node's thread-safety attributes can
-// name the capability they are guarded by (`cluster_->mu_` must resolve
-// against a complete Cluster).  The members Node needs (ctor, dtor,
-// RecordDetection) are declared here and defined out-of-line after Node.
+// name the capability they are guarded by (`stripe_->mu_` must resolve
+// against a complete Cluster::Stripe).  The members Node needs (ctor,
+// dtor, RecordDetection) are declared here and defined out-of-line
+// after Node.
 class Node;
 
 class Cluster {
@@ -153,10 +193,7 @@ class Cluster {
   // Blocks for `rounds` heartbeat periods of wall time (real-time runtime).
   void Advance(int rounds);
 
-  int Round() {
-    MutexLock lk(mu_);
-    return round_;
-  }
+  int Round() { return round_.load(); }
   int Membership(int observer, int* out, int cap);
   int Suspects(int observer, int* out, int cap);
   long long Incarnation(int observer, int subject);  // hb, -1 if absent
@@ -173,71 +210,121 @@ class Cluster {
   void SeedFull();  // fully-joined steady state (udp seed_full_membership)
   int Warm();       // 1 iff every alive view is full with every hb > 1
 
+  // -- the receive-path shard (round 20): nodes i with i % loops == s
+  // are OWNED by stripe s — its epoll fd drains their sockets, its
+  // thread ticks them, and its mutex guards ALL their protocol state.
+  // Datagram "delivery" between nodes is real UDP, so a stripe thread
+  // only ever mutates its OWN nodes; the cross-stripe reads that remain
+  // (ground-truth aliveness in RecordDetection, the shared round clock,
+  // the cumulative counters) are atomics, and the shared planes — the
+  // detection-event queue, the obs buffer, the armed fault gates — sit
+  // behind their own leaf mutexes.  Lock order: stripe mutexes (index
+  // order when more than one) before any leaf; leaves never nest.
+  struct Stripe {
+    Mutex mu_;
+    int epoll_fd_ = -1;
+    std::thread thread_;
+    std::vector<int> node_ids_;  // immutable after Configure/Start
+    // the round this stripe has already ticked (its own thread only)
+    int done_round_ = 0;
+  };
+
   const Config& cfg() const { return cfg_; }
-  void RecordDetection(int observer, const std::string& subject_addr)
-      GFS_REQUIRES(mu_);
+  void RecordDetection(int observer, const std::string& subject_addr);
   int IdxOf(const std::string& addr) const {
     auto it = addr_to_idx_.find(addr);
     return it == addr_to_idx_.end() ? -1 : it->second;
   }
-  // obs emission (single writer of the event lines; the Python side
-  // renders them through obs.recorder.FlightRecorder so the stream's
-  // reader stays obs.recorder.load_stream).  Kind strings are literals
-  // at every call site: gossipfs-lint's native-obs-kinds rule requires
-  // each to appear in obs/schema.py EVENT_KINDS (single ownership
-  // across the language boundary), and rules_spec's
-  // spec-native-annotations rule requires every LIFECYCLE kind to be
-  // dominated by a matching `// @gfs:` contract annotation.
+  // obs emission (the event lines the Python side renders through
+  // obs.recorder.FlightRecorder so the stream's reader stays
+  // obs.recorder.load_stream).  Kind strings are literals at every call
+  // site: gossipfs-lint's native-obs-kinds rule requires each to appear
+  // in obs/schema.py EVENT_KINDS (single ownership across the language
+  // boundary), and rules_spec's spec-native-annotations rule requires
+  // every LIFECYCLE kind to be dominated by a matching `// @gfs:`
+  // contract annotation.
   void ObsEmit(const char* kind, int observer, int subject,
-               const std::string& detail) GFS_REQUIRES(mu_);
+               const std::string& detail);
   void ObsEmit(const char* kind, int observer,
-               const std::string& subject_addr, const std::string& detail)
-      GFS_REQUIRES(mu_);
-  bool ScenarioDrops(int src, const std::string& dst_addr) const
-      GFS_REQUIRES(mu_);
-  void CountSend() GFS_REQUIRES(mu_) { sends_total_ += 1; }
+               const std::string& subject_addr, const std::string& detail);
+  bool ScenarioDrops(int src, const std::string& dst_addr) const;
+  void CountSend(size_t bytes, FrameKind kind) {
+    sends_total_.fetch_add(1, std::memory_order_relaxed);
+    bytes_total_.fetch_add(static_cast<long long>(bytes),
+                           std::memory_order_relaxed);
+    if (kind == FrameKind::kFull)
+      frames_full_.fetch_add(1, std::memory_order_relaxed);
+    else if (kind == FrameKind::kDelta)
+      frames_delta_.fetch_add(1, std::memory_order_relaxed);
+  }
+  std::atomic<int> round_{0};  // Node::Tick reads it for the anti-entropy
+                               // cadence; published by the barrier winner
 
  private:
-  void LoopBody();
-  void EmitRoundTick(double tick_ms) GFS_REQUIRES(mu_);
+  void RebuildStripes(int loops);  // pre-Start only (Configure)
+  Stripe* StripeOf(int i) {
+    return stripes_[static_cast<size_t>(i) % stripes_.size()].get();
+  }
+  void StripeBody(Stripe* s);
+  void EmitRoundTick(double tick_ms);
+  void ObsEmitLocked(const char* kind, int observer, int subject,
+                     const std::string& detail) GFS_REQUIRES(obs_mu_);
 
   // Immutable after construction / Start (no lock needed): cfg_ (knob
-  // writes only before the loop thread exists), nodes_, addr_to_idx_,
-  // epoll_fd_, loop_, running_ (atomic).
+  // writes only before the loop threads exist), nodes_, addr_to_idx_,
+  // stripes_ layout (RebuildStripes runs pre-Start), running_ (atomic).
   Config cfg_;
   std::vector<std::unique_ptr<Node>> nodes_;
   std::map<std::string, int> addr_to_idx_;
-  std::thread loop_;
+  std::vector<std::unique_ptr<Stripe>> stripes_;
   std::atomic<bool> running_{false};
-  int epoll_fd_ = -1;
-  // mu_ guards all protocol state — every Node field below plus these —
-  // against the epoll loop thread vs the C-ABI control verbs.  The loop
-  // thread holds it while processing one batch of datagrams / one tick.
-  Mutex mu_;
-  std::vector<DetectionEvent> events_ GFS_GUARDED_BY(mu_);
-  int round_ GFS_GUARDED_BY(mu_) = 0;
-  double next_tick_ GFS_GUARDED_BY(mu_) = 0.0;
+  Mutex ctl_mu_;  // serializes Configure vs Start (both pre-loop)
+  // -- round clock + tick barrier (all stripe threads).  A stripe ticks
+  // its nodes when now >= next_tick_ and it has not ticked this round_;
+  // the FIRST starter stamps tick_t0_, the LAST arriver emits the
+  // round_tick, advances next_tick_, resets the counters, and ONLY THEN
+  // publishes round_+1 — the ordering that makes a double-tick
+  // impossible (no stripe can re-enter until the new round is visible).
+  std::atomic<double> next_tick_{0.0};
+  std::atomic<int> tick_starters_{0};
+  std::atomic<int> tick_arrivals_{0};
+  std::atomic<double> tick_t0_{0.0};
   // -- cumulative counters (vitals; events_ drains, so the `metrics`
-  // surface needs its own accounting — the udp engine's convention)
-  long long det_total_ GFS_GUARDED_BY(mu_) = 0;
-  long long fp_total_ GFS_GUARDED_BY(mu_) = 0;
-  long long sends_total_ GFS_GUARDED_BY(mu_) = 0;
+  // surface needs its own accounting — the udp engine's convention).
+  // Atomics: bumped under different stripe locks.
+  std::atomic<long long> det_total_{0};
+  std::atomic<long long> fp_total_{0};
+  std::atomic<long long> sends_total_{0};
+  // wire accounting (the delta A/B surface): payload bytes handed to
+  // sendto + the full-list vs delta frame split (FrameKind at the send
+  // site — no payload scan)
+  std::atomic<long long> bytes_total_{0};
+  std::atomic<long long> frames_full_{0};
+  std::atomic<long long> frames_delta_{0};
+  // -- detection-event queue (leaf lock: any stripe appends, the C ABI
+  // drains)
+  Mutex events_mu_;
+  std::vector<DetectionEvent> events_ GFS_GUARDED_BY(events_mu_);
   // -- obs plane: rendered event lines awaiting ObsDrain.  OFF until
   // gfs_obs_enable so detectors without a recorder never grow the
   // buffer; enabling rebases the stamped round clock to 0 (the
-  // arming-relative frame the udp campaign streams use).
-  bool obs_enabled_ GFS_GUARDED_BY(mu_) = false;
-  int obs_round0_ GFS_GUARDED_BY(mu_) = 0;
-  std::string obs_buf_ GFS_GUARDED_BY(mu_);
-  long long obs_det0_ GFS_GUARDED_BY(mu_) = 0;
-  long long obs_fp0_ GFS_GUARDED_BY(mu_) = 0;
-  long long obs_sends0_ GFS_GUARDED_BY(mu_) = 0;
-  long long obs_sus_entered0_ GFS_GUARDED_BY(mu_) = 0;
-  long long obs_refut0_ GFS_GUARDED_BY(mu_) = 0;
-  // -- armed fault gates (ScenarioLoad); windows are round0-relative
-  GateTable gates_ GFS_GUARDED_BY(mu_);
-  bool gates_armed_ GFS_GUARDED_BY(mu_) = false;
-  int scn_round0_ GFS_GUARDED_BY(mu_) = 0;
+  // arming-relative frame the udp campaign streams use).  The armed
+  // bit is an atomic fast path; the buffer + baselines are a leaf lock.
+  std::atomic<bool> obs_enabled_{false};
+  Mutex obs_mu_;
+  int obs_round0_ GFS_GUARDED_BY(obs_mu_) = 0;
+  std::string obs_buf_ GFS_GUARDED_BY(obs_mu_);
+  long long obs_det0_ GFS_GUARDED_BY(obs_mu_) = 0;
+  long long obs_fp0_ GFS_GUARDED_BY(obs_mu_) = 0;
+  long long obs_sends0_ GFS_GUARDED_BY(obs_mu_) = 0;
+  long long obs_sus_entered0_ GFS_GUARDED_BY(obs_mu_) = 0;
+  long long obs_refut0_ GFS_GUARDED_BY(obs_mu_) = 0;
+  // -- armed fault gates (ScenarioLoad); windows are round0-relative.
+  // Armed bit atomic (the per-send fast path); table behind a leaf lock.
+  std::atomic<bool> gates_armed_{false};
+  mutable Mutex gates_mu_;
+  GateTable gates_ GFS_GUARDED_BY(gates_mu_);
+  int scn_round0_ GFS_GUARDED_BY(gates_mu_) = 0;
 
   friend class Node;
 };
@@ -251,69 +338,112 @@ class Node {
   void Close();
 
   void HandleDatagram(const std::string& payload)
-      GFS_REQUIRES(cluster_->mu_);
-  void Tick(double now) GFS_REQUIRES(cluster_->mu_);
-  void StopGraceful() GFS_REQUIRES(cluster_->mu_);  // LEAVE broadcast, die
-  void StopCrash() GFS_REQUIRES(cluster_->mu_);     // silent death (CTRL+C)
-  void ResetState() GFS_REQUIRES(cluster_->mu_);    // fresh state for rejoin
+      GFS_REQUIRES(stripe_->mu_);
+  void Tick(double now) GFS_REQUIRES(stripe_->mu_);
+  void StopGraceful() GFS_REQUIRES(stripe_->mu_);  // LEAVE broadcast, die
+  void StopCrash() { alive_.store(false); }        // silent death (CTRL+C)
+  void ResetState() GFS_REQUIRES(stripe_->mu_);    // fresh state for rejoin
   void SeedMembers(const std::vector<std::string>& addrs, double now)
-      GFS_REQUIRES(cluster_->mu_);
+      GFS_REQUIRES(stripe_->mu_);
 
   int fd() const { return fd_; }
   int idx() const { return idx_; }
-  bool alive() const GFS_REQUIRES(cluster_->mu_) { return alive_; }
+  // ground-truth aliveness is lock-free: RecordDetection reads it for a
+  // subject owned by a DIFFERENT stripe, and it only toggles at the
+  // C-ABI crash/leave/join seams
+  bool alive() const { return alive_.load(); }
   const std::string& addr() const { return addr_; }
-  std::vector<std::string> MemberAddrs() const GFS_REQUIRES(cluster_->mu_);
-  std::vector<std::string> SuspectAddrs() const GFS_REQUIRES(cluster_->mu_);
+  std::vector<std::string> MemberAddrs() const GFS_REQUIRES(stripe_->mu_);
+  std::vector<std::string> SuspectAddrs() const GFS_REQUIRES(stripe_->mu_);
   // per-entry heartbeat counter (the incarnation surface the conformance
   // harness reads); -1 when the addr is not in this node's view
-  long long HbOf(const std::string& addr) const GFS_REQUIRES(cluster_->mu_);
+  long long HbOf(const std::string& addr) const GFS_REQUIRES(stripe_->mu_);
 
   // TSA compares capability expressions syntactically, so at a Cluster
-  // call site `node->Tick()` requires `node->cluster_->mu_` — an alias
-  // of the held `this->mu_` the analysis cannot prove.  This assert-only
-  // no-op states the aliasing fact; Cluster calls it once per node at
-  // every crossing made with mu_ held.
-  void AssertLockHeld() const GFS_ASSERT_CAPABILITY(cluster_->mu_) {}
+  // call site `node->Tick()` requires `node->stripe_->mu_` — an alias
+  // of the held stripe mutex the analysis cannot prove.  This
+  // assert-only no-op states the aliasing fact; Cluster calls it once
+  // per node at every crossing made with the owning stripe's lock held.
+  void AssertLockHeld() const GFS_ASSERT_CAPABILITY(stripe_->mu_) {}
 
  private:
-  void Send(const std::string& peer_addr, const std::string& msg)
-      GFS_REQUIRES(cluster_->mu_);
+  void Send(const std::string& peer_addr, const std::string& msg,
+            FrameKind kind) GFS_REQUIRES(stripe_->mu_);
   void AddMember(const std::string& addr, double now)
-      GFS_REQUIRES(cluster_->mu_);  // introducer path
+      GFS_REQUIRES(stripe_->mu_);  // introducer path
   void RemoveMember(const std::string& addr, double now)
-      GFS_REQUIRES(cluster_->mu_);
+      GFS_REQUIRES(stripe_->mu_);
   void Merge(const std::vector<MemberEntry>& remote, double now)
-      GFS_REQUIRES(cluster_->mu_);
+      GFS_REQUIRES(stripe_->mu_);
   void OnSuspect(const std::string& addr, double now)
-      GFS_REQUIRES(cluster_->mu_);
+      GFS_REQUIRES(stripe_->mu_);
   void OnRefute(const std::string& arg, double now)
-      GFS_REQUIRES(cluster_->mu_);
+      GFS_REQUIRES(stripe_->mu_);
   // Lifeguard local health (runtime.py::degraded)
-  bool Degraded() const GFS_REQUIRES(cluster_->mu_);
-  std::string EncodeSelf() const GFS_REQUIRES(cluster_->mu_);
+  bool Degraded() const GFS_REQUIRES(stripe_->mu_);
+  std::string EncodeSelf() const GFS_REQUIRES(stripe_->mu_);
+  // delta gossip (protocol_spec.DELTA_GOSSIP; udp.py _encode_delta is
+  // the twin): advance the change clock / build one bounded per-peer
+  // delta frame / send one refresh push picking full vs delta
+  long long Bump() GFS_REQUIRES(stripe_->mu_) { return ++ver_clock_; }
+  // stamp an entry's change version and re-index it in changed_log_
+  void Stamp(Member& m, const std::string& addr)
+      GFS_REQUIRES(stripe_->mu_) {
+    if (m.ver > 0) changed_log_.erase(m.ver);
+    m.ver = Bump();
+    changed_log_[m.ver] = addr;
+  }
+  void RingInsert(const std::string& addr) GFS_REQUIRES(stripe_->mu_) {
+    auto it = std::lower_bound(addr_ring_.begin(), addr_ring_.end(), addr);
+    if (it == addr_ring_.end() || *it != addr) addr_ring_.insert(it, addr);
+  }
+  void RingErase(const std::string& addr) GFS_REQUIRES(stripe_->mu_) {
+    auto it = std::lower_bound(addr_ring_.begin(), addr_ring_.end(), addr);
+    if (it != addr_ring_.end() && *it == addr) addr_ring_.erase(it);
+  }
+  std::string EncodeDeltaFor(const std::string& peer, FrameKind* kind)
+      GFS_REQUIRES(stripe_->mu_);
+  void PushRefresh(const std::string& peer, bool anti_entropy,
+                   std::string& full_msg) GFS_REQUIRES(stripe_->mu_);
   // per-node stream for the random-push draw
-  uint32_t NextRand() GFS_REQUIRES(cluster_->mu_);
+  uint32_t NextRand() GFS_REQUIRES(stripe_->mu_);
 
   Cluster* const cluster_;
   const int idx_;
   const int port_;
   std::string addr_;
   int fd_ = -1;  // epoll registration is pre-thread; Close post-join
-  bool alive_ GFS_GUARDED_BY(cluster_->mu_) = false;
+  // the owning receive-path stripe (assigned by Cluster::RebuildStripes
+  // pre-Start); its mutex is THE capability guarding this node's state
+  Cluster::Stripe* stripe_ = nullptr;
+  std::atomic<bool> alive_{false};
   // sorted: ring order by address
-  std::map<std::string, Member> members_ GFS_GUARDED_BY(cluster_->mu_);
+  std::map<std::string, Member> members_ GFS_GUARDED_BY(stripe_->mu_);
   // addr -> cooldown-start ts
-  std::map<std::string, double> fail_list_ GFS_GUARDED_BY(cluster_->mu_);
+  std::map<std::string, double> fail_list_ GFS_GUARDED_BY(stripe_->mu_);
   // suspicion (armed iff cfg.t_suspect > 0): addr -> suspect-start ts,
   // plus cumulative lifecycle counters (the vitals/round_tick surface)
-  std::map<std::string, double> suspects_ GFS_GUARDED_BY(cluster_->mu_);
-  long long sus_entered_ GFS_GUARDED_BY(cluster_->mu_) = 0;
-  long long sus_refutations_ GFS_GUARDED_BY(cluster_->mu_) = 0;
-  long long sus_confirms_ GFS_GUARDED_BY(cluster_->mu_) = 0;
+  std::map<std::string, double> suspects_ GFS_GUARDED_BY(stripe_->mu_);
+  long long sus_entered_ GFS_GUARDED_BY(stripe_->mu_) = 0;
+  long long sus_refutations_ GFS_GUARDED_BY(stripe_->mu_) = 0;
+  long long sus_confirms_ GFS_GUARDED_BY(stripe_->mu_) = 0;
   // rate-limits REFUTE broadcasts
-  double last_refute_t_ GFS_GUARDED_BY(cluster_->mu_) = -1e18;
-  uint32_t rng_state_ GFS_GUARDED_BY(cluster_->mu_);
+  double last_refute_t_ GFS_GUARDED_BY(stripe_->mu_) = -1e18;
+  uint32_t rng_state_ GFS_GUARDED_BY(stripe_->mu_);
+  // delta gossip state (protocol_spec DELTA_GOSSIP): the node's change
+  // clock, the per-peer "entries up to this version already sent"
+  // cursors, and the round-robin tail-refresh position
+  long long ver_clock_ GFS_GUARDED_BY(stripe_->mu_) = 0;
+  std::map<std::string, long long> sent_ver_ GFS_GUARDED_BY(stripe_->mu_);
+  size_t refresh_pos_ GFS_GUARDED_BY(stripe_->mu_) = 0;
+  // ver-ordered change index (ver -> addr, one entry per member at its
+  // LATEST ver): EncodeDeltaFor walks it top-down, so the per-peer
+  // changed-first selection costs O(cap log N) instead of an O(N)
+  // scan + sort PER PEER — the scan made delta-mode ticks ~5x slower
+  // than full-list at n=256 (fanout encodes per round vs one)
+  std::map<long long, std::string> changed_log_ GFS_GUARDED_BY(stripe_->mu_);
+  // sorted address ring: O(1)-indexed round-robin tail refresh
+  std::vector<std::string> addr_ring_ GFS_GUARDED_BY(stripe_->mu_);
 
   friend class Cluster;
 };
@@ -326,19 +456,38 @@ Cluster::Cluster(const Config& cfg) : cfg_(cfg) {
     nodes_.emplace_back(new Node(this, i, cfg.base_port + i));
     addr_to_idx_[nodes_.back()->addr()] = i;
   }
+  RebuildStripes(cfg_.loops);
 }
 
 Cluster::~Cluster() { Stop(); }
 
+void Cluster::RebuildStripes(int loops) {
+  // pre-Start only: no stripe threads exist, so the layout swap is
+  // single-threaded by construction (Configure rejects a started
+  // cluster before it ever reaches the loops knob)
+  stripes_.clear();
+  for (int s = 0; s < loops; ++s)
+    stripes_.emplace_back(new Stripe);
+  for (int i = 0; i < static_cast<int>(nodes_.size()); ++i) {
+    Stripe* s = stripes_[static_cast<size_t>(i % loops)].get();
+    s->node_ids_.push_back(i);
+    nodes_[i]->stripe_ = s;
+  }
+}
+
 void Cluster::RecordDetection(int observer, const std::string& subject_addr) {
   auto it = addr_to_idx_.find(subject_addr);
   if (it == addr_to_idx_.end()) return;
-  Node* subject = nodes_[it->second].get();
-  subject->AssertLockHeld();
-  int fp = subject->alive() ? 1 : 0;
-  events_.push_back(DetectionEvent{round_, observer, it->second, fp});
-  det_total_ += 1;
-  fp_total_ += fp;
+  // the subject may be owned by a DIFFERENT stripe than the calling
+  // observer's: ground-truth aliveness is an atomic read, the queue and
+  // counters are the shared leaf planes
+  int fp = nodes_[it->second]->alive() ? 1 : 0;
+  {
+    MutexLock lk(events_mu_);
+    events_.push_back(DetectionEvent{round_.load(), observer, it->second, fp});
+  }
+  det_total_.fetch_add(1, std::memory_order_relaxed);
+  fp_total_.fetch_add(fp, std::memory_order_relaxed);
   // the one emission point every failure declaration funnels through —
   // the suspicion path after the (lh-stretched) window expires, and the
   // direct stale confirm when suspicion is disarmed (t_suspect == 0)
@@ -399,20 +548,33 @@ void Node::ResetState() {
   // a fresh process forgets its suspicions with the rest of its state;
   // the cumulative lifecycle counters survive (vitals are per-run)
   suspects_.clear();
+  // delta gossip: a fresh process restarts its change clock and forgets
+  // its per-peer cursors — the next push to any peer is a full list
+  // (udp.py UdpNode reset does the same)
+  ver_clock_ = 0;
+  sent_ver_.clear();
+  refresh_pos_ = 0;
+  changed_log_.clear();
   // a fresh process knows only itself (InitMembership, slave.go:161-167)
   members_[addr_] = Member{0, MonotonicNow()};
-  alive_ = true;
+  addr_ring_.assign(1, addr_);
+  alive_.store(true);
 }
 
 void Node::SeedMembers(const std::vector<std::string>& addrs, double now) {
   // the fully-joined steady state the tensor engine's init_state models
   // (udp.py seed_full_membership): everyone listed at hb 0 with a fresh
-  // local stamp — inside the hb<=1 detection grace
+  // local stamp — inside the hb<=1 detection grace.  Entries seed at
+  // ver 0 (nothing "recently changed"), like the udp twin.
   members_.clear();
   for (const auto& a : addrs) members_[a] = Member{0, now};
+  changed_log_.clear();
+  addr_ring_.assign(addrs.begin(), addrs.end());
+  std::sort(addr_ring_.begin(), addr_ring_.end());
 }
 
-void Node::Send(const std::string& peer_addr, const std::string& msg) {
+void Node::Send(const std::string& peer_addr, const std::string& msg,
+                FrameKind kind) {
   if (fd_ < 0) return;
   // fault-gate hook (the UdpNode._send seam): an armed scenario rule —
   // flapping dark phase, rack outage, partition, lagging sender —
@@ -437,7 +599,7 @@ void Node::Send(const std::string& peer_addr, const std::string& msg) {
     return;
   ::sendto(fd_, msg.data(), msg.size(), 0, reinterpret_cast<sockaddr*>(&sa),
            sizeof(sa));
-  cluster_->CountSend();
+  cluster_->CountSend(msg.size(), kind);
 }
 
 std::string Node::EncodeSelf() const {
@@ -448,8 +610,87 @@ std::string Node::EncodeSelf() const {
   return EncodeMembers(entries);
 }
 
+std::string Node::EncodeDeltaFor(const std::string& peer, FrameKind* kind) {
+  // One bounded delta frame for `peer` — the protocol_spec DELTA_GOSSIP
+  // entry-selection rule (udp.py _encode_delta is the line-for-line
+  // twin): entries whose version advanced past the per-peer cursor,
+  // most recently changed first, then round-robin refresh of the stable
+  // tail in any leftover capacity, capped at delta_entries.  A peer
+  // with no cursor yet (first contact) gets the full list instead.
+  auto cur = sent_ver_.find(peer);
+  long long cursor = cur == sent_ver_.end() ? -1 : cur->second;
+  sent_ver_[peer] = ver_clock_;
+  if (cursor < 0) {
+    *kind = FrameKind::kFull;
+    return EncodeSelf();
+  }
+  *kind = FrameKind::kDelta;
+  size_t cap = static_cast<size_t>(cluster_->cfg().delta_entries);
+  std::vector<MemberEntry> picks;
+  picks.reserve(cap);
+  // changed entries most-recent-first: walk the ver-ordered change
+  // index from the top until the cursor or the cap — O(cap log N) per
+  // peer where the members_ scan + sort was O(N log N) PER PEER (the
+  // full-list arm encodes once per round; this path runs fanout times)
+  for (auto it = changed_log_.rbegin();
+       it != changed_log_.rend() && it->first > cursor
+       && picks.size() < cap; ++it) {
+    auto mi = members_.find(it->second);
+    if (mi == members_.end()) continue;
+    picks.push_back(MemberEntry{mi->first, mi->second.hb, mi->second.ts});
+  }
+  if (picks.size() < cap && addr_ring_.size() > picks.size()) {
+    // round-robin refresh of the stable tail (ring order by address)
+    size_t nall = addr_ring_.size();
+    size_t taken = 0;
+    for (size_t k = 0; k < nall && picks.size() < cap; ++k) {
+      const std::string& a = addr_ring_[(refresh_pos_ + k) % nall];
+      bool dup = false;
+      for (const auto& p : picks)
+        if (p.addr == a) {
+          dup = true;
+          break;
+        }
+      if (!dup) {
+        auto mi = members_.find(a);
+        if (mi != members_.end())
+          picks.push_back(MemberEntry{a, mi->second.hb, mi->second.ts});
+      }
+      taken = k + 1;
+    }
+    refresh_pos_ = (refresh_pos_ + taken) % nall;
+  }
+  return EncodeDelta(picks);
+}
+
+void Node::PushRefresh(const std::string& peer, bool anti_entropy,
+                       std::string& full_msg) {
+  if (anti_entropy) {
+    if (cluster_->cfg().delta) {
+      // a full list covers everything: advance this peer's cursor
+      sent_ver_[peer] = ver_clock_;
+    }
+    Send(peer, full_msg, FrameKind::kFull);
+    return;
+  }
+  if (sent_ver_.find(peer) == sent_ver_.end()) {
+    // first contact gets the full list; encode it lazily ONCE per tick
+    // and reuse across all cursor-less peers this round — with fanout
+    // peers drawn per round, first contacts dominate the early rounds
+    // and a per-peer EncodeSelf is an O(N) tax the full-list arm
+    // never pays
+    if (full_msg.empty()) full_msg = EncodeSelf();
+    sent_ver_[peer] = ver_clock_;
+    Send(peer, full_msg, FrameKind::kFull);
+    return;
+  }
+  FrameKind kind = FrameKind::kDelta;
+  std::string msg = EncodeDeltaFor(peer, &kind);
+  Send(peer, msg, kind);
+}
+
 void Node::HandleDatagram(const std::string& payload) {
-  if (!alive_) return;
+  if (!alive()) return;
   double now = MonotonicNow();
   if (auto ctrl = DecodeControl(payload)) {
     // @gfs:verb JOIN
@@ -466,6 +707,14 @@ void Node::HandleDatagram(const std::string& payload) {
     } else if (ctrl->verb == "REFUTE") {
       OnRefute(ctrl->arg, now);
     }
+    return;
+  }
+  if (IsDelta(payload)) {
+    // delta frame: strip the marker and run the SAME hardened per-entry
+    // max-merge — a truncated or replayed delta degrades to a smaller
+    // merge, never a protocol error (udp.py handle() mirrors this
+    // dispatch order: control verb, then delta mark, then full list)
+    Merge(DecodeDelta(payload), now);
     return;
   }
   Merge(DecodeMembers(payload), now);
@@ -497,10 +746,11 @@ void Node::OnSuspect(const std::string& addr, double now) {
     last_refute_t_ = now;
     me->second.hb += 1;
     me->second.ts = now;
+    Stamp(me->second, addr_);
     std::string msg = EncodeControl(
         addr_ + kFieldSep + std::to_string(me->second.hb), "REFUTE");
     for (const auto& [peer, m] : members_)
-      if (peer != addr_) Send(peer, msg);
+      if (peer != addr_) Send(peer, msg, FrameKind::kControl);
   } else if (members_.find(addr) != members_.end()) {
     // adopt a peer-disseminated suspicion: start the timer, uncounted
     // (runtime.py::adopt — local freshness discards it at the next tick)
@@ -523,7 +773,10 @@ void Node::OnRefute(const std::string& arg, double now) {
   }
   auto it = members_.find(addr);
   if (it == members_.end()) return;
-  if (hb > it->second.hb) it->second.hb = hb;
+  if (hb > it->second.hb) {
+    it->second.hb = hb;
+    Stamp(it->second, addr);
+  }
   it->second.ts = now;
   if (suspects_.erase(addr)) {
     sus_refutations_ += 1;
@@ -536,10 +789,14 @@ void Node::AddMember(const std::string& addr, double now) {
   // introducer path: append at hb=0, push the full list to every member
   // (addNewMember, slave.go:250-274)
   // @gfs:transition UNKNOWN->MEMBER guard=join_or_merge_add
-  if (members_.find(addr) == members_.end()) members_[addr] = Member{0, now};
+  if (members_.find(addr) == members_.end()) {
+    Member& m = members_[addr] = Member{0, now};
+    Stamp(m, addr);
+    RingInsert(addr);
+  }
   std::string msg = EncodeSelf();
   for (const auto& [peer, m] : members_)
-    if (peer != addr_) Send(peer, msg);
+    if (peer != addr_) Send(peer, msg, FrameKind::kFull);
 }
 
 void Node::RemoveMember(const std::string& addr, double now) {
@@ -553,6 +810,8 @@ void Node::RemoveMember(const std::string& addr, double now) {
     // @gfs:transition MEMBER->FAILED guard=leave_or_remove
     cluster_->ObsEmit("remove", idx_, addr, "");
   }
+  if (it->second.ver > 0) changed_log_.erase(it->second.ver);
+  RingErase(addr);
   members_.erase(it);
   // removed for any reason (LEAVE, a peer's REMOVE, a confirm): forget
   // the pending suspicion uncounted (runtime.py::drop)
@@ -567,6 +826,7 @@ void Node::Merge(const std::vector<MemberEntry>& remote, double now) {
       if (entry.hb > it->second.hb) {
         it->second.hb = entry.hb;
         it->second.ts = now;
+        Stamp(it->second, entry.addr);
         if (suspects_.erase(entry.addr)) {
           // refute-by-advance: a fresher counter observed while SUSPECT
           // cancels the pending failure (runtime.py::refute)
@@ -574,16 +834,33 @@ void Node::Merge(const std::vector<MemberEntry>& remote, double now) {
           // @gfs:transition SUSPECT->MEMBER guard=refute_evidence
           cluster_->ObsEmit("refute", idx_, entry.addr, "");
         }
+      } else if (cluster_->cfg().delta && entry.hb == it->second.hb &&
+                 entry.ts > it->second.ts) {
+        // delta mode only: freshness rides the wire on EQUAL counters.
+        // Bounded frames break the full-list assumption that every
+        // round max-merges 16 fresh draws — after a synchronized
+        // anti-entropy round most nodes hold the SAME hb for an entry,
+        // so the next full push carries no advance and the local-stamp
+        // rule leaves ts aging toward t_fail on a QUIET cluster (the
+        // n=1024 staleness storm).  Max-merging the wire ts on equal
+        // hb closes it without breaking crash detection: a live node
+        // keeps stamping fresh ts into its own pushes, while a crashed
+        // node's copies converge to a constant max and staleness still
+        // grows globally.  Clamped to now so a forged future ts cannot
+        // suppress detection; full-list mode stays bit-identical.
+        it->second.ts = std::min(entry.ts, now);
       }
       // @gfs:transition UNKNOWN->MEMBER guard=join_or_merge_add
     } else if (fail_list_.find(entry.addr) == fail_list_.end()) {
-      members_[entry.addr] = Member{entry.hb, now};
+      Member& m = members_[entry.addr] = Member{entry.hb, now};
+      Stamp(m, entry.addr);
+      RingInsert(entry.addr);
     }
   }
 }
 
 void Node::Tick(double now) {
-  if (!alive_) return;
+  if (!alive()) return;
   const Config& cfg = cluster_->cfg();
   if (static_cast<int>(members_.size()) < cfg.min_group) {
     for (auto& [addr, m] : members_) m.ts = now;  // refresh-only
@@ -593,6 +870,7 @@ void Node::Tick(double now) {
   if (self != members_.end()) {
     self->second.hb += 1;
     self->second.ts = now;
+    Stamp(self->second, addr_);
   }
   // failure detection (slave.go:460-482).  With suspicion armed
   // (cfg.t_suspect > 0) a stale member passes through SUSPECT first:
@@ -642,7 +920,7 @@ void Node::Tick(double now) {
         // active incarnation-bump refute the moment the subject is
         // reachable again; the REFUTE broadcast is rate-limited on the
         // subject's side, so k re-notifiers cost one bump per period.
-        Send(addr, EncodeControl(addr, "SUSPECT"));
+        Send(addr, EncodeControl(addr, "SUSPECT"), FrameKind::kControl);
         continue;
       }
       suspects_.erase(it);
@@ -665,7 +943,7 @@ void Node::Tick(double now) {
       // ~500k synchronous sendtos that stall the epoll thread for
       // seconds, go-stale everything, and storm the cluster by
       // ENGINE physics, not protocol (measured: 26 s tick, 73k FPs).
-      Send(addr, msg);
+      Send(addr, msg, FrameKind::kControl);
       std::vector<const std::string*> peers;
       peers.reserve(members_.size());
       for (const auto& [peer, m] : members_)
@@ -674,14 +952,14 @@ void Node::Tick(double now) {
       for (int i = 0; i < k; ++i) {
         int j = i + static_cast<int>(NextRand() % (peers.size() - i));
         std::swap(peers[i], peers[j]);
-        Send(*peers[i], msg);
+        Send(*peers[i], msg, FrameKind::kControl);
       }
     } else {
       // ring mode: the asyncio engine's wire behavior verbatim (the
       // small-n udp-parity lane compares event sequences)
       // @gfs:dissemination new_suspect profile=reference bound=all_peers
       for (const auto& [peer, m] : members_)
-        if (peer != addr_) Send(peer, msg);
+        if (peer != addr_) Send(peer, msg, FrameKind::kControl);
     }
   }
   for (const auto& addr : failed) {
@@ -692,7 +970,7 @@ void Node::Tick(double now) {
     if (cfg.remove_broadcast) {
       std::string msg = EncodeControl(addr, "REMOVE");
       for (const auto& [peer, m] : members_)
-        if (peer != addr_) Send(peer, msg);
+        if (peer != addr_) Send(peer, msg, FrameKind::kControl);
     }
   }
   // fail-list cooldown expiry (slave.go:484-497)
@@ -705,7 +983,17 @@ void Node::Tick(double now) {
       ++it;
   }
   if (members_.find(addr_) == members_.end()) return;  // removed-self
-  std::string msg = EncodeSelf();
+  // membership refresh push.  Delta mode (protocol_spec
+  // membership_refresh/delta, round 20): every anti_entropy_every-th
+  // cluster round — all stripes tick on the same round clock — pushes
+  // the FULL list so a lost delta can never wedge convergence (Pittel's
+  // bound stays the reconvergence oracle); every other round sends a
+  // bounded per-peer delta frame (EncodeDeltaFor: changed-first, rr
+  // tail, capped).
+  // @gfs:dissemination membership_refresh profile=delta bound=changed+rr_tail+capped
+  bool anti_entropy =
+      !cfg.delta || (cluster_->round_.load() % cfg.anti_entropy_every == 0);
+  std::string msg = anti_entropy ? EncodeSelf() : std::string();
   if (cfg.push_random) {
     // campaign/north-star push topology: fanout random listed peers per
     // tick (the tensor engine's topology='random' — event propagation
@@ -719,7 +1007,7 @@ void Node::Tick(double now) {
     for (int i = 0; i < k; ++i) {
       int j = i + static_cast<int>(NextRand() % (peers.size() - i));
       std::swap(peers[i], peers[j]);
-      Send(*peers[i], msg);
+      PushRefresh(*peers[i], anti_entropy, msg);
     }
     return;
   }
@@ -734,20 +1022,18 @@ void Node::Tick(double now) {
     if (*ordered[i] == addr_) self_i = i;
   for (int off : {-1, 1, 2}) {
     const std::string& peer = *ordered[((self_i + off) % n + n) % n];
-    if (peer != addr_) Send(peer, msg);
+    if (peer != addr_) PushRefresh(peer, anti_entropy, msg);
   }
 }
 
 void Node::StopGraceful() {
-  if (alive_) {
+  if (alive()) {
     std::string msg = EncodeControl(addr_, "LEAVE");
     for (const auto& [peer, m] : members_)
-      if (peer != addr_) Send(peer, msg);
+      if (peer != addr_) Send(peer, msg, FrameKind::kControl);
   }
-  alive_ = false;
+  alive_.store(false);
 }
-
-void Node::StopCrash() { alive_ = false; }
 
 std::vector<std::string> Node::MemberAddrs() const {
   std::vector<std::string> out;
@@ -772,72 +1058,111 @@ long long Node::HbOf(const std::string& addr) const {
 // Cluster
 
 bool Cluster::Start() {
-  epoll_fd_ = ::epoll_create1(0);
-  if (epoll_fd_ < 0) return false;
+  MutexLock ctl(ctl_mu_);
+  if (running_.load()) return false;
+  for (auto& s : stripes_) {
+    s->epoll_fd_ = ::epoll_create1(0);
+    if (s->epoll_fd_ < 0) return false;
+  }
   for (auto& node : nodes_) {
     if (!node->Open()) return false;
     epoll_event ev{};
     ev.events = EPOLLIN;
     ev.data.u32 = static_cast<uint32_t>(node->idx());
-    ::epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, node->fd(), &ev);
+    ::epoll_ctl(StripeOf(node->idx())->epoll_fd_, EPOLL_CTL_ADD, node->fd(),
+                &ev);
   }
-  // everyone joins through the introducer (slave.go:288-308)
-  {
-    MutexLock lk(mu_);
-    Node* intro = nodes_[cfg_.introducer].get();
-    for (auto& node : nodes_) {
-      node->AssertLockHeld();
-      node->ResetState();
+  // everyone joins through the introducer (slave.go:288-308); the JOIN
+  // datagrams sit in socket buffers until the stripe threads start
+  const std::string intro_addr = nodes_[cfg_.introducer]->addr();
+  for (auto& s : stripes_) {
+    MutexLock lk(s->mu_);
+    for (int id : s->node_ids_) {
+      nodes_[id]->AssertLockHeld();
+      nodes_[id]->ResetState();
     }
-    for (auto& node : nodes_) {
+  }
+  for (auto& s : stripes_) {
+    MutexLock lk(s->mu_);
+    for (int id : s->node_ids_) {
+      Node* node = nodes_[id].get();
       node->AssertLockHeld();
       if (node->idx() != cfg_.introducer)
-        node->Send(intro->addr(), EncodeControl(node->addr(), "JOIN"));
+        node->Send(intro_addr, EncodeControl(node->addr(), "JOIN"),
+                   FrameKind::kControl);
     }
-    next_tick_ = MonotonicNow() + cfg_.period;
   }
+  round_.store(0);
+  tick_starters_.store(0);
+  tick_arrivals_.store(0);
+  for (auto& s : stripes_) s->done_round_ = 0;
+  next_tick_.store(MonotonicNow() + cfg_.period);
   running_ = true;
-  loop_ = std::thread([this] {
-    while (running_) LoopBody();
-  });
+  for (auto& s : stripes_) {
+    Stripe* sp = s.get();
+    s->thread_ = std::thread([this, sp] {
+      while (running_) StripeBody(sp);
+    });
+  }
   return true;
 }
 
-void Cluster::LoopBody() {
+void Cluster::StripeBody(Stripe* s) {
   epoll_event events[64];
-  double deadline;
-  {
-    MutexLock lk(mu_);
-    deadline = next_tick_;
-  }
   double now = MonotonicNow();
-  double wait_s = deadline - now;
+  double wait_s = next_tick_.load() - now;
   int timeout_ms = wait_s > 0 ? static_cast<int>(wait_s * 1000) + 1 : 0;
-  int nfds = ::epoll_wait(epoll_fd_, events, 64, std::min(timeout_ms, 50));
-  MutexLock lk(mu_);
-  char buf[65536];
-  for (int e = 0; e < nfds; ++e) {
-    Node* node = nodes_[events[e].data.u32].get();
-    node->AssertLockHeld();
-    while (true) {
-      ssize_t len = ::recv(node->fd(), buf, sizeof(buf), 0);
-      if (len <= 0) break;
-      node->HandleDatagram(std::string(buf, static_cast<size_t>(len)));
-    }
+  if (s->done_round_ != round_.load()) {
+    // this stripe already ticked the current round and is waiting for
+    // the barrier winner to publish the next one: keep draining
+    // datagrams on a short poll instead of busy-spinning
+    timeout_ms = 1;
   }
-  now = MonotonicNow();
-  if (now >= next_tick_) {
-    double t0 = MonotonicNow();
-    for (auto& node : nodes_) {
+  int nfds = ::epoll_wait(s->epoll_fd_, events, 64, std::min(timeout_ms, 50));
+  bool ticked = false;
+  {
+    MutexLock lk(s->mu_);
+    char buf[65536];
+    for (int e = 0; e < nfds; ++e) {
+      Node* node = nodes_[events[e].data.u32].get();
       node->AssertLockHeld();
-      node->Tick(now);
+      while (true) {
+        ssize_t len = ::recv(node->fd(), buf, sizeof(buf), 0);
+        if (len <= 0) break;
+        node->HandleDatagram(std::string(buf, static_cast<size_t>(len)));
+      }
     }
-    double tick_ms = (MonotonicNow() - t0) * 1000.0;
-    if (obs_enabled_) EmitRoundTick(tick_ms);
-    round_ += 1;
-    next_tick_ += cfg_.period;
-    if (next_tick_ < now) next_tick_ = now + cfg_.period;  // fell behind
+    now = MonotonicNow();
+    if (now >= next_tick_.load() && s->done_round_ == round_.load()) {
+      if (tick_starters_.fetch_add(1) == 0) tick_t0_.store(now);
+      for (int id : s->node_ids_) {
+        Node* node = nodes_[id].get();
+        node->AssertLockHeld();
+        node->Tick(now);
+      }
+      s->done_round_ = round_.load() + 1;
+      ticked = true;
+    }
   }
+  if (!ticked) return;
+  // tick barrier: the LAST stripe to arrive owns the round roll-over.
+  // It emits the round_tick (locking stripes one at a time — no stripe
+  // lock is ever held while taking another, so the order is deadlock-
+  // free by construction), advances the shared deadline, resets the
+  // barrier counters, and publishes round_+1 LAST — no stripe can
+  // re-enter its tick until the new round is visible, so a double-tick
+  // is impossible.
+  if (tick_arrivals_.fetch_add(1) + 1 != static_cast<int>(stripes_.size()))
+    return;
+  double tick_ms = (MonotonicNow() - tick_t0_.load()) * 1000.0;
+  if (obs_enabled_.load()) EmitRoundTick(tick_ms);
+  double nt = next_tick_.load() + cfg_.period;
+  double now2 = MonotonicNow();
+  if (nt < now2) nt = now2 + cfg_.period;  // fell behind
+  next_tick_.store(nt);
+  tick_starters_.store(0);
+  tick_arrivals_.store(0);
+  round_.fetch_add(1);
 }
 
 void Cluster::EmitRoundTick(double tick_ms) {
@@ -854,47 +1179,58 @@ void Cluster::EmitRoundTick(double tick_ms) {
   int n_alive = 0;
   long long members_listed = 0;
   long long sus_entered = 0, sus_refut = 0, sus_now = 0;
-  for (const auto& node : nodes_) {
-    node->AssertLockHeld();
-    if (node->alive()) {
-      n_alive += 1;
-      members_listed += static_cast<long long>(node->members_.size());
-      sus_now += static_cast<long long>(node->suspects_.size());
+  for (auto& s : stripes_) {
+    MutexLock lk(s->mu_);
+    for (int id : s->node_ids_) {
+      Node* node = nodes_[id].get();
+      node->AssertLockHeld();
+      if (node->alive()) {
+        n_alive += 1;
+        members_listed += static_cast<long long>(node->members_.size());
+        sus_now += static_cast<long long>(node->suspects_.size());
+      }
+      sus_entered += node->sus_entered_;
+      sus_refut += node->sus_refutations_;
     }
-    sus_entered += node->sus_entered_;
-    sus_refut += node->sus_refutations_;
   }
-  long long det_d = det_total_ - obs_det0_;
-  long long fp_d = fp_total_ - obs_fp0_;
+  long long det = det_total_.load();
+  long long fp = fp_total_.load();
+  long long sends = sends_total_.load();
+  MutexLock ob(obs_mu_);
+  long long det_d = det - obs_det0_;
+  long long fp_d = fp - obs_fp0_;
   std::ostringstream d;
   d << "n_alive=" << n_alive << " true_detections=" << (det_d - fp_d)
     << " false_positives=" << fp_d << " members_listed=" << members_listed
-    << " sends=" << (sends_total_ - obs_sends0_) << " tick_ms="
+    << " sends=" << (sends - obs_sends0_) << " tick_ms="
     << std::fixed << std::setprecision(3) << tick_ms;
   if (cfg_.t_suspect > 0) {
     d << " suspects_entered=" << (sus_entered - obs_sus_entered0_)
       << " refutations=" << (sus_refut - obs_refut0_)
       << " suspects_now=" << sus_now;
   }
-  obs_det0_ = det_total_;
-  obs_fp0_ = fp_total_;
-  obs_sends0_ = sends_total_;
+  obs_det0_ = det;
+  obs_fp0_ = fp;
+  obs_sends0_ = sends;
   obs_sus_entered0_ = sus_entered;
   obs_refut0_ = sus_refut;
-  ObsEmit("round_tick", -1, -1, d.str());
+  ObsEmitLocked("round_tick", -1, -1, d.str());
 }
 
 void Cluster::Stop() {
-  if (running_.exchange(false)) loop_.join();
-  if (epoll_fd_ >= 0) {
-    ::close(epoll_fd_);
-    epoll_fd_ = -1;
+  running_.store(false);
+  for (auto& s : stripes_) {
+    if (s->thread_.joinable()) s->thread_.join();
+    if (s->epoll_fd_ >= 0) {
+      ::close(s->epoll_fd_);
+      s->epoll_fd_ = -1;
+    }
   }
   for (auto& node : nodes_) node->Close();
 }
 
 void Cluster::Crash(int i) {
-  MutexLock lk(mu_);
+  MutexLock lk(StripeOf(i)->mu_);
   nodes_[i]->AssertLockHeld();
   nodes_[i]->StopCrash();
   // ground truth stamped at the injection seam: a dead process bumps
@@ -906,7 +1242,7 @@ void Cluster::Crash(int i) {
 }
 
 void Cluster::Leave(int i) {
-  MutexLock lk(mu_);
+  MutexLock lk(StripeOf(i)->mu_);
   nodes_[i]->AssertLockHeld();
   nodes_[i]->StopGraceful();
   // @gfs:inject leave
@@ -914,36 +1250,29 @@ void Cluster::Leave(int i) {
 }
 
 void Cluster::Join(int i) {
-  MutexLock lk(mu_);
+  MutexLock lk(StripeOf(i)->mu_);
   Node* node = nodes_[i].get();
   node->AssertLockHeld();
   if (!node->alive()) node->ResetState();
   // JOIN to the introducer; lost if the introducer is down (SPOF kept,
   // slave.go:22)
   node->Send(nodes_[cfg_.introducer]->addr(),
-             EncodeControl(node->addr(), "JOIN"));
+             EncodeControl(node->addr(), "JOIN"), FrameKind::kControl);
   // @gfs:inject join
   ObsEmit("join", -1, i, "");
 }
 
 void Cluster::Advance(int rounds) {
-  int target;
-  {
-    MutexLock lk(mu_);
-    target = round_ + rounds;
-  }
+  int target = round_.load() + rounds;
   while (running_) {
-    {
-      MutexLock lk(mu_);
-      if (round_ >= target) return;
-    }
+    if (round_.load() >= target) return;
     std::this_thread::sleep_for(
         std::chrono::duration<double>(cfg_.period / 4));
   }
 }
 
 int Cluster::Membership(int observer, int* out, int cap) {
-  MutexLock lk(mu_);
+  MutexLock lk(StripeOf(observer)->mu_);
   std::vector<int> ids;
   nodes_[observer]->AssertLockHeld();
   for (const auto& addr : nodes_[observer]->MemberAddrs()) {
@@ -957,7 +1286,7 @@ int Cluster::Membership(int observer, int* out, int cap) {
 }
 
 int Cluster::Suspects(int observer, int* out, int cap) {
-  MutexLock lk(mu_);
+  MutexLock lk(StripeOf(observer)->mu_);
   std::vector<int> ids;
   nodes_[observer]->AssertLockHeld();
   for (const auto& addr : nodes_[observer]->SuspectAddrs()) {
@@ -971,23 +1300,22 @@ int Cluster::Suspects(int observer, int* out, int cap) {
 }
 
 long long Cluster::Incarnation(int observer, int subject) {
-  MutexLock lk(mu_);
+  MutexLock lk(StripeOf(observer)->mu_);
   nodes_[observer]->AssertLockHeld();
   return nodes_[observer]->HbOf(nodes_[subject]->addr());
 }
 
 int Cluster::AliveNodes(int* out, int cap) {
-  MutexLock lk(mu_);
+  // ground-truth aliveness is atomic per node: no locks needed
   int count = 0;
   for (const auto& node : nodes_) {
-    node->AssertLockHeld();
     if (node->alive() && count < cap) out[count++] = node->idx();
   }
   return count;
 }
 
 int Cluster::DrainEvents(int* out, int cap) {
-  MutexLock lk(mu_);
+  MutexLock lk(events_mu_);
   int n = std::min(static_cast<int>(events_.size()), cap / 4);
   for (int i = 0; i < n; ++i) {
     out[i * 4 + 0] = events_[i].round;
@@ -1003,8 +1331,8 @@ int Cluster::DrainEvents(int* out, int cap) {
 // round-16 control/observation surface
 
 int Cluster::Configure(const std::string& kv) {
-  MutexLock lk(mu_);
-  if (running_) return -1;  // protocol knobs are fixed once the loop runs
+  MutexLock lk(ctl_mu_);
+  if (running_.load()) return -1;  // knobs are fixed once the loops run
   std::istringstream in(kv);
   std::string tok;
   while (in >> tok) {
@@ -1035,54 +1363,86 @@ int Cluster::Configure(const std::string& kv) {
       if (end == val.c_str() || *end != '\0' || !(v > 0.0 && v < 1.0))
         return -1;
       cfg_.lh_frac = v;
+    } else if (key == "delta") {
+      cfg_.delta = val != "0";
+    } else if (key == "delta_entries") {
+      long v = std::strtol(val.c_str(), &end, 10);
+      if (end == val.c_str() || *end != '\0' || v < 1) return -1;
+      cfg_.delta_entries = static_cast<int>(v);
+    } else if (key == "anti_entropy_every") {
+      long v = std::strtol(val.c_str(), &end, 10);
+      if (end == val.c_str() || *end != '\0' || v < 1) return -1;
+      cfg_.anti_entropy_every = static_cast<int>(v);
+    } else if (key == "loops") {
+      long v = std::strtol(val.c_str(), &end, 10);
+      if (end == val.c_str() || *end != '\0' || v < 1 || v > 64) return -1;
+      cfg_.loops = static_cast<int>(v);
+      RebuildStripes(cfg_.loops);
     } else {
       return -1;
     }
   }
+  // the DELTA_GOSSIP cadence constraint (see Config): an anti-entropy
+  // gap at or past the detection window could manufacture staleness —
+  // reject it, exactly like UdpCluster's ValueError
+  if (cfg_.delta && cfg_.anti_entropy_every >= cfg_.t_fail) return -1;
   return 0;
 }
 
-void Cluster::ObsEmit(const char* kind, int observer, int subject,
-                      const std::string& detail) {
-  if (!obs_enabled_) return;
+void Cluster::ObsEmitLocked(const char* kind, int observer, int subject,
+                            const std::string& detail) {
   std::ostringstream line;
-  line << kind << ' ' << (round_ - obs_round0_) << ' ' << observer << ' '
-       << subject;
+  line << kind << ' ' << (round_.load() - obs_round0_) << ' ' << observer
+       << ' ' << subject;
   if (!detail.empty()) line << ' ' << detail;
   line << '\n';
   obs_buf_ += line.str();
 }
 
+void Cluster::ObsEmit(const char* kind, int observer, int subject,
+                      const std::string& detail) {
+  if (!obs_enabled_.load(std::memory_order_acquire)) return;
+  MutexLock lk(obs_mu_);
+  ObsEmitLocked(kind, observer, subject, detail);
+}
+
 void Cluster::ObsEmit(const char* kind, int observer,
                       const std::string& subject_addr,
                       const std::string& detail) {
-  if (!obs_enabled_) return;
+  if (!obs_enabled_.load(std::memory_order_acquire)) return;
   ObsEmit(kind, observer, IdxOf(subject_addr), detail);
 }
 
 int Cluster::ObsEnable() {
-  MutexLock lk(mu_);
-  obs_enabled_ = true;
+  // gather the suspicion baselines stripe by stripe (stripe locks come
+  // before the obs leaf in the lock order)
+  long long e = 0, r = 0;
+  for (auto& s : stripes_) {
+    MutexLock lk(s->mu_);
+    for (int id : s->node_ids_) {
+      Node* node = nodes_[id].get();
+      node->AssertLockHeld();
+      e += node->sus_entered_;
+      r += node->sus_refutations_;
+    }
+  }
+  int round = round_.load();
+  MutexLock ob(obs_mu_);
   // rebase the stamped round clock to 0 and zero the per-round deltas:
   // the recorded stream lives in the arming-relative frame the udp
   // campaign runner's streams use (its cluster clock starts at 0)
-  obs_round0_ = round_;
-  obs_det0_ = det_total_;
-  obs_fp0_ = fp_total_;
-  obs_sends0_ = sends_total_;
-  long long e = 0, r = 0;
-  for (const auto& node : nodes_) {
-    node->AssertLockHeld();
-    e += node->sus_entered_;
-    r += node->sus_refutations_;
-  }
+  obs_round0_ = round;
+  obs_det0_ = det_total_.load();
+  obs_fp0_ = fp_total_.load();
+  obs_sends0_ = sends_total_.load();
   obs_sus_entered0_ = e;
   obs_refut0_ = r;
-  return round_;
+  obs_enabled_.store(true, std::memory_order_release);
+  return round;
 }
 
 int Cluster::ObsDrain(char* out, int cap) {
-  MutexLock lk(mu_);
+  MutexLock lk(obs_mu_);
   if (obs_buf_.empty() || cap <= 1) return 0;
   size_t take = obs_buf_.size();
   if (take > static_cast<size_t>(cap - 1)) {
@@ -1098,24 +1458,30 @@ int Cluster::ObsDrain(char* out, int cap) {
 }
 
 std::string Cluster::VitalsText() {
-  MutexLock lk(mu_);
   int n_alive = 0;
   long long sus_now = 0, entered = 0, refut = 0, confirms = 0;
-  for (const auto& node : nodes_) {
-    node->AssertLockHeld();
-    if (node->alive()) {
-      n_alive += 1;
-      sus_now += static_cast<long long>(node->suspects_.size());
+  for (auto& s : stripes_) {
+    MutexLock lk(s->mu_);
+    for (int id : s->node_ids_) {
+      Node* node = nodes_[id].get();
+      node->AssertLockHeld();
+      if (node->alive()) {
+        n_alive += 1;
+        sus_now += static_cast<long long>(node->suspects_.size());
+      }
+      entered += node->sus_entered_;
+      refut += node->sus_refutations_;
+      confirms += node->sus_confirms_;
     }
-    entered += node->sus_entered_;
-    refut += node->sus_refutations_;
-    confirms += node->sus_confirms_;
   }
   std::ostringstream os;
-  AppendVital(os, "round", round_);
+  AppendVital(os, "round", round_.load());
   AppendVital(os, "n_alive", n_alive);
-  AppendVital(os, "detections", det_total_);
-  AppendVital(os, "false_positives", fp_total_);
+  AppendVital(os, "detections", det_total_.load());
+  AppendVital(os, "false_positives", fp_total_.load());
+  AppendVital(os, "bytes_sent", bytes_total_.load());
+  AppendVital(os, "frames_full", frames_full_.load());
+  AppendVital(os, "frames_delta", frames_delta_.load());
   if (cfg_.t_suspect > 0) {
     AppendVital(os, "suspects_now", sus_now);
     AppendVital(os, "suspects_entered", entered);
@@ -1185,28 +1551,30 @@ int Cluster::ScenarioLoad(const std::string& table, int round0) {
       return -1;
     }
   }
-  MutexLock lk(mu_);
-  gates_ = std::move(g);
-  gates_armed_ = true;
-  scn_round0_ = round0;
+  const std::string name = g.name.empty() ? std::string("scenario") : g.name;
+  const int horizon = g.horizon;
+  {
+    MutexLock lk(gates_mu_);
+    gates_ = std::move(g);
+    scn_round0_ = round0;
+    gates_armed_.store(true, std::memory_order_release);
+  }
   ObsEmit("scenario_arm", -1, -1,
-          "name=" + (gates_.name.empty() ? std::string("scenario")
-                                         : gates_.name) +
-              " horizon=" + std::to_string(gates_.horizon));
+          "name=" + name + " horizon=" + std::to_string(horizon));
   return 0;
 }
 
 void Cluster::ScenarioClear() {
-  MutexLock lk(mu_);
-  if (gates_armed_) ObsEmit("scenario_clear", -1, -1, "");
-  gates_armed_ = false;
+  if (gates_armed_.exchange(false)) ObsEmit("scenario_clear", -1, -1, "");
 }
 
 bool Cluster::ScenarioDrops(int src, const std::string& dst_addr) const {
   // ScenarioRuntime.drops, minus Bernoulli loss (rejected at compile
-  // time by native.py): called from Node::Send with mu_ held
-  if (!gates_armed_) return false;
-  int r = round_ - scn_round0_;
+  // time by native.py): called from Node::Send with the sender's stripe
+  // lock held — the gate table is its own leaf, armed bit the fast path
+  if (!gates_armed_.load(std::memory_order_acquire)) return false;
+  MutexLock lk(gates_mu_);
+  int r = round_.load() - scn_round0_;
   for (const auto& f : gates_.flaps) {
     if (f.mask[src] && f.start <= r && r < f.end &&
         (r - f.start) % (f.up + f.down) >= f.up)
@@ -1231,33 +1599,39 @@ bool Cluster::ScenarioDrops(int src, const std::string& dst_addr) const {
 }
 
 void Cluster::SeedFull() {
-  MutexLock lk(mu_);
   double now = MonotonicNow();
   std::vector<std::string> addrs;
   addrs.reserve(nodes_.size());
   for (const auto& node : nodes_) addrs.push_back(node->addr());
-  for (auto& node : nodes_) {
-    node->AssertLockHeld();
-    if (node->alive()) node->SeedMembers(addrs, now);
+  for (auto& s : stripes_) {
+    MutexLock lk(s->mu_);
+    for (int id : s->node_ids_) {
+      Node* node = nodes_[id].get();
+      node->AssertLockHeld();
+      if (node->alive()) node->SeedMembers(addrs, now);
+    }
   }
 }
 
 int Cluster::Warm() {
-  MutexLock lk(mu_);
-  for (const auto& node : nodes_) {
-    node->AssertLockHeld();
-    if (!node->alive()) continue;
-    // full view with every counter past the hb<=1 grace — and NO churn
-    // residue: a pending suspicion means some entry is already past
-    // t_fail silent (it would confirm right after the caller starts
-    // its run — observed as a warm-gate FP burst in the stream's first
-    // rounds), and a non-empty fail list means a detection fired within
-    // the cooldown window (the view only LOOKS full because the entry
-    // was just re-added at a stale-prone counter)
-    if (static_cast<int>(node->members_.size()) != cfg_.n) return 0;
-    if (!node->suspects_.empty() || !node->fail_list_.empty()) return 0;
-    for (const auto& [addr, m] : node->members_)
-      if (m.hb <= 1) return 0;
+  for (auto& s : stripes_) {
+    MutexLock lk(s->mu_);
+    for (int id : s->node_ids_) {
+      Node* node = nodes_[id].get();
+      node->AssertLockHeld();
+      if (!node->alive()) continue;
+      // full view with every counter past the hb<=1 grace — and NO churn
+      // residue: a pending suspicion means some entry is already past
+      // t_fail silent (it would confirm right after the caller starts
+      // its run — observed as a warm-gate FP burst in the stream's first
+      // rounds), and a non-empty fail list means a detection fired within
+      // the cooldown window (the view only LOOKS full because the entry
+      // was just re-added at a stale-prone counter)
+      if (static_cast<int>(node->members_.size()) != cfg_.n) return 0;
+      if (!node->suspects_.empty() || !node->fail_list_.empty()) return 0;
+      for (const auto& [addr, m] : node->members_)
+        if (m.hb <= 1) return 0;
+    }
   }
   return 1;
 }
@@ -1330,8 +1704,10 @@ int gfs_drain_events(void* h, int* out, int cap) {
 // -- round-16 observability + campaign surface ------------------------------
 
 // Pre-start protocol knobs ("k=v k=v ..."): push=ring|random, fanout,
-// remove_broadcast, t_suspect, lh_multiplier, lh_frac.  0 ok, -1 on a
-// bad table or a started cluster.
+// remove_broadcast, t_suspect, lh_multiplier, lh_frac, delta,
+// delta_entries, anti_entropy_every, loops.  0 ok, -1 on a bad table, a
+// started cluster, or delta with anti_entropy_every >= t_fail (the same
+// constraint UdpCluster rejects with ValueError).
 int gfs_configure(void* h, const char* kv) {
   return static_cast<gossipfs::Cluster*>(h)->Configure(kv ? kv : "");
 }

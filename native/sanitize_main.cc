@@ -201,6 +201,81 @@ int main(int argc, char** argv) {
 
   gfs_cluster_destroy(h);
 
+  // round-20 delta + k-loop phase: a second cluster running the delta
+  // dissemination profile with the receive path striped across 4 epoll
+  // loops, under the same concurrent observation hammering — the
+  // per-peer cursor maps, the ver-ordered change index, the address
+  // ring, and the striped socket ownership get their own TSan/ASan
+  // certification.  The cadence constraint (anti_entropy_every must
+  // stay strictly below t_fail in delta mode, or a lost anti-entropy
+  // push can manufacture staleness past the detection window) is
+  // exercised as a reject first.
+  {
+    void* h2 = gfs_cluster_create(kN, base_port + 64, period, kTFail,
+                                  kTCooldown, /*min_group=*/4,
+                                  /*fresh_cooldown=*/1, /*introducer=*/0);
+    if (gfs_configure(h2, "delta=1 anti_entropy_every=5") == 0) {
+      gfs_cluster_destroy(h2);
+      return Fail("configure accepted anti_entropy_every >= t_fail "
+                  "with delta on");
+    }
+    if (gfs_configure(h2, "loops=0") == 0 ||
+        gfs_configure(h2, "loops=65") == 0 ||
+        gfs_configure(h2, "delta_entries=0") == 0) {
+      gfs_cluster_destroy(h2);
+      return Fail("configure accepted an out-of-range delta/loops knob");
+    }
+    if (gfs_configure(h2, "push=random fanout=4 remove_broadcast=0 "
+                          "t_suspect=2 delta=1 delta_entries=8 "
+                          "anti_entropy_every=3 loops=4") != 0) {
+      gfs_cluster_destroy(h2);
+      return Fail("configure rejected a valid delta + loops knob table");
+    }
+    if (gfs_cluster_start(h2) != 0) {
+      gfs_cluster_destroy(h2);
+      return Fail("delta cluster failed to start (ports busy?)");
+    }
+    gfs_seed_full(h2);
+    for (int i = 0; i < 100 && !gfs_warm(h2); ++i)
+      std::this_thread::sleep_for(
+          std::chrono::duration<double>(period / 2));
+    gfs_obs_enable(h2);
+    std::atomic<bool> stop2{false};
+    std::thread poller2([&] {
+      int pbuf[4 * kN];
+      char obs[8192];
+      char vit[512];
+      while (!stop2.load()) {
+        gfs_alive(h2, pbuf, kN);
+        gfs_membership(h2, 1, pbuf, kN);
+        gfs_drain_events(h2, pbuf, 4 * kN);
+        gfs_obs_drain(h2, obs, sizeof obs);
+        gfs_vitals(h2, vit, sizeof vit);
+        std::this_thread::sleep_for(std::chrono::milliseconds(2));
+      }
+    });
+    // crash one node mid-poll: detection must cross stripe boundaries
+    // (the crashed node's entries live in every other stripe's views)
+    gfs_crash(h2, 4);
+    gfs_advance(h2, kTFail + kTSuspect + 7);
+    stop2.store(true);
+    poller2.join();
+    int alive2 = gfs_alive(h2, buf, kN);
+    if (Contains(buf, alive2, 4))
+      rc = Fail("delta cluster: crashed node still alive after slack");
+    // the wire actually ran in delta mode: frames_delta must be nonzero
+    char vit[512];
+    if (gfs_vitals(h2, vit, sizeof vit) <= 0) {
+      rc = Fail("delta cluster: vitals unreadable");
+    } else {
+      const char* p = std::strstr(vit, "frames_delta=");
+      if (p == nullptr || std::atoll(p + std::strlen("frames_delta=")) <= 0)
+        rc = Fail("delta cluster: no delta frames on the wire");
+    }
+    gfs_stop(h2);
+    gfs_cluster_destroy(h2);
+  }
+
   // codec sweep: round-trip plus the malformed chunks DecodeMembers must
   // skip (strtoll/strtod edge input — the UBSan half of the build)
   {

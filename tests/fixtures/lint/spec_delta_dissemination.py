@@ -1,0 +1,43 @@
+"""Seeded drift for spec-delta-dissemination: the delta entry
+selection rewritten oldest-first with NO round-robin stable-tail
+refresh — a stable entry is never re-pushed between anti-entropy
+rounds, so its refresh gap silently grows toward the detection window
+(mounted over gossipfs_tpu/detector/udp.py)."""
+
+DELTA_MARK = "<#DELTA#>"
+ENTRY_SEP = "<#ENTRY#>"
+FIELD_SEP = "<#INFO#>"
+
+
+class UdpNode:
+    def _encode_delta(self, peer):
+        c = self.cluster
+        cursor = self._sent_ver.get(peer)
+        self._sent_ver[peer] = self._ver
+        if cursor is None:
+            return self._encode()
+        cap = c.delta_entries
+        # DRIFT: oldest change first, truncated at the cap, and the
+        # stable tail is never refreshed in leftover capacity
+        changed = [(a, m) for a, m in self.members.items()
+                   if m.ver > cursor]
+        changed.sort(key=lambda am: am[1].ver)
+        picks = changed[:cap]
+        return DELTA_MARK + ENTRY_SEP.join(
+            f"{a}{FIELD_SEP}{m.hb}{FIELD_SEP}{m.ts}" for a, m in picks)
+
+    def tick(self, now):
+        c = self.cluster
+        anti_entropy = (not c.delta
+                        or self.rounds % c.anti_entropy_every == 0)
+        return anti_entropy
+
+
+class UdpCluster:
+    def __init__(self, n, t_fail=5, delta=False, delta_entries=16,
+                 anti_entropy_every=4):
+        if delta and anti_entropy_every >= t_fail:
+            raise ValueError("anti_entropy_every must stay below t_fail")
+        self.delta = delta
+        self.delta_entries = delta_entries
+        self.anti_entropy_every = anti_entropy_every

@@ -148,6 +148,7 @@ class TestDriver:
 STORM_CASE = REPO / "regressions" / "outage_storm_n256.json"
 ABSORBED_CASE = REPO / "regressions" / "outage_absorbed_n256.json"
 MILD_UDP_CASE = REPO / "regressions" / "outage_mild_udp_n24.json"
+MILD_DELTA_UDP_CASE = REPO / "regressions" / "outage_mild_delta_udp_n24.json"
 
 
 class TestOutageAbsorption:
@@ -204,6 +205,24 @@ class TestOutageAbsorption:
         kinds = {e.kind for e in events}
         assert "round_tick" in kinds and "crash" in kinds
 
+    def test_udp_engine_delta_campaign_smoke(self):
+        """THE tier-1 fast-lane delta-dissemination smoke (round 20):
+        the mild case's delta twin end-to-end over UdpCluster — the
+        membership refresh rides bounded delta frames (changed-first +
+        rr tail, cap 16) with a full anti-entropy push every 4th round,
+        and the verdict must stay pass AND agree with the tensor
+        replay: bounded piggybacking loses no detection fidelity."""
+        out = campaigns.run_case_engine(MILD_DELTA_UDP_CASE, engine="udp",
+                                        period=0.05)
+        assert out["reproduced"], out
+        assert out["agreement"]["match"], out["agreement"]
+        assert out["engine_verdict"] == out["tensor_verdict"] == "pass"
+        # delta mode really engaged on the wire: both frame kinds flowed
+        # (deltas between anti-entropy rounds, full lists on them)
+        wire = out["engine_row"]["wire"]
+        assert wire["frames_delta"] > 0, wire
+        assert wire["frames_full"] > 0, wire
+
     def test_native_engine_campaign_smoke(self):
         """THE tier-1 fast-lane native-engine smoke (round 16): the
         same mild committed case end-to-end over the C++ epoll engine —
@@ -232,16 +251,32 @@ class TestOutageAbsorption:
         assert out["engine_row"]["tick_ms"]["count"] > 0
 
     def test_nativecampaign_matrix_artifact(self):
-        """The committed three-engine verdict matrix
-        (NATIVECAMPAIGN_r16.json, `tools/campaign.py --matrix`) keeps
-        its contract: every native row COHORT-EXACT and reproduced
-        (storm/absorption pair included, n=256), every committed case
-        covered, full agreement (scaled-reference knife-edges only in
-        rescale_boundaries — with the committed expectation still met)."""
-        art = json.loads((REPO / "NATIVECAMPAIGN_r16.json").read_text())
+        """The committed three-engine verdict matrix — re-anchored at
+        round 20 from NATIVECAMPAIGN_r16.json to COHORT_r20.json
+        (`tools/campaign.py --matrix --ab`): the matrix nests under
+        "matrix", the delta A/B under "ab", and the cohort-exact
+        native lane now reaches n=1024 (the delta-dissemination
+        regression case).  Contract otherwise unchanged: every native
+        row COHORT-EXACT and reproduced (storm/absorption pair
+        included, n=256), every committed case covered, full agreement
+        (scaled-reference knife-edges only in rescale_boundaries —
+        with the committed expectation still met) — plus the A/B
+        payoff gates: headline payload reduction >= the committed
+        target at n=1024, every delta cell's p50 tick inside
+        native_period(n), zero false positives in every cell."""
+        cohort = json.loads((REPO / "COHORT_r20.json").read_text())
+        assert cohort["schema"] == "gossipfs-cohort/v1"
+        assert cohort["ok"] is True
+        assert cohort["native_cohort_max_n"] >= 1024
+        ab = cohort["ab"]
+        assert ab["ok"] is True
+        assert ab["headline_reduction"] >= ab["target_reduction"] >= 4.0
+        assert ab["zero_false_positives"] is True
+        assert ab["p50_within_budget"] is True
+        art = cohort["matrix"]
         assert art["schema"] == "gossipfs-nativecampaign/v1"
         assert art["all_agree"] is True
-        assert art["native_cohort_max_n"] >= 256
+        assert art["native_cohort_max_n"] >= 1024
         # the matrix covers every committed GOSSIP case; traffic-plane
         # cases (a "traffic" block instead of a "scenario") replay on
         # the durability harness, not the engine matrix — see

@@ -100,7 +100,7 @@ def test_udp_decode_skips_bad_entries():
     good = f"127.0.0.1:9001{FIELD_SEP}7{FIELD_SEP}0.0"
     bad = f"x{FIELD_SEP}notanumber{FIELD_SEP}0.0"
     out = UdpNode._decode(ENTRY_SEP.join([bad, good, f"y{FIELD_SEP}"]))
-    assert out == [("127.0.0.1:9001", 7)]
+    assert out == [("127.0.0.1:9001", 7, 0.0)]
 
 
 # ---------------------------------------------------------------------------
@@ -125,6 +125,33 @@ def test_shrink_requires_failing_start():
     case = schedules.generate("confirm_expiry", seed=0)
     with pytest.raises(ValueError):
         shrink.shrink(case, lambda cand: False)
+
+
+def test_shrink_seed_neighbourhood_canonicalizes():
+    """The seed pass restarts ddmin from the smallest failing draw in
+    the neighbourhood — with size-tied draws that means the lowest
+    failing seed, here 1 (seed 0 passes, so it may not be adopted)."""
+    case = schedules.generate("malformed_codec", seed=3)
+
+    def still_fails(cand):
+        return (cand.get("seed", 0) >= 1
+                and any(s["op"] == "crash" for s in cand["steps"]))
+
+    small = shrink.shrink(case, still_fails, settle_pad=2)
+    assert small["seed"] == 1
+    assert [s["op"] for s in small["steps"]] == ["crash"]
+    schedules.validate(small)
+
+
+def test_shrink_seed_radius_zero_disables_pass():
+    case = schedules.generate("malformed_codec", seed=3)
+
+    def still_fails(cand):
+        return any(s["op"] == "crash" for s in cand["steps"])
+
+    small = shrink.shrink(case, still_fails, settle_pad=2,
+                          seed_radius=0)
+    assert small["seed"] == 3
 
 
 # ---------------------------------------------------------------------------

@@ -46,7 +46,10 @@ class TestCodecParity:
         assert ENTRY_SEP in wire and FIELD_SEP in wire
         # the Python decoder reads the C++ encoder's output
         decoded = UdpNode._decode(wire)
-        assert decoded == [("127.0.0.1:8000", 17), ("127.0.0.1:8001", 0)]
+        assert decoded == [
+            ("127.0.0.1:8000", 17, 3.5),
+            ("127.0.0.1:8001", 0, 0.0),
+        ]
 
     def test_cpp_decodes_python_style_wire(self):
         wire = ENTRY_SEP.join(

@@ -345,6 +345,96 @@ def _matrix(args) -> dict:
     }
 
 
+def _ab_cell_subprocess(n: int, delta: int, loops: int, rounds: int,
+                        period: float | None) -> dict:
+    """One A/B cell in a FRESH interpreter (same isolation rationale
+    as _case_subprocess — the cells are real-time measurements)."""
+    import subprocess
+
+    cmd = [sys.executable, os.path.abspath(__file__),
+           "--ab-cell", str(n), "--ab-delta", str(delta),
+           "--ab-cell-loops", str(loops), "--ab-rounds", str(rounds)]
+    if period is not None:
+        cmd += ["--period", str(period)]
+    out = subprocess.run(cmd, capture_output=True, text=True,
+                         timeout=1800)
+    for line in reversed(out.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError(f"no JSON from {cmd}: {out.stdout[-300:]}\n"
+                       f"{out.stderr[-300:]}")
+
+
+def _ab(args) -> dict:
+    """The delta-piggyback A/B grid (full-list vs delta x k epoll
+    loops x cohort sizes) on the native engine: bytes/round and the
+    per-round tick latency, quiet cluster, both arms at the same
+    fanout.  ``ok`` requires (a) the delta arm's payload reduction at
+    the LARGEST n to reach --ab-target on some loop count, (b) every
+    delta cell's p50 tick inside the lane's period budget
+    (native_period(n)), and (c) zero false positives in every cell —
+    the honesty check that the bytes saved did not come out of
+    correctness."""
+    from gossipfs_tpu.campaigns.engines import native_period
+
+    cells = []
+    for n in args.ab_ns:
+        for loops in args.ab_loop_grid:
+            for delta in (0, 1):
+                cells.append(_ab_cell_subprocess(
+                    n, delta, loops, args.ab_rounds, args.period))
+    by = {(c["n"], c["loops"], bool(c["delta"])): c for c in cells}
+    reduction = {}
+    p50_tick_ms = {}
+    for n in args.ab_ns:
+        for k in args.ab_loop_grid:
+            full, dl = by[(n, k, False)], by[(n, k, True)]
+            key = f"n{n}_k{k}"
+            reduction[key] = (full["wire"]["bytes_per_round"]
+                              / dl["wire"]["bytes_per_round"])
+            p50_tick_ms[key] = {"full": full["tick_ms"]["p50_ms"],
+                                "delta": dl["tick_ms"]["p50_ms"]}
+    n_max = max(args.ab_ns)
+    headline = max(reduction[f"n{n_max}_k{k}"]
+                   for k in args.ab_loop_grid)
+    budget_ms = {n: native_period(n) * 1000.0 for n in args.ab_ns}
+    p50_ok = all(
+        by[(n, k, True)]["tick_ms"]["p50_ms"] <= budget_ms[n]
+        for n in args.ab_ns for k in args.ab_loop_grid)
+    fp_ok = all(c["false_positives"] == 0 for c in cells)
+    doc = {
+        "schema": "gossipfs-delta-ab/v1",
+        "metric": "full-list vs delta-piggyback wire payload and tick "
+                  "latency on the native engine, k epoll loops, quiet "
+                  "cluster, both arms at identical fanout",
+        "ns": args.ab_ns, "loop_grid": args.ab_loop_grid,
+        "rounds": args.ab_rounds,
+        "cells": cells,
+        "bytes_reduction": reduction,
+        "p50_tick_ms": p50_tick_ms,
+        "p50_budget_ms": {str(n): budget_ms[n] for n in args.ab_ns},
+        "headline_reduction": headline,
+        "target_reduction": args.ab_target,
+        "zero_false_positives": fp_ok,
+        "p50_within_budget": p50_ok,
+        "ok": headline >= args.ab_target and p50_ok and fp_ok,
+    }
+    if args.ab_udp_case:
+        u = _case_subprocess(args.ab_udp_case, "udp", None, args.period)
+        wire = (u.get("engine_row") or {}).get("wire")
+        doc["udp_slice"] = {
+            "case": os.path.basename(args.ab_udp_case),
+            "reproduced": u["reproduced"],
+            "verdict": u["engine_verdict"],
+            "agreement": u["agreement"],
+            "wire": wire,
+        }
+        doc["ok"] = doc["ok"] and u["reproduced"] \
+            and bool(wire and wire["frames_delta"] > 0)
+    return doc
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--family", choices=None, default=None,
@@ -433,6 +523,31 @@ def main(argv=None) -> int:
     p.add_argument("--absorption", type=str, default=None, metavar="ART",
                    help="re-verify a committed surface artifact's "
                         "chosen point (the outage_absorption claim)")
+    p.add_argument("--ab", action="store_true",
+                   help="delta-piggyback A/B grid on the native engine "
+                        "(full vs delta x --ab-loop-grid x --ab-ns); "
+                        "with --matrix, both land in one cohort "
+                        "artifact (COHORT_r20.json)")
+    p.add_argument("--ab-ns", type=int, nargs="+",
+                   default=[256, 512, 1024],
+                   help="--ab: cohort sizes")
+    p.add_argument("--ab-loop-grid", type=int, nargs="+", default=[1, 4],
+                   help="--ab: epoll loop counts (gfs_configure loops=k)")
+    p.add_argument("--ab-rounds", type=int, default=24,
+                   help="--ab: measured steady-state rounds per cell")
+    p.add_argument("--ab-target", type=float, default=4.0,
+                   help="--ab: required bytes/round reduction at the "
+                        "largest --ab-ns")
+    p.add_argument("--ab-udp-case", type=str, default=None,
+                   help="--ab: also replay this committed delta case "
+                        "on the udp engine (the delta_cohort claim's "
+                        "verdict-agreement slice)")
+    p.add_argument("--ab-cell", type=int, default=None, metavar="N",
+                   help=argparse.SUPPRESS)
+    p.add_argument("--ab-delta", type=int, default=0,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--ab-cell-loops", type=int, default=1,
+                   help=argparse.SUPPRESS)
     args = p.parse_args(argv)
 
     from gossipfs_tpu import campaigns
@@ -450,6 +565,50 @@ def main(argv=None) -> int:
                 f.write("\n")
         print(json.dumps(out))
         return 0
+
+    if args.ab_cell:
+        from gossipfs_tpu.campaigns.engines import run_ab_cell
+
+        out = run_ab_cell(args.ab_cell, delta=bool(args.ab_delta),
+                          loops=args.ab_cell_loops,
+                          rounds=args.ab_rounds, period=args.period)
+        print(json.dumps(out))
+        return 0
+
+    if args.ab and args.matrix:
+        # the round-20 cohort artifact: the three-engine verdict matrix
+        # (n=1024 cohort-exact included) + the delta A/B perf grid
+        matrix = _matrix(args)
+        ab = _ab(args)
+        out = {
+            "schema": "gossipfs-cohort/v1",
+            "matrix": matrix,
+            "ab": ab,
+            "all_agree": matrix["all_agree"],
+            "native_cohort_max_n": matrix["native_cohort_max_n"],
+            "headline_reduction": ab["headline_reduction"],
+            "ok": matrix["all_agree"] and ab["ok"],
+            "command": ("python tools/campaign.py --matrix --ab "
+                        "--ab-ns %s --out COHORT_r20.json"
+                        % " ".join(str(n) for n in args.ab_ns)),
+        }
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(out, f, indent=1)
+                f.write("\n")
+        print(json.dumps({k: out[k] for k in
+                          ("schema", "all_agree", "native_cohort_max_n",
+                           "headline_reduction", "ok")}))
+        return 0 if out["ok"] else 1
+
+    if args.ab:
+        out = _ab(args)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(out, f, indent=1)
+                f.write("\n")
+        print(json.dumps(out))
+        return 0 if out["ok"] else 1
 
     if args.matrix:
         out = _matrix(args)

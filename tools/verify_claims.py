@@ -209,6 +209,20 @@ CLAIMS = {
          "--pair", "regressions/outage_storm_n256.json",
          "regressions/outage_absorbed_n256.json"],
         lambda d: 1.0 if d["reproduced"] else 0.0, 1.0, 0.0),
+    # round-20 delta-piggyback dissemination (COHORT_r20.json is the
+    # committed full artifact): the n=256 delta-vs-full A/B on the
+    # native engine — >= 2x bytes/round reduction at identical fanout,
+    # delta p50 tick inside native_period(256), zero false positives in
+    # both arms — plus the committed delta udp case replayed with its
+    # verdict agreeing with the tensor replay and delta frames actually
+    # on the wire.  The >= 4x n=1024 headline is the slow lane's
+    # (tools/campaign.py --matrix --ab).  ~3 min on a 1-core host.
+    "delta_cohort": (
+        ["env", "JAX_PLATFORMS=cpu", sys.executable, "tools/campaign.py",
+         "--ab", "--ab-ns", "256", "--ab-loop-grid", "1",
+         "--ab-rounds", "16", "--ab-target", "2.0",
+         "--ab-udp-case", "regressions/outage_mild_delta_udp_n24.json"],
+        lambda d: 1.0 if d["ok"] else 0.0, 1.0, 0.0),
     # traffic plane (TRAFFIC_r12.json is the committed artifact of the
     # full-bench form of this command): writes race a timed partition
     # that confines quorum reachability to the master's side; the claim
